@@ -1,0 +1,20 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+the `zhanj7/mxnet` reference (an Apache MXNet 1.x fork).
+
+Not a port: the reference's C++ engine/executor/kernel stack maps onto XLA's
+async runtime, compiler fusion, and GSPMD partitioning (see SURVEY.md §7).
+Import as `import mxnet_tpu as mx` — the public surface mirrors the reference:
+`mx.nd`, `mx.sym`, `mx.gluon`, `mx.autograd`, `mx.kv`, `mx.cpu()/mx.tpu()`.
+"""
+from . import base
+from .base import MXNetError, __version__
+
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
+                      num_gpus, num_tpus, current_context)
+
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import io

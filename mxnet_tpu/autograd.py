@@ -1,0 +1,296 @@
+"""Imperative autograd.
+
+TPU-native analog of the reference's tape autograd (reference:
+src/imperative/imperative.cc (Imperative::RecordOp/Backward),
+python/mxnet/autograd.py). The reference records an NNVM graph and executes a
+Gradient-pass graph; here each recorded op stores the `jax.vjp` pullback
+captured at forward time (residuals live on device), and `backward()` replays
+pullbacks in reverse tape order. Hybridized blocks record ONE tape node whose
+pullback is the vjp of the whole jitted function — same shape as the
+reference's CachedOp backward (src/imperative/cached_op.cc).
+
+Lifetime: the tape holds weak references; a node stays alive only while some
+NDArray downstream of it is alive (outputs hold their producing node, nodes
+hold their inputs). Dropping the results of a recorded branch frees its
+residuals — mirroring the reference, where the graph is owned by the arrays.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variable", "record_op", "backward", "grad",
+           "set_recording", "set_training", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []          # list[weakref.ref[_Node]]
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    """reference: MXAutogradSetIsRecording — returns previous value."""
+    st = _st()
+    prev = st.recording
+    st.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    """reference: MXAutogradSetIsTraining."""
+    st = _st()
+    prev = st.training
+    st.training = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """reference: python/mxnet/autograd.py (record)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# the tape
+# ---------------------------------------------------------------------------
+class _Node:
+    __slots__ = ("op_name", "inputs", "n_out", "out_meta", "vjp_fn",
+                 "out_cots", "alive", "__weakref__")
+
+    def __init__(self, op_name, inputs, out_meta, vjp_fn):
+        self.op_name = op_name
+        self.inputs = inputs          # list[NDArray] (object refs)
+        self.n_out = len(out_meta)
+        self.out_meta = out_meta      # [(shape, dtype)] for zero-filling
+        self.vjp_fn = vjp_fn
+        self.out_cots = None          # filled during backward
+        self.alive = True
+
+
+def mark_variable(nd, grad_req="write"):
+    """reference: Imperative::MarkVariables."""
+    nd._grad_req = grad_req
+
+
+def record_op(op_name, input_nds, output_nds, vjp_fn):
+    """Append one executed op to the tape (reference: Imperative::RecordOp)."""
+    st = _st()
+    meta = [(o.shape, o.dtype) for o in output_nds]
+    node = _Node(op_name, list(input_nds), meta, vjp_fn)
+    st.tape.append(weakref.ref(node))
+    for inp in input_nds:
+        inp._tape_used = True   # mutating it now would corrupt grad routing
+    for i, o in enumerate(output_nds):
+        o._autograd_node = (node, i)
+    if len(st.tape) >= 4096:
+        st.tape = [r for r in st.tape if r() is not None]
+
+
+def _run_backward(heads, head_grads, retain_graph, want_ids=None):
+    """Reverse replay. Returns {id(nd): (nd, cotangent)} for inputs whose
+    grad_req != 'null', plus any ids in `want_ids`. Does NOT touch .grad
+    buffers (callers decide)."""
+    st = _st()
+    tape = [r() for r in st.tape]
+    tape = [n for n in tape if n is not None]
+
+    def _wanted(nd_in):
+        return (nd_in._grad_req != "null" or
+                (want_ids is not None and id(nd_in) in want_ids))
+
+    leaf_acc = {}
+    for h, hg in zip(heads, head_grads):
+        cot = hg if hg is not None else jnp.ones(h.shape, dtype=h.dtype)
+        entry = h._autograd_node
+        if entry is None:
+            if _wanted(h):
+                _acc(leaf_acc, h, cot)
+            continue
+        node, slot = entry
+        if node.out_cots is None:
+            node.out_cots = [None] * node.n_out
+        node.out_cots[slot] = _add_maybe(node.out_cots[slot], cot)
+
+    for node in reversed(tape):
+        if node.out_cots is None or not node.alive:
+            continue
+        if node.n_out == 1:
+            cot_arg = node.out_cots[0]
+        else:
+            # zero-fill unused output slots so the pullback sees full structure
+            cot_arg = tuple(
+                c if c is not None else jnp.zeros(sh, dtype=dt)
+                for c, (sh, dt) in zip(node.out_cots, node.out_meta))
+        in_cots = node.vjp_fn(cot_arg)
+        for nd_in, cot in zip(node.inputs, in_cots):
+            if cot is None or (hasattr(cot, "dtype") and
+                               cot.dtype == jax.dtypes.float0):
+                continue
+            entry = nd_in._autograd_node
+            if entry is not None:
+                pnode, pslot = entry
+                if pnode.alive:
+                    if pnode.out_cots is None:
+                        pnode.out_cots = [None] * pnode.n_out
+                    pnode.out_cots[pslot] = _add_maybe(
+                        pnode.out_cots[pslot], cot)
+            if _wanted(nd_in):
+                _acc(leaf_acc, nd_in, cot)
+        node.out_cots = None
+        if not retain_graph:
+            node.alive = False
+            node.vjp_fn = None
+
+    if not retain_graph:
+        st.tape = [r for r in st.tape if r() is not None and r().alive]
+    return leaf_acc
+
+
+def _acc(acc, nd, cot):
+    k = id(nd)
+    if k in acc:
+        acc[k] = (nd, acc[k][1] + cot)
+    else:
+        acc[k] = (nd, cot)
+
+
+def _add_maybe(a, b):
+    return b if a is None else a + b
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """reference: MXAutogradBackwardEx via python/mxnet/autograd.py (backward).
+    Writes accumulated gradients into `.grad` of marked variables, honoring
+    grad_req 'write' (overwrite) vs 'add' (accumulate across backwards)."""
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    head_grads = [g._read() if hasattr(g, "_read") else g for g in head_grads]
+    leaf_acc = _run_backward(list(heads), head_grads, retain_graph)
+    for _, (nd_var, cot) in leaf_acc.items():
+        if nd_var._grad_req == "null":
+            continue
+        if nd_var._grad is None:
+            from .ndarray.ndarray import zeros
+            nd_var._grad = zeros(nd_var.shape, ctx=nd_var._ctx,
+                                 dtype=nd_var.dtype)
+        if nd_var._grad_req == "add":
+            nd_var._grad._write(nd_var._grad._read() + cot.astype(nd_var.dtype))
+        else:
+            nd_var._grad._write(cot.astype(nd_var.dtype))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """reference: python/mxnet/autograd.py (grad) — returns grads w.r.t.
+    `variables`; never touches their `.grad` buffers."""
+    from .ndarray.ndarray import NDArray, zeros
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    single = not isinstance(variables, (list, tuple))
+    variables = [variables] if single else list(variables)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    head_grads = [g._read() if hasattr(g, "_read") else g for g in head_grads]
+    acc = _run_backward(list(heads), head_grads, retain_graph,
+                        want_ids={id(v) for v in variables})
+    outs = []
+    for v in variables:
+        k = id(v)
+        if k in acc:
+            outs.append(NDArray(acc[k][1].astype(v.dtype), ctx=v._ctx))
+        else:
+            outs.append(zeros(v.shape, ctx=v._ctx, dtype=v.dtype))
+    return outs[0] if single else outs
+
+
+class Function:
+    """Custom differentiable function (reference: python/mxnet/autograd.py
+    (Function) — user-defined forward/backward pair)."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn_self = self
+            n_out = len(outs)
+
+            def vjp_fn(cot):
+                cots = (cot,) if n_out == 1 else cot
+                cot_nds = [NDArray(c) for c in cots]
+                in_grads = fn_self.backward(*cot_nds)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = [in_grads]
+                return [g._read() if isinstance(g, NDArray) else g
+                        for g in in_grads]
+
+            record_op(type(self).__name__, list(inputs), outs, vjp_fn)
+        return outs[0] if single else outs

@@ -1,0 +1,11 @@
+"""Operator library: the registry plus all op-definition modules.
+
+Importing this package registers every op (reference: static registration of
+NNVM_REGISTER_OP at libmxnet.so load time).
+"""
+from . import registry
+from .registry import register, alias, get, list_ops
+
+from . import tensor      # noqa: F401  elementwise/broadcast/reduce/shape
+from . import nn          # noqa: F401  FC/conv/pool/norm/softmax/dropout
+from . import random_ops  # noqa: F401  sampling ops

@@ -1,0 +1,70 @@
+"""Stateful RNG facade over JAX's functional threefry keys.
+
+TPU-native analog of the reference's per-device `RandGenerator<xpu>`
+(reference: src/common/random_generator.h, include/mxnet/random_generator.h,
+seeded via python/mxnet/random.py (seed)). The reference keeps mutable
+Philox/MT state per device; here a per-context key table holds a threefry key
+that is split on every draw, preserving `mx.random.seed(s[, ctx])` semantics
+while staying functional underneath (each op consumes a fresh subkey).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "take_key", "fold_in", "Generator"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _table():
+    if not hasattr(_state, "keys"):
+        _state.keys = {}
+    return _state.keys
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the RNG. reference: python/mxnet/random.py (seed) — seeds every
+    device generator, or one device when ctx is given."""
+    if ctx == "all":
+        _table().clear()
+        global _DEFAULT_SEED
+        _DEFAULT_SEED = int(seed_state)
+        _table()[None] = jax.random.key(int(seed_state))
+    else:
+        key = (ctx.device_type, ctx.device_id)
+        _table()[key] = jax.random.key(int(seed_state))
+
+
+def take_key(ctx=None):
+    """Split the current key and return a fresh subkey (advances state)."""
+    tbl = _table()
+    key = None if ctx is None else (ctx.device_type, ctx.device_id)
+    if key not in tbl:
+        if key is not None and None in tbl:
+            # derive device stream from the global seed, like the reference's
+            # per-device generators seeded from one seed + device id
+            tbl[key] = jax.random.fold_in(tbl[None], hash(key) & 0x7FFFFFFF)
+        else:
+            tbl[key] = jax.random.key(_DEFAULT_SEED)
+    k0, k1 = jax.random.split(tbl[key])
+    tbl[key] = k0
+    return k1
+
+
+def fold_in(data):
+    """Deterministically derive a key from current state + integer data."""
+    return jax.random.fold_in(take_key(), int(data))
+
+
+class Generator:
+    """Explicit generator object for code that wants owned RNG state."""
+
+    def __init__(self, seed_state=0):
+        self._key = jax.random.key(int(seed_state))
+
+    def take_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub

@@ -1,0 +1,31 @@
+"""Test harness configuration.
+
+Forces the CPU platform with 8 virtual devices BEFORE jax initializes, so the
+whole suite exercises multi-device mesh code paths without TPU hardware
+(SURVEY.md §4: the reference re-runs its CPU suite on gpu(0); we are
+context-parametric the same way via MXNET_TEST_DEVICE).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng(request):
+    """reference: tests/python/unittest/common.py (@with_seed) — seed and log
+    the RNG per test for reproducibility."""
+    seed = np.random.randint(0, 2 ** 31)
+    env = os.environ.get("MXNET_TEST_SEED")
+    if env:
+        seed = int(env)
+    import mxnet_tpu as mx
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    request.node.user_properties.append(("mxnet_test_seed", seed))
+    yield

@@ -1,0 +1,260 @@
+"""Autograd tape (reference suite: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x * x)
+    y.backward()
+    expected = 2 * 2.0 * np.exp(4.0)
+    assert np.allclose(x.grad.asnumpy(), [expected], rtol=1e-5)
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), [4, 5])
+    assert np.allclose(b.grad.asnumpy(), [1, 2])
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+    x.zero_grad()
+    assert np.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()  # write
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])  # only d(y_const * x)/dx = y
+
+
+def test_blockgrad_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * x) + x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_no_record_no_grad():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 5  # outside record
+    with autograd.record():
+        z = x * 3
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_autograd_grad_function():
+    x = nd.array([3.0])
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, x)
+    assert np.allclose(g.asnumpy(), [6.0])
+    assert x.grad is None or np.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_matrix_grad():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 2).astype(np.float32))
+    a.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b)
+        loss = c.sum()
+    loss.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy().sum(axis=1)[None, :].repeat(3, 0),
+                       atol=1e-5)
+
+
+def test_broadcast_grad():
+    x = nd.ones((2, 3))
+    bias = nd.zeros((3,))
+    bias.attach_grad()
+    with autograd.record():
+        y = (x + bias).sum()
+    y.backward()
+    assert np.allclose(bias.grad.asnumpy(), [2, 2, 2])
+
+
+def test_reused_variable():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_multiple_heads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = x * 3
+    autograd.backward([y, z])
+    assert np.allclose(x.grad.asnumpy(), [5.0, 5.0])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    f = Square()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_softmax_output_fused_grad():
+    data = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype="float32")
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    sm = out.asnumpy()
+    oh = np.eye(5)[[0, 1, 2, 3]]
+    assert np.allclose(data.grad.asnumpy(), sm - oh, atol=1e-5)
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert np.all(y.asnumpy() == 1.0)
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_partial_multioutput_backward():
+    """Only one output of a multi-output op feeds the loss."""
+    x = nd.array(np.arange(8, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.split(x, num_outputs=2, axis=0)
+        loss = (a * 2).sum()
+    loss.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 2, 2, 2, 0, 0, 0, 0])
+
+
+def test_split_v2_grad():
+    x = nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split_v2(x, 3)
+        loss = parts[1].sum() * 5
+    loss.backward()
+    assert np.allclose(x.grad.asnumpy(), [0, 0, 5, 5, 0, 0])
+
+
+def test_inplace_under_record_raises():
+    """reference semantics: in-place ops on tape-involved arrays while
+    recording raise."""
+    import mxnet_tpu as mx
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError):
+            y += 1
+        with pytest.raises(mx.MXNetError):
+            x += 1  # x was consumed by the mul
+    # outside recording both are fine
+    y += 1
+    x += 1
+
+
+def test_grad_does_not_clobber_backward_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    saved = x.grad.asnumpy().copy()
+    with autograd.record():
+        z = x * 5
+    g = autograd.grad(z, x)
+    assert np.allclose(g.asnumpy(), [5.0])
+    assert np.allclose(x.grad.asnumpy(), saved)  # untouched
+
+
+def test_dropout_mode_always():
+    x = nd.ones((64, 64))
+    y = nd.Dropout(x, p=0.5, mode="always")  # outside any train scope
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7

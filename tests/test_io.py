@@ -1,0 +1,56 @@
+"""IO: NDArrayIter + .params serde (reference: tests/python/unittest/test_io.py)."""
+import os
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_ndarray_iter():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    # discard mode
+    it2 = mx.io.NDArrayIter(data, label, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    # shuffle determinism by seed
+    a = [b.data[0].asnumpy() for b in mx.io.NDArrayIter(data, label, 5, shuffle=True, shuffle_seed=3)]
+    b = [b.data[0].asnumpy() for b in mx.io.NDArrayIter(data, label, 5, shuffle=True, shuffle_seed=3)]
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def test_params_save_load_roundtrip(tmp_path):
+    fname = str(tmp_path / "test.params")
+    d = {"arg:weight": nd.array(np.random.rand(3, 4)),
+         "aux:mean": nd.ones((5,), dtype="int32"),
+         "b16": nd.ones((2, 2), dtype="float16")}
+    nd.save(fname, d)
+    back = nd.load(fname)
+    assert set(back) == set(d)
+    for k in d:
+        assert back[k].dtype == d[k].dtype
+        assert np.array_equal(back[k].asnumpy(), d[k].asnumpy())
+
+
+def test_params_save_load_list(tmp_path):
+    fname = str(tmp_path / "list.params")
+    nd.save(fname, [nd.ones((2,)), nd.zeros((3,))])
+    back = nd.load(fname)
+    assert isinstance(back, list) and len(back) == 2
+    assert np.array_equal(back[0].asnumpy(), [1, 1])
+
+
+def test_prefetching_iter():
+    data = np.arange(24).reshape(12, 2).astype(np.float32)
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(data, None, batch_size=4))
+    n = 0
+    for batch in it:
+        n += 1
+        assert batch.data[0].shape == (4, 2)
+    assert n == 3

@@ -1,0 +1,224 @@
+"""NDArray basics (reference suite: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+    z = nd.zeros((3, 4))
+    assert z.shape == (3, 4) and z.asnumpy().sum() == 0
+    o = nd.ones((2, 3), dtype="int32")
+    assert o.dtype == np.int32
+    f = nd.full((2, 2), 7.0)
+    assert np.all(f.asnumpy() == 7)
+    r = nd.arange(0, 10, 2)
+    assert np.allclose(r.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_default_float32():
+    a = nd.array(np.zeros((2, 2), dtype=np.float64))
+    assert a.dtype == np.float32  # MXNet's default-dtype semantics
+
+
+def test_arith():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert np.allclose((a + b).asnumpy(), [5, 7, 9])
+    assert np.allclose((a - b).asnumpy(), [-3, -3, -3])
+    assert np.allclose((a * b).asnumpy(), [4, 10, 18])
+    assert np.allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert np.allclose((a + 1).asnumpy(), [2, 3, 4])
+    assert np.allclose((2 * a).asnumpy(), [2, 4, 6])
+    assert np.allclose((1 - a).asnumpy(), [0, -1, -2])
+    assert np.allclose((a ** 2).asnumpy(), [1, 4, 9])
+    assert np.allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_inplace_arith():
+    a = nd.ones((2, 2))
+    a += 1
+    assert np.all(a.asnumpy() == 2)
+    a *= 3
+    assert np.all(a.asnumpy() == 6)
+    a /= 2
+    assert np.all(a.asnumpy() == 3)
+    a -= 1
+    assert np.all(a.asnumpy() == 2)
+
+
+def test_view_aliasing():
+    """Writes through base and view must be mutually visible (reference:
+    zero-copy NDArray::Slice)."""
+    a = nd.zeros((4, 4))
+    v = a[1:3]
+    a[1:3] = 5.0
+    assert np.all(v.asnumpy() == 5.0)
+    v[:] = 7.0
+    assert np.all(a.asnumpy()[1:3] == 7.0)
+    assert np.all(a.asnumpy()[0] == 0.0)
+    # view of view
+    vv = v[0]
+    vv[:] = 9.0
+    assert np.all(a.asnumpy()[1] == 9.0)
+
+
+def test_setitem_getitem():
+    a = nd.zeros((3, 3))
+    a[1, 2] = 4.0
+    assert a.asnumpy()[1, 2] == 4.0
+    a[:] = 1.0
+    assert np.all(a.asnumpy() == 1.0)
+    b = a[2]
+    assert b.shape == (3,)
+    idx = nd.array([0, 2], dtype="int32")
+    picked = a[idx]          # advanced indexing → copy
+    assert picked.shape == (2, 3)
+
+
+def test_reshape_transpose():
+    a = nd.arange(0, 12).reshape((3, 4))
+    assert a.shape == (3, 4)
+    assert a.T.shape == (4, 3)
+    assert a.reshape((2, 6)).shape == (2, 6)
+    assert a.reshape((-1,)).shape == (12,)
+    assert a.reshape((0, -1)).shape == (3, 4)   # MXNet reshape code 0
+    assert a.reshape((-3,)).shape == (12,)      # merge two dims
+    assert nd.transpose(a, axes=(1, 0)).shape == (4, 3)
+    assert a.flatten().shape == (3, 4)
+    assert a.expand_dims(0).shape == (1, 3, 4)
+    assert a.reshape((3, 4, 1)).squeeze(axis=2).shape == (3, 4)
+
+
+def test_reductions():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().asscalar() == 10
+    assert np.allclose(a.sum(axis=0).asnumpy(), [4, 6])
+    assert np.allclose(a.mean(axis=1).asnumpy(), [1.5, 3.5])
+    assert a.max().asscalar() == 4
+    assert a.min().asscalar() == 1
+    assert a.prod().asscalar() == 24
+    assert np.allclose(nd.sum(a, axis=0, exclude=True).asnumpy(), [3, 7])
+    assert a.argmax(axis=1).asnumpy().tolist() == [1, 1]
+    assert a.norm().asscalar() == pytest.approx(np.sqrt(30), rel=1e-5)
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4))
+    b = nd.array(np.random.rand(4, 5))
+    c = nd.dot(a, b)
+    assert c.shape == (3, 5)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    # transpose flags
+    d = nd.dot(a, b.T, transpose_b=True)
+    assert np.allclose(d.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    # batch_dot
+    x = nd.array(np.random.rand(2, 3, 4))
+    y = nd.array(np.random.rand(2, 4, 5))
+    z = nd.batch_dot(x, y)
+    assert z.shape == (2, 3, 5)
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_comparison_where_clip():
+    a = nd.array([1.0, 5.0, 3.0])
+    b = nd.array([2.0, 2.0, 3.0])
+    assert (a > b).asnumpy().tolist() == [0, 1, 0]
+    assert (a == b).asnumpy().tolist() == [0, 0, 1]
+    w = nd.where(a > b, a, b)
+    assert w.asnumpy().tolist() == [2, 5, 3]
+    assert a.clip(2, 4).asnumpy().tolist() == [2, 4, 3]
+
+
+def test_copy_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    b = a.copy()
+    b[:] = 3
+    assert np.all(a.asnumpy() == 1)
+    c = nd.zeros((2, 2))
+    a.copyto(c)
+    assert np.all(c.asnumpy() == 1)
+    d = a.as_in_context(mx.cpu())
+    assert d is a
+    assert a.context.device_type == "cpu"
+
+
+def test_astype():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    assert a.astype(np.float16).dtype == np.float16
+
+
+def test_take_pick_onehot():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    t = nd.take(a, nd.array([0, 2], dtype="int32"))
+    assert np.allclose(t.asnumpy(), [[1, 2], [5, 6]])
+    p = nd.pick(a, nd.array([0, 1, 0]), axis=1)
+    assert p.asnumpy().tolist() == [1, 4, 5]
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), 3)
+    assert np.allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_wait_and_sync():
+    a = nd.ones((100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 100
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == 3.5
+    assert int(nd.array([7], dtype="int32").asscalar()) == 7
+    with pytest.raises(ValueError):
+        nd.ones((2, 2)).asscalar()
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0]])
+    idx = nd.topk(a, k=2)
+    assert idx.asnumpy()[0].tolist() == [0, 2]
+    both = nd.topk(a, k=2, ret_typ="both")
+    assert np.allclose(both[0].asnumpy()[0], [3, 2])
+    assert nd.sort(a).asnumpy()[0].tolist() == [1, 2, 3]
+    assert nd.argsort(a).asnumpy()[0].tolist() == [1, 2, 0]
+
+
+def test_elemwise_unary():
+    a = nd.array([1.0, 4.0, 9.0])
+    assert np.allclose(nd.sqrt(a).asnumpy(), [1, 2, 3])
+    assert np.allclose(nd.square(a).asnumpy(), [1, 16, 81])
+    assert np.allclose(nd.exp(nd.zeros((2,))).asnumpy(), [1, 1])
+    assert np.allclose(nd.log(a).asnumpy(), np.log([1, 4, 9]), atol=1e-6)
+    assert np.allclose(nd.relu(nd.array([-1.0, 2.0])).asnumpy(), [0, 2])
+    s = nd.sigmoid(nd.zeros((1,)))
+    assert s.asnumpy()[0] == pytest.approx(0.5)
+
+
+def test_broadcasting():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    c = nd.broadcast_to(nd.ones((1, 3)), shape=(5, 3))
+    assert c.shape == (5, 3)
+    d = nd.broadcast_axis(nd.ones((1, 3)), axis=0, size=4)
+    assert d.shape == (4, 3)
