@@ -18,3 +18,14 @@ from . import ndarray as nd
 from . import autograd
 from . import random
 from . import io
+from . import name
+from . import symbol
+from . import symbol as sym
+from . import initializer
+from . import initializer as init
+from . import lr_scheduler
+from . import optimizer
+from . import optimizer as opt
+from . import kvstore
+from . import kvstore as kv
+from . import gluon

@@ -1,0 +1,14 @@
+"""Gluon: the imperative neural-network API.
+reference: python/mxnet/gluon/__init__.py."""
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import (Constant, DeferredInitializationError, Parameter,
+                        ParameterDict)
+from . import nn
+from . import loss
+from . import utils
+from .trainer import Trainer
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Constant",
+           "DeferredInitializationError", "Parameter", "ParameterDict",
+           "Trainer", "nn", "loss", "utils"]

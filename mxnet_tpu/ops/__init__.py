@@ -9,3 +9,4 @@ from .registry import register, alias, get, list_ops
 from . import tensor      # noqa: F401  elementwise/broadcast/reduce/shape
 from . import nn          # noqa: F401  FC/conv/pool/norm/softmax/dropout
 from . import random_ops  # noqa: F401  sampling ops
+from . import optimizer_ops  # noqa: F401  sgd/adam/... update kernels

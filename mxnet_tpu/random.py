@@ -38,8 +38,27 @@ def seed(seed_state, ctx="all"):
         _table()[key] = jax.random.key(int(seed_state))
 
 
+def push_trace_key(key):
+    """Enter a functional-RNG scope: while active, `take_key` splits from
+    `key` (a traced jax key) instead of the global table. Used by CachedOp /
+    hybridize so random ops inside a jit trace consume a per-call key input
+    rather than baking a constant (reference analog: per-op kRandom resource
+    requests, src/resource.cc)."""
+    if not hasattr(_state, "trace_keys"):
+        _state.trace_keys = []
+    _state.trace_keys.append(key)
+
+
+def pop_trace_key():
+    return _state.trace_keys.pop()
+
+
 def take_key(ctx=None):
     """Split the current key and return a fresh subkey (advances state)."""
+    if getattr(_state, "trace_keys", None):
+        k0, k1 = jax.random.split(_state.trace_keys[-1])
+        _state.trace_keys[-1] = k0
+        return k1
     tbl = _table()
     key = None if ctx is None else (ctx.device_type, ctx.device_id)
     if key not in tbl:
@@ -57,6 +76,30 @@ def take_key(ctx=None):
 def fold_in(data):
     """Deterministically derive a key from current state + integer data."""
     return jax.random.fold_in(take_key(), int(data))
+
+
+def _nd_random(op):
+    def fn(*args, **kwargs):
+        from . import ndarray as _nd
+        return _nd.invoke(op, *args, **kwargs)
+    fn.__name__ = op.lstrip("_")
+    return fn
+
+
+# sampling entry points (reference: python/mxnet/random.py delegates to
+# mx.nd.random.*)
+uniform = _nd_random("_random_uniform")
+normal = _nd_random("_random_normal")
+randn = _nd_random("_random_normal")
+randint = _nd_random("_random_randint")
+gamma = _nd_random("_random_gamma")
+exponential = _nd_random("_random_exponential")
+poisson = _nd_random("_random_poisson")
+negative_binomial = _nd_random("_random_negative_binomial")
+generalized_negative_binomial = _nd_random(
+    "_random_generalized_negative_binomial")
+multinomial = _nd_random("_sample_multinomial")
+shuffle = _nd_random("_shuffle")
 
 
 class Generator:

@@ -1,0 +1,24 @@
+"""`mx.sym` — the symbolic namespace, codegen'd from the shared op registry.
+reference: python/mxnet/symbol/__init__.py."""
+import sys as _sys
+import types as _types
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json, populate,
+                     zeros, ones, arange)
+from .executor import Executor
+
+populate(globals())
+
+# mx.sym.random.* sub-namespace (reference: python/mxnet/symbol/random.py)
+from .symbol import _make_op as _mk  # noqa: E402
+random = _types.ModuleType(__name__ + ".random")
+for _pub, _src in [("uniform", "_random_uniform"),
+                   ("normal", "_random_normal"),
+                   ("randint", "_random_randint"),
+                   ("gamma", "_random_gamma"),
+                   ("exponential", "_random_exponential"),
+                   ("poisson", "_random_poisson"),
+                   ("multinomial", "_sample_multinomial"),
+                   ("shuffle", "_shuffle")]:
+    setattr(random, _pub, _mk(_src))
+_sys.modules[random.__name__] = random

@@ -1,0 +1,289 @@
+"""Gluon core tests — modeled on reference tests/python/unittest/test_gluon.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data(mx.cpu(0)).context == mx.cpu(0)
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.var().name == "weight"
+    assert p.grad(mx.cpu(0)).stype == "default"
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+    with pytest.raises(RuntimeError):
+        p.list_data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_")
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    outputs = model(inputs)
+    assert {p.name for p in model.collect_params().values()} == \
+        {"test_weight", "test_bias"}
+    assert outputs.shape == (2, 3, 128)
+
+    model = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                     prefix="test2_")
+    inputs = mx.nd.zeros((17, 2, 5, 3))
+    model.initialize()
+    outputs = model(inputs)
+    assert outputs.shape == (17, 128)
+
+
+def test_dense_deferred_shape():
+    model = nn.Dense(4)
+    model.initialize()
+    out = model(mx.nd.ones((3, 7)))
+    assert out.shape == (3, 4)
+    assert model.weight.shape == (4, 7)
+
+
+def test_sequential_training():
+    """MLP trains end-to-end: loss decreases (the SURVEY §7 config-1 slice)."""
+    np.random.seed(0)
+    x = np.random.normal(size=(64, 10)).astype("float32")
+    w = np.random.normal(size=(10, 1)).astype("float32")
+    y = (x @ w > 0).astype("float32").reshape(-1)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"),
+                nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    data, label = mx.nd.array(x), mx.nd.array(y)
+
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            out = net(data)
+            loss = loss_fn(out, label)
+        loss.backward()
+        trainer.step(64)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.normal(size=(4, 5)).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call goes through compiled cache
+    hybrid2 = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_matches_eager():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+        return net
+
+    x = mx.nd.array(np.random.normal(size=(4, 5)).astype("float32"))
+
+    net = build()
+    net.initialize()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    eager_grads = {k: v.grad().asnumpy()
+                   for k, v in net.collect_params().items()}
+
+    net.hybridize()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    for k, v in net.collect_params().items():
+        np.testing.assert_allclose(eager_grads[k], v.grad().asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_moving_stats():
+    layer = nn.BatchNorm(in_channels=4)
+    layer.initialize()
+    x = mx.nd.array(np.random.normal(2.0, 3.0, size=(8, 4, 3, 3))
+                    .astype("float32"))
+    with autograd.record():
+        layer(x)
+    rm = layer.running_mean.data().asnumpy()
+    assert not np.allclose(rm, np.zeros(4)), "moving mean should update"
+    # predict mode uses moving stats, output differs from train mode
+    out_pred = layer(x).asnumpy()
+    assert out_pred.shape == x.shape
+
+
+def test_batchnorm_moving_stats_hybridized():
+    layer = nn.BatchNorm(in_channels=4)
+    layer.initialize()
+    layer.hybridize()
+    x = mx.nd.array(np.random.normal(1.0, 2.0, size=(8, 4))
+                    .astype("float32"))
+    with autograd.record():
+        layer(x)
+    rm = layer.running_mean.data().asnumpy()
+    assert not np.allclose(rm, np.zeros(4)), \
+        "moving mean should update through the jit trace"
+
+
+def test_conv_layers():
+    layer = nn.Conv2D(16, (3, 3), in_channels=4)
+    layer.initialize()
+    x = mx.nd.ones((2, 4, 10, 10))
+    assert layer(x).shape == (2, 16, 8, 8)
+
+    layer = nn.Conv2D(16, (3, 3), padding=(1, 1), strides=(2, 2))
+    layer.initialize()
+    assert layer(x).shape == (2, 16, 5, 5)
+
+    layer = nn.MaxPool2D((2, 2), strides=(2, 2))
+    assert layer(x).shape == (2, 4, 5, 5)
+
+    layer = nn.GlobalAvgPool2D()
+    assert layer(x).shape == (2, 4, 1, 1)
+
+    layer = nn.Conv2DTranspose(8, (2, 2), strides=(2, 2), in_channels=4)
+    layer.initialize()
+    assert layer(x).shape == (2, 8, 20, 20)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=5), nn.Dense(3, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=5), nn.Dense(3, in_units=8))
+    net2.load_parameters(f)
+    x = mx.nd.ones((2, 5))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(),
+                               rtol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 4))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.1})
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+
+
+def test_optimizers_decrease_loss():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "nag", "adadelta",
+                 "adamax", "nadam", "ftrl", "signum", "ftml", "lamb",
+                 "adamw"]:
+        net = nn.Dense(1, in_units=3)
+        net.initialize(mx.init.Normal(0.5))
+        if name == "adadelta":
+            opt_params = {}
+        elif name in ("adamax", "nadam", "signum"):
+            opt_params = {"learning_rate": 0.01}
+        else:
+            opt_params = {"learning_rate": 0.05}
+        tr = gluon.Trainer(net.collect_params(), name, opt_params)
+        x = mx.nd.array(np.random.normal(size=(16, 3)).astype("float32"))
+        y = mx.nd.array(np.ones((16, 1), dtype="float32"))
+        l2 = gluon.loss.L2Loss()
+        first = None
+        for _ in range(10):
+            with autograd.record():
+                loss = l2(net(x), y)
+            loss.backward()
+            tr.step(16)
+            cur = float(loss.mean().asscalar())
+            if first is None:
+                first = cur
+        assert cur < first, "optimizer %s did not reduce loss" % name
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.Sequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary(mx.nd.ones((2, 3)))
+    out = capsys.readouterr().out
+    assert "Dense" in out and "Total params" in out
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.const = self.params.get_constant(
+                "const", np.ones((2, 2), dtype="float32"))
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    net = Net()
+    net.initialize()
+    out = net(mx.nd.zeros((2, 2)))
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 2)))
+    # constants take no gradient
+    assert net.const.grad_req == "null"
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import (FactorScheduler, PolyScheduler,
+                                        CosineScheduler,
+                                        MultiFactorScheduler)
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    s = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert abs(s(12) - 0.01) < 1e-9
+    s = PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(s(50) - 0.5) < 1e-6
+    s = CosineScheduler(max_update=100, base_lr=1.0)
+    assert abs(s(100)) < 1e-6
+    # warmup
+    s = FactorScheduler(step=100, base_lr=1.0, warmup_steps=10,
+                        warmup_begin_lr=0.0)
+    assert s(5) == 0.5
