@@ -29,3 +29,9 @@ from . import optimizer as opt
 from . import kvstore
 from . import kvstore as kv
 from . import gluon
+from . import metric
+from . import callback
+from . import model
+from . import module
+from . import module as mod
+from . import lr_scheduler as _lrs_alias  # noqa: F401

@@ -5,7 +5,9 @@ from .block import Block, HybridBlock, SymbolBlock
 from .parameter import (Constant, DeferredInitializationError, Parameter,
                         ParameterDict)
 from . import nn
+from . import rnn
 from . import loss
+from . import data
 from . import utils
 from .trainer import Trainer
 

@@ -1,0 +1,201 @@
+"""DataLoader: mini-batch loading with worker processes.
+
+TPU-native analog of reference python/mxnet/gluon/data/dataloader.py. The
+reference forks workers that return batches through POSIX-shm `cpu_shared`
+NDArrays (src/storage/cpu_shared_storage_manager.h); here workers are a
+multiprocessing pool shipping numpy batches (pickled over pipes; the native
+C++ fast path lives in mxnet_tpu/native with shared-memory framing), and
+the final host→device transfer is PjRt's async H2D — the analog of the
+reference's pinned-memory prefetch.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import sys
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...context import Context, cpu
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Collate samples into a batch. reference: dataloader.py
+    (default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+def default_mp_batchify_fn(data):
+    """Worker-side collate (numpy; shipped to the main process).
+    reference: dataloader.py (default_mp_batchify_fn) — uses cpu_shared
+    NDArrays; the numpy path here serializes via pickle, the C++ native
+    loader uses shm."""
+    if isinstance(data[0], nd.NDArray):
+        return _np.stack([d.asnumpy() for d in data], axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    return _np.asarray(data)
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn):
+    global _worker_dataset
+    batch = batchify_fn([_worker_dataset[i] for i in samples])
+    return batch
+
+
+def _as_in_context(data, ctx):
+    if isinstance(data, nd.NDArray):
+        return data.as_in_context(ctx)
+    if isinstance(data, _np.ndarray):
+        return nd.array(data, ctx=ctx, dtype=data.dtype)
+    if isinstance(data, (list, tuple)):
+        return [_as_in_context(d, ctx) for d in data]
+    return data
+
+
+class DataLoader:
+    """reference: gluon/data/dataloader.py (DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        assert timeout > 0, "timeout must be positive"
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless " +
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None else
+                             2 * self._num_workers)
+        if batchify_fn is None:
+            if num_workers > 0:
+                self._batchify_fn = default_mp_batchify_fn
+            else:
+                self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.dummy import Pool as ThreadPool
+                self._pool = ThreadPool(self._num_workers,
+                                        initializer=_worker_initializer,
+                                        initargs=(self._dataset,))
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_initializer,
+                                      initargs=(self._dataset,))
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    ret = self._batchify_fn(
+                        [self._dataset[idx] for idx in batch])
+                    yield _as_in_context(ret, cpu())
+            return same_process_iter()
+        return _MultiWorkerIter(self._pool, self._batchify_fn,
+                                self._batch_sampler,
+                                prefetch=self._prefetch,
+                                timeout=self._timeout)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()
+
+
+class _MultiWorkerIter:
+    """Prefetching iterator over the worker pool.
+    reference: dataloader.py (_MultiWorkerIter)."""
+
+    def __init__(self, pool, batchify_fn, batch_sampler, prefetch=0,
+                 timeout=120):
+        self._pool = pool
+        self._batchify_fn = batchify_fn
+        self._batch_sampler = batch_sampler
+        self._data_buffer = {}
+        self._rcvd_idx = 0
+        self._sent_idx = 0
+        self._iter = iter(self._batch_sampler)
+        self._timeout = timeout
+        for _ in range(prefetch):
+            self._push_next()
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _push_next(self):
+        r = next(self._iter, None)
+        if r is None:
+            return
+        async_ret = self._pool.apply_async(_worker_fn,
+                                           (r, self._batchify_fn))
+        self._data_buffer[self._sent_idx] = async_ret
+        self._sent_idx += 1
+
+    def __next__(self):
+        self._push_next()
+        if self._rcvd_idx == self._sent_idx:
+            assert not self._data_buffer, \
+                "Data buffer should be empty at this moment"
+            raise StopIteration
+        assert self._rcvd_idx < self._sent_idx, \
+            "rcvd_idx must be smaller than sent_idx"
+        assert self._rcvd_idx in self._data_buffer, \
+            "fatal error in _push_next, rcvd_idx missing"
+        ret = self._data_buffer.pop(self._rcvd_idx)
+        batch = ret.get(self._timeout)
+        self._rcvd_idx += 1
+        return _as_in_context(batch, cpu())
+
+    def next(self):
+        return self.__next__()
+
+    def __iter__(self):
+        return self

@@ -10,3 +10,5 @@ from . import tensor      # noqa: F401  elementwise/broadcast/reduce/shape
 from . import nn          # noqa: F401  FC/conv/pool/norm/softmax/dropout
 from . import random_ops  # noqa: F401  sampling ops
 from . import optimizer_ops  # noqa: F401  sgd/adam/... update kernels
+from . import rnn_ops      # noqa: F401  fused RNN/LSTM/GRU via lax.scan
+from . import shape_hints  # noqa: F401  FInferShape-style param-shape hints

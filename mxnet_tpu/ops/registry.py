@@ -35,6 +35,9 @@ class Operator:
         self.num_outputs = num_outputs
         self.doc = doc or (fn.__doc__ if fn else None)
         self.tpu_fn = None            # optional Pallas/TPU-specialized impl
+        self.shape_hint = None        # fn(in_shapes, kwargs) -> in_shapes
+        #   fills unknown (None) input shapes from known ones — the forward
+        #   half of the reference's bidirectional FInferShape
 
     def tpu_impl(self, fn):
         """Register a TPU-specialized (Pallas) implementation.

@@ -206,6 +206,7 @@ class Symbol:
         return s
 
     def _compose_args(self, *args, **kwargs):
+        name = kwargs.pop("name", None)
         if args and kwargs:
             raise TypeError(
                 "compose only accept input Symbols either as positional or "
@@ -221,7 +222,10 @@ class Symbol:
                 if not isinstance(v, Symbol):
                     raise TypeError("Compose expect `Symbol` as arguments")
                 mapping[k] = v
-        return self._compose_with(mapping)
+        out = self._compose_with(mapping)
+        if name is not None:
+            out._name = name
+        return out
 
     def _compose_with(self, mapping):
         """Return a copy of the graph with variables substituted by name."""
@@ -305,28 +309,41 @@ class Symbol:
                             dtypes[n._name] = np_dtype(
                                 n._attrs.get("__dtype__", "float32"))
                             continue
-                    if partial:
-                        shapes[n._name] = None
-                        continue
-                    raise MXNetError(
-                        "cannot infer shape: argument %s has unknown shape"
-                        % n._name)
+                    # defer: may be filled by a consumer op's shape hint
+                    shapes[n._name] = None
             else:
                 in_shapes = []
                 ok = True
                 for i in n._inputs:
                     s_in = shapes.get(i._name)
-                    if s_in is None:
-                        ok = False
-                        break
                     if isinstance(s_in, list):
                         s_in = s_in[i._out_index or 0]
-                    elif i._out_index is not None and isinstance(s_in, list):
-                        s_in = s_in[i._out_index]
                     in_shapes.append((s_in, dtypes.get(i._name, _np.float32)))
+                if any(s is None for s, _ in in_shapes):
+                    # the forward half of the reference's bidirectional
+                    # FInferShape: fill parameter shapes from data shapes
+                    hint = _reg.get(n._op).shape_hint
+                    if hint is not None:
+                        filled = hint([s for s, _ in in_shapes], n._kwargs)
+                        for i, new_shape, (old, dt) in zip(
+                                n._inputs, filled, in_shapes):
+                            if old is None and new_shape is not None:
+                                shapes[i._name] = tuple(new_shape)
+                        in_shapes = [
+                            (shapes.get(i._name) if not isinstance(
+                                shapes.get(i._name), list) else
+                             shapes.get(i._name)[i._out_index or 0], dt)
+                            for i, (_, dt) in zip(n._inputs, in_shapes)]
+                    ok = all(s is not None for s, _ in in_shapes)
                 if not ok:
-                    shapes[n._name] = None
-                    continue
+                    if partial:
+                        shapes[n._name] = None
+                        continue
+                    missing = [i._name for i, (s, _) in
+                               zip(n._inputs, in_shapes) if s is None]
+                    raise MXNetError(
+                        "cannot infer shape: op %s (%s) has inputs with "
+                        "unknown shapes: %s" % (n._name, n._op, missing))
                 op = _reg.get(n._op)
                 abstract = [jax.ShapeDtypeStruct(s, d) for s, d in in_shapes]
                 kw = dict(n._kwargs)
@@ -715,27 +732,99 @@ def load(fname):
 # ---------------------------------------------------------------------------
 # op namespace codegen (reference: python/mxnet/symbol/register.py)
 # ---------------------------------------------------------------------------
+# Tensor-input parameter names recognized in op signatures. The reference
+# gets the tensor-argument list from NNVM op registration (ListArguments);
+# here it is derived from the registered fn's signature prefix.
+_TENSOR_PARAMS = frozenset([
+    "data", "weight", "bias", "gamma", "beta", "moving_mean", "moving_var",
+    "label", "lhs", "rhs", "parameters", "state", "state_cell", "grid",
+    "indices", "index", "condition", "x", "y", "a", "b", "positive",
+    "negative", "input1", "input2", "query", "key_arr", "value", "mean",
+    "var", "mom", "weight32", "grad", "loc", "rois", "anchors", "score"])
+
+
+def _op_tensor_slots(op):
+    """Ordered tensor-input slot names from the fn signature prefix; None
+    for variadic ops (*args)."""
+    import inspect
+    try:
+        sig = inspect.signature(op.fn)
+    except (ValueError, TypeError):
+        return None
+    slots = []
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return None
+        if p.name in _TENSOR_PARAMS:
+            slots.append(p.name)
+        else:
+            break
+    return slots
+
+
+def _auto_var_skip(op_name, slot, kwargs):
+    """Slots the reference's ListArguments omits conditionally."""
+    if slot == "bias" and kwargs.get("no_bias"):
+        return True
+    if op_name == "LeakyReLU" and slot == "gamma" and \
+            kwargs.get("act_type", "leaky") != "prelu":
+        return True
+    if op_name == "Deconvolution" and slot == "bias" and \
+            kwargs.get("no_bias", True):
+        return True
+    return False
+
+
 def _make_op(op_name):
     op = _reg.get(op_name)
+    slots = _op_tensor_slots(op)
 
     def sym_op(*args, name=None, attr=None, **kwargs):
-        inputs = []
+        sym_kwargs = {}
+        filled = {}
+        extras = []
+        pos_inputs = []
         for a in args:
             if isinstance(a, Symbol):
-                inputs.append(a)
+                pos_inputs.append(a)
             elif a is None:
-                continue
+                pos_inputs.append(None)
             else:
-                # scalar operand: keep as hyper-param via scalar-literal node
-                inputs.append(_scalar_const(a))
-        sym_kwargs = {}
+                pos_inputs.append(_scalar_const(a))
         for k, v in kwargs.items():
             if isinstance(v, Symbol):
-                inputs.append(v)
+                if slots and k in slots:
+                    filled[k] = v
+                else:
+                    extras.append(v)
             elif v is not None:
                 sym_kwargs[k] = v
         hint = op_name.lower().strip("_")
         name = NameManager.current.get(name, hint)
+
+        if slots is None or not slots:
+            inputs = [i for i in pos_inputs if i is not None] + extras
+        else:
+            # positional args fill slots in order; then auto-create the
+            # reference's auto-variables (`{name}_weight` etc.) for any
+            # remaining slot (reference: Symbol::Compose auto-var creation)
+            for i, a in enumerate(pos_inputs):
+                if a is not None and i < len(slots):
+                    filled.setdefault(slots[i], a)
+                elif a is not None:
+                    extras.append(a)
+            inputs = []
+            for slot in slots:
+                if slot in filled:
+                    inputs.append(filled[slot])
+                elif _auto_var_skip(op_name, slot, sym_kwargs):
+                    continue
+                else:
+                    v = Variable("%s_%s" % (name, slot))
+                    if slot in ("moving_mean", "moving_var"):
+                        v._attrs["__aux__"] = "True"
+                    inputs.append(v)
+            inputs.extend(extras)
         return Symbol(op_name, name, inputs, attrs=attr, kwargs=sym_kwargs,
                       num_outputs=op.num_outputs)
 
