@@ -29,6 +29,8 @@ from . import optimizer as opt
 from . import kvstore
 from . import kvstore as kv
 from . import gluon
+from . import recordio
+from . import image
 from . import metric
 from . import callback
 from . import model
