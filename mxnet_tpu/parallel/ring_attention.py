@@ -1,0 +1,95 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Not in the reference (SURVEY.md §5.7: its longest-sequence story is
+BucketingModule); this is the long-context capability the TPU build adds as
+first-class. The sequence axis is sharded over mesh axis `seq`; each device
+holds one Q/K/V chunk and K/V chunks rotate around the ring via
+`lax.ppermute` (lowering to ICI neighbor RDMA), overlapping the next
+transfer with the current block's attention. Online-softmax merging keeps
+memory O(S/n) per device, so max context scales linearly with ring size.
+
+Call inside shard_map/jit with the sequence axis sharded, e.g.::
+
+    f = shard_map(lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+                  mesh=mesh, in_specs=P(None, None, "seq", None), ...)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, mask, sm_scale):
+    """One Q-chunk x K-chunk block: returns (unnormalized out, m, l) in f32."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, -1e9)  # keep fully-masked rows finite
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
+    """Attention with K/V rotating around the `axis_name` ring.
+
+    q: (B, H, Sq/n, D); k, v: (B, Hkv, Sk/n, D) — the per-device shards.
+    GQA is handled by repeating K/V heads locally.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, step_idx):
+        acc, m_run, l_run, k_cur, v_cur = carry
+        # chunk index the current K/V block originated from
+        src = (my - step_idx) % n
+        # rotate early so transfer overlaps this block's compute
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        if causal:
+            qi = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0) + my * Sq
+            ki = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1) + src * Sk
+            mask = (ki <= qi)[None, None]
+        else:
+            mask = None
+        o, m_blk, l_blk = _block_attend(qf, k_cur.astype(jnp.float32),
+                                        v_cur, mask, sm_scale)
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc = acc * alpha + o * beta
+        l_new = l_run * alpha + l_blk * beta
+        return (acc, m_new, l_new, k_nxt, v_nxt), None
+
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    # constants enter the scan carry device-varying (they become varying
+    # through the masked block math) — mark them so under shard_map
+    try:
+        acc0, m0, l0 = (lax.pvary(t, (axis_name,)) for t in (acc0, m0, l0))
+    except AttributeError:
+        pass
+    (acc, _, l, _, _), _ = lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l).astype(q.dtype)
