@@ -1,0 +1,78 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+BASELINE.md: target >= 0.9x A100 per-chip throughput. A100 ResNet-50 train
+(fp16/AMP, batch 256) is ~2500 img/s, so vs_baseline is measured against
+0.9 * 2500 = 2250 img/s. Synthetic data, bf16, fused fwd+bwd+SGD step per
+the BASELINE.md measurement protocol (warm-up, then median-free steady-state
+mean over 50 steps).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.models.resnet import (CONFIGS, resnet_init, resnet_loss,
+                                     update_running_stats)
+
+BASELINE_IMG_S = 2250.0
+LR = 0.1
+MOMENTUM = 0.9
+
+
+def tmap(f, *t):
+    return jax.tree_util.tree_map(f, *t)
+
+
+def main():
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    cfg = CONFIGS["resnet50"] if on_accel else CONFIGS["resnet_tiny"]
+    batch = 256 if on_accel else 8
+    size = 224 if on_accel else 32
+    steps, warmup = (50, 10) if on_accel else (5, 2)
+
+    key = jax.random.PRNGKey(0)
+    params = resnet_init(key, cfg)
+    mom = tmap(jnp.zeros_like, params)
+    images = jax.random.normal(jax.random.PRNGKey(1),
+                               (batch, size, size, 3), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0,
+                                cfg.classes)
+    data = {"images": images, "labels": labels}
+
+    @jax.jit
+    def step(params, mom, data):
+        (loss, stats), grads = jax.value_and_grad(
+            resnet_loss, has_aux=True)(params, data, cfg)
+        mom = tmap(lambda m, g: MOMENTUM * m + g.astype(m.dtype), mom, grads)
+        params = tmap(lambda p, m: (p - LR * m.astype(p.dtype)).astype(p.dtype),
+                      params, mom)
+        params = update_running_stats(params, stats, cfg)
+        return params, mom, loss
+
+    for _ in range(warmup):
+        params, mom, loss = step(params, mom, data)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, mom, loss = step(params, mom, data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec" if on_accel
+                  else "resnet_tiny_cpu_img_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,4 +36,5 @@ from . import callback
 from . import model
 from . import module
 from . import module as mod
+from . import models
 from . import lr_scheduler as _lrs_alias  # noqa: F401

@@ -43,6 +43,15 @@ __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 _AUX_COLLECTOR = threading.local()
 
+# Active CachedOp trace (ctx of the traced device). While set, nested
+# hybridized children run unhybridized so they trace into the parent's graph
+# (reference: CachedOp inlines the whole subtree, cached_op.cc inline_limit).
+_TRACE_STATE = threading.local()
+
+
+def _trace_ctx():
+    return getattr(_TRACE_STATE, "ctx", None)
+
 
 def record_aux_update(aux_nd, new_raw):
     """Record a functional update to an auxiliary state (e.g. BatchNorm
@@ -476,13 +485,16 @@ class CachedOp:
             if not hasattr(_AUX_COLLECTOR, "stack"):
                 _AUX_COLLECTOR.stack = []
             _AUX_COLLECTOR.stack.append(aux_updates)
+            prev_trace = _trace_ctx()
+            _TRACE_STATE.ctx = self._trace_device
             try:
                 for p, raw in zip(param_nds, param_raws):
                     p._data, p._base, p._idx = raw, None, None
                 _random.push_trace_key(rng_key)
                 try:
                     with autograd.pause(train_mode=train):
-                        in_nds = [nd.from_jax(r) for r in input_raws]
+                        in_nds = [nd.from_jax(r, ctx=self._trace_device)
+                                  for r in input_raws]
                         args = _regroup(in_nds, fmt_holder[0])[0]
                         if not isinstance(args, (list, tuple)):
                             args = [args]
@@ -490,6 +502,7 @@ class CachedOp:
                 finally:
                     _random.pop_trace_key()
             finally:
+                _TRACE_STATE.ctx = prev_trace
                 _AUX_COLLECTOR.stack.pop()
                 for p, (d, b, i) in zip(param_nds, saved):
                     p._data, p._base, p._idx = d, b, i
@@ -514,6 +527,7 @@ class CachedOp:
                 break
         if ctx is None:
             ctx = current_context()
+        self._trace_device = ctx
         self._param_nds = [p.data(ctx) for p in block_params]
         param_raws = tuple(p._read() for p in self._param_nds)
         input_raws = tuple(a._read() for a in flat_args)
@@ -704,7 +718,7 @@ class HybridBlock(Block):
         if isinstance(x, nd.NDArray):
             self._cached_graph_inputs = [x.shape] + [
                 a.shape for a in args if isinstance(a, nd.NDArray)]
-            if self._active and not self._in_trace:
+            if self._active and not self._in_trace and _trace_ctx() is None:
                 # ensure params initialized (deferred shapes) by an eager
                 # pre-pass ONLY when some param is uninitialized
                 need_init = False
@@ -713,10 +727,14 @@ class HybridBlock(Block):
                         need_init = True
                         break
                 if need_init:
+                    # run the whole subtree unhybridized (suppress child
+                    # CachedOps too — they'd be throwaway compilations)
                     self._in_trace = True
+                    _TRACE_STATE.ctx = x.context
                     try:
                         self._forward_unhybridized(x, *args)
                     finally:
+                        _TRACE_STATE.ctx = None
                         self._in_trace = False
                 if self._cached_op is None:
                     self._cached_op = CachedOp(self, **{
