@@ -37,4 +37,8 @@ from . import model
 from . import module
 from . import module as mod
 from . import models
+from . import profiler
+from . import runtime
+from . import test_utils
+from . import contrib
 from . import lr_scheduler as _lrs_alias  # noqa: F401

@@ -1,0 +1,6 @@
+"""contrib namespace. reference: python/mxnet/contrib/ — AMP now;
+quantization/onnx are documented out-of-scope for the TPU build
+(SURVEY.md §2.1)."""
+from . import amp
+
+__all__ = ["amp"]

@@ -9,6 +9,7 @@ from . import rnn
 from . import loss
 from . import data
 from . import utils
+from . import model_zoo
 from .trainer import Trainer
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "Constant",

@@ -519,6 +519,19 @@ class CachedOp:
 
     def __call__(self, block_params, args):
         """block_params: list[Parameter]; args: forward inputs (nested)."""
+        from .. import profiler as _profiler
+        if _profiler._state == "run" and _profiler._config["profile_symbolic"]:
+            import time as _time
+            t0 = _time.perf_counter()
+            try:
+                return self._call_impl(block_params, args)
+            finally:
+                _profiler.record_op(
+                    "CachedOp:" + getattr(self._block, "name", "block"),
+                    _time.perf_counter() - t0)
+        return self._call_impl(block_params, args)
+
+    def _call_impl(self, block_params, args):
         flat_args, in_fmt = _flatten(args, "input")
         ctx = None
         for a in flat_args:

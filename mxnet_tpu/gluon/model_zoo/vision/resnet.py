@@ -266,9 +266,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=cpu(),
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        from ..model_store import get_model_file
-        net.load_parameters(get_model_file(
-            "resnet%d_v%d" % (num_layers, version), root=root), ctx=ctx)
+        raise ValueError(
+            "pretrained weights are unavailable in this offline build; "
+            "load parameters explicitly with load_parameters()")
     return net
 
 

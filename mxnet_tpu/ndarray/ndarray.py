@@ -509,7 +509,27 @@ def _wrap_out(raw, ctx):
     return NDArray(raw, ctx=ctx)
 
 
+# installed by mxnet_tpu.contrib.amp.init(); wraps op fns with dtype casts
+_AMP_WRAP = None
+# toggled by mxnet_tpu.profiler.set_state(); plain bool so the off-path
+# costs one global read per dispatch
+_PROFILE_IMPERATIVE = False
+
+
 def invoke(op_name, *args, out=None, **kwargs):
+    if _PROFILE_IMPERATIVE:
+        from .. import profiler as _profiler
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            return _invoke(op_name, *args, out=out, **kwargs)
+        finally:
+            # host dispatch time; device time comes from the jax trace layer
+            _profiler.record_op(op_name, _time.perf_counter() - t0)
+    return _invoke(op_name, *args, out=out, **kwargs)
+
+
+def _invoke(op_name, *args, out=None, **kwargs):
     op = _reg.get(op_name)
     from .. import autograd
 
@@ -535,6 +555,8 @@ def invoke(op_name, *args, out=None, **kwargs):
 
     on_tpu = ctx.device_type in ("gpu", "tpu")
     fn = op.best_fn(on_tpu)
+    if _AMP_WRAP is not None:
+        fn = _AMP_WRAP(fn, op_name)
 
     # reference records every op executed under record() (Imperative::RecordOp);
     # grads later flow only to marked variables, but unmarked ones can still be

@@ -1,0 +1,59 @@
+"""Model zoo construction + forward smoke. reference idiom:
+tests/python/unittest/test_gluon_model_zoo.py (build each model, run a
+small forward, check output shape)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import get_model
+
+# (name, input hw) — inception wants 299; squeezenet's fixed 13x13 avgpool
+# and densenet/vgg 7x7 pools want 224.
+FAST_MODELS = [
+    ("resnet18_v1", 224), ("resnet18_v2", 224),
+    ("mobilenet0.25", 224), ("mobilenetv2_0.25", 224),
+    ("squeezenet1.1", 224),
+]
+SLOW_MODELS = [
+    ("resnet50_v1", 224), ("vgg11", 224), ("vgg11_bn", 224),
+    ("alexnet", 224), ("densenet121", 224), ("inceptionv3", 299),
+    ("squeezenet1.0", 224), ("mobilenet1.0", 224),
+    ("mobilenetv2_1.0", 224),
+]
+
+
+@pytest.mark.parametrize("name,hw", FAST_MODELS)
+def test_model_forward(name, hw):
+    net = get_model(name, classes=10)
+    net.initialize()
+    x = nd.random_uniform(shape=(1, 3, hw, hw))
+    out = net(x)
+    assert out.shape == (1, 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+@pytest.mark.parametrize("name,hw", SLOW_MODELS)
+@pytest.mark.slow
+def test_model_forward_slow(name, hw):
+    net = get_model(name, classes=10)
+    net.initialize()
+    x = nd.random_uniform(shape=(1, 3, hw, hw))
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_get_model_unknown_raises():
+    with pytest.raises(ValueError):
+        get_model("resnet999_v9")
+
+
+def test_hybridize_and_export(tmp_path):
+    net = get_model("resnet18_v1", classes=10)
+    net.initialize()
+    net.hybridize()
+    x = nd.random_uniform(shape=(1, 3, 224, 224))
+    out1 = net(x)
+    out2 = net(x)  # cached path
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
