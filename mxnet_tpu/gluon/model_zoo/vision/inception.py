@@ -126,16 +126,6 @@ def _make_E(prefix):
     return _MixedE(prefix=prefix)
 
 
-def make_aux(classes):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.AvgPool2D(pool_size=5, strides=3))
-    out.add(_make_basic_conv(channels=128, kernel_size=1))
-    out.add(_make_basic_conv(channels=768, kernel_size=5))
-    out.add(nn.Flatten())
-    out.add(nn.Dense(classes))
-    return out
-
-
 class Inception3(HybridBlock):
     """reference: inception.py (Inception3)."""
 

@@ -1,4 +1,4 @@
 """`mx.io` — data loading (reference: python/mxnet/io/)."""
 from . import params_serde
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter)
+                 PrefetchingIter, LibSVMIter)

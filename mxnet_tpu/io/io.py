@@ -15,7 +15,7 @@ import numpy as _np
 from ..ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter"]
+           "PrefetchingIter", "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -265,3 +265,98 @@ class PrefetchingIter(DataIter):
 
     def iter_next(self):
         raise NotImplementedError
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator yielding CSR data batches. reference:
+    src/io/iter_libsvm.cc (LibSVMIter) — the input path of the sparse
+    linear/FM configs (BASELINE config #4). Format per line:
+    ``label idx:val idx:val ...`` (indices may be 0- or 1-based; pass
+    the feature dim via data_shape)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = (data_shape,) if isinstance(data_shape, int) \
+            else tuple(data_shape)
+        dim = self._data_shape[-1]
+        labels, rows_data, rows_idx = [], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                idx, val = [], []
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                rows_idx.append(idx)
+                rows_data.append(val)
+        if label_libsvm is not None:
+            # separate label file (reference: iter_libsvm.cc label_libsvm) —
+            # first token per line is the label; feature tokens are ignored
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        labels.append(float(parts[0]))
+            if len(labels) != len(rows_data):
+                raise ValueError(
+                    "label_libsvm has %d rows but data has %d"
+                    % (len(labels), len(rows_data)))
+        self._num = len(labels)
+        self._labels = _np.asarray(labels, dtype=_np.float32)
+        self._rows_idx = rows_idx
+        self._rows_data = rows_data
+        self._dim = dim
+        self.cursor = -batch_size
+        self.round_batch = round_batch
+        self.num_batches = (self._num + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._dim),
+                         _np.float32)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,), _np.float32)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self._num
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from ..ndarray import sparse as _sp
+        start = self.cursor
+        stop = min(start + self.batch_size, self._num)
+        sel = list(range(start, stop))
+        pad = self.batch_size - len(sel)
+        if pad and self.round_batch:
+            # wrap around (reference round_batch); modulo handles datasets
+            # smaller than one batch
+            sel += [i % self._num for i in range(pad)]
+        data_vals, col_idx, indptr = [], [], [0]
+        for i in sel:
+            data_vals.extend(self._rows_data[i])
+            col_idx.extend(self._rows_idx[i])
+            indptr.append(len(col_idx))
+        csr = _sp.csr_matrix(
+            (_np.asarray(data_vals, _np.float32),
+             _np.asarray(col_idx, _np.int32),
+             _np.asarray(indptr, _np.int32)),
+            shape=(len(sel), self._dim))
+        label = array(self._labels[sel])
+        # pad counts wrap rows so consumers (BaseModule.predict) can slice
+        # them off — same contract as NDArrayIter.getpad()
+        return DataBatch(data=[csr], label=[label],
+                         pad=pad if self.round_batch else 0)

@@ -146,11 +146,19 @@ class KVStoreLocal(KVStore):
                 raise MXNetError("key %s has not been initialized" % str(k))
 
     def _merge(self, vals):
-        """Sum device replicas (reference: CommDevice::Reduce)."""
+        """Sum device replicas (reference: CommDevice::Reduce). All-rsp
+        pushes stay row_sparse so the updater's lazy path applies
+        (reference: CommCPU::ReduceRowSparse)."""
+        from ..ndarray import sparse as _sp
         if isinstance(vals, nd.NDArray):
             return vals
         if len(vals) == 1:
             return vals[0]
+        if all(isinstance(v, _sp.RowSparseNDArray) for v in vals):
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = _sp.elemwise_add(acc, v)
+            return acc
         ctx = self._store_ctx_for(vals)
         acc = vals[0].as_in_context(ctx)._read()
         for v in vals[1:]:
@@ -214,9 +222,23 @@ class KVStoreLocal(KVStore):
             src = self._store[str(k)]
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
-                rows = r.astype("int32")
-                gathered = _sp.retain(src, rows)
-                gathered.copyto(t)
+                rows = r.data_jax.astype("int32") if isinstance(
+                    r, nd.NDArray) else _sp.jnp.asarray(r, dtype="int32")
+                # sorted unique ids: the RowSparseNDArray invariant that
+                # retain()'s searchsorted relies on
+                rows = _sp.jnp.unique(rows)
+                if isinstance(src, _sp.RowSparseNDArray):
+                    gathered = _sp.retain(src, rows)
+                    vals, idx = gathered._values, gathered._indices
+                else:  # dense-backed store: plain row gather
+                    vals, idx = src._read()[rows], rows
+                if not isinstance(t, _sp.RowSparseNDArray):
+                    raise ValueError(
+                        "row_sparse_pull requires row_sparse outs "
+                        "(reference kvstore restriction); got stype %s"
+                        % t.stype)
+                t._values = vals.astype(t.dtype)
+                t._indices = idx
 
 
 def create(name="local"):

@@ -226,33 +226,28 @@ def retain(arr, indices):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse dot (reference: dot.cc csr kernels). csr·dense via per-nnz
-    gather + segment-sum — the TPU-friendly formulation; falls back to a
-    densified matmul when nnz is large relative to the dense size."""
+    """Sparse dot (reference: dot.cc sparse kernels). csr.dense routes
+    through the registered `_sparse_dot_csr_dense` op (per-nnz gather +
+    segment-sum) so autograd records it -- gradients flow to the dense rhs,
+    which is what sparse linear models (BASELINE config #4 FM) train."""
+    from .ndarray import invoke
     if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise NotImplementedError("transpose_b with csr lhs")
         m, k = lhs.shape
         rows = jnp.searchsorted(
             lhs._indptr, jnp.arange(lhs._sp_data.shape[0]), side="right") - 1
-        if transpose_a:
-            # csr^T · dense → scatter-add into k rows
-            contrib = lhs._sp_data[:, None] * rhs.data_jax[rows]
-            out = jnp.zeros((k, rhs.shape[1]), dtype=contrib.dtype)
-            out = out.at[lhs._sp_indices].add(contrib)
-        else:
-            gathered = rhs.data_jax[lhs._sp_indices]        # (nnz, n)
-            contrib = lhs._sp_data[:, None] * gathered
-            out = jax.ops.segment_sum(contrib, rows, num_segments=m)
-        return NDArray(out, ctx=lhs._ctx)
+        return invoke("_sparse_dot_csr_dense",
+                      from_jax(lhs._sp_data, ctx=lhs._ctx),
+                      from_jax(lhs._sp_indices, ctx=lhs._ctx),
+                      from_jax(rows, ctx=lhs._ctx), rhs,
+                      m=m, k=k, transpose_a=transpose_a)
     if isinstance(lhs, RowSparseNDArray) and not isinstance(rhs, BaseSparseNDArray):
         if transpose_a:
-            contrib = jnp.einsum("nk,nj->kj", jnp.zeros(0), jnp.zeros(0)) \
-                if False else None
-            out = jnp.zeros((lhs.shape[1], rhs.shape[1]),
-                            dtype=lhs._values.dtype)
+            # rsp^T(m,k) . dense(m,n) -> only stored rows contribute
             vals = jnp.matmul(lhs._values.T, rhs.data_jax[lhs._indices])
             return NDArray(vals, ctx=lhs._ctx)
         return NDArray(jnp.matmul(lhs._read(), rhs.data_jax), ctx=lhs._ctx)
-    from .ndarray import invoke
     return invoke("dot", lhs, rhs, transpose_a=transpose_a,
                   transpose_b=transpose_b)
 

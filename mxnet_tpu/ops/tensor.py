@@ -679,3 +679,25 @@ def _eye(N=1, M=0, k=0, ctx=None, dtype="float32"):
 @register("logsumexp")
 def _logsumexp(x, axis=None, keepdims=False):
     return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels (reference: src/operator/tensor/dot.cc csr FComputeEx).
+# Raw-array ops so the autograd tape records them: cotangents flow to the
+# dense rhs (and to sp_data) through gather/segment_sum transposes — the
+# backward the reference hand-writes in dot_backward_csr.
+# ---------------------------------------------------------------------------
+@register("_sparse_dot_csr_dense", arity=4)
+def _sparse_dot_csr_dense(sp_data, sp_indices, rows, rhs, m=0, k=0,
+                          transpose_a=False):
+    """csr(m,k) · dense(k,n) (or csrᵀ · dense → (k,n)): per-nnz gather +
+    segment-sum, the TPU-friendly formulation (MXU-free but fuses well)."""
+    rows = rows.astype(jnp.int32)
+    cols = sp_indices.astype(jnp.int32)
+    if transpose_a:
+        contrib = sp_data[:, None] * rhs[rows]
+        out = jnp.zeros((int(k), rhs.shape[1]), dtype=contrib.dtype)
+        return out.at[cols].add(contrib)
+    gathered = rhs[cols]
+    contrib = sp_data[:, None] * gathered
+    return jax.ops.segment_sum(contrib, rows, num_segments=int(m))

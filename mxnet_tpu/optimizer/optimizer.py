@@ -35,8 +35,29 @@ def _run_op(name, *arrays, **kwargs):
     """Execute an optimizer op on NDArray payloads, writing results back
     in-place — the reference's out=weight convention. Every optimizer op
     takes (weight, grad, *states) and returns (weight, *states): the grad
-    input is read-only and produces no output."""
+    input is read-only and produces no output.
+
+    row_sparse grads with lazy_update=True take the lazy path (reference:
+    optimizer_op.cc rowsparse kernels): only rows present in grad.indices
+    are touched — momentum/history of absent rows is NOT decayed."""
+    from ..ndarray.sparse import RowSparseNDArray
     op = _reg.get(name)
+    grad = arrays[1] if len(arrays) > 1 else None
+    if isinstance(grad, RowSparseNDArray) and kwargs.get("lazy_update") \
+            and grad._indices.shape[0] < grad.shape[0]:
+        idx = grad._indices
+        w_full = arrays[0]._read()
+        state_fulls = [a._read() for a in arrays[2:]]
+        row_args = [w_full[idx], grad._values] + [s[idx] for s in state_fulls]
+        out = op.fn(*row_args, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+        targets = [arrays[0]] + list(arrays[2:])
+        fulls = [w_full] + state_fulls
+        assert len(targets) == len(out)
+        for target, full, new in zip(targets, fulls, out):
+            target._write(full.at[idx].set(new.astype(full.dtype)))
+        return
     raws = [a._read() for a in arrays]
     out = op.fn(*raws, **kwargs)
     if not isinstance(out, tuple):

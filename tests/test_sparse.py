@@ -1,0 +1,214 @@
+"""Sparse storage + ops + kvstore + FM end-to-end.
+
+reference idioms: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py, test_kvstore.py (rowsparse), and the FM training
+config (BASELINE config #4, example/sparse/factorization_machine).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _rand_csr(m, n, density=0.3):
+    dense = np.random.rand(m, n) * (np.random.rand(m, n) < density)
+    return sp.csr_matrix(dense.astype(np.float32)), dense.astype(np.float32)
+
+
+def test_rsp_roundtrip_and_retain():
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rsp = sp.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(rsp.tostype("default").asnumpy(), dense)
+    kept = sp.retain(rsp, np.array([0, 4]))
+    out = kept.tostype("default").asnumpy()
+    np.testing.assert_allclose(out[4], dense[4])
+    assert out[1].sum() == 0
+
+
+def test_csr_roundtrip():
+    csr, dense = _rand_csr(5, 7)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.tostype("default").asnumpy(), dense,
+                               rtol=1e-6)
+    back = csr.tostype("default").tostype("csr")
+    np.testing.assert_allclose(back.tostype("default").asnumpy(), dense,
+                               rtol=1e-6)
+
+
+def test_csr_dot_forward_backward():
+    csr, dense = _rand_csr(4, 6)
+    w = nd.array(np.random.rand(6, 3).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        out = sp.dot(csr, w)
+        loss = nd.sum(out)
+    loss.backward()
+    np.testing.assert_allclose(out.asnumpy(), dense @ w.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    # d(sum(X@W))/dW = X^T @ ones
+    expect = dense.T @ np.ones((4, 3), np.float32)
+    np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_csr_dot_transpose():
+    csr, dense = _rand_csr(4, 6)
+    rhs = nd.array(np.random.rand(4, 2).astype(np.float32))
+    out = sp.dot(csr, rhs, transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), dense.T @ rhs.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rsp_elemwise_add():
+    a = sp.row_sparse_array(np.diag([1., 0, 2, 0]).astype(np.float32))
+    b = sp.row_sparse_array(np.diag([0., 3, 4, 0]).astype(np.float32))
+    out = sp.elemwise_add(a, b)
+    np.testing.assert_allclose(out.tostype("default").asnumpy(),
+                               np.diag([1., 3, 6, 0]))
+
+
+def test_lazy_sgd_momentum_untouched_rows():
+    """reference rowsparse sgd_mom semantics: momentum of rows absent from
+    the grad must NOT decay."""
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, momentum=0.5,
+                              rescale_grad=1.0)
+    w = nd.array(np.ones((4, 2), np.float32))
+    mom = opt.create_state(0, w)
+    mom[:] = nd.array(np.full((4, 2), 10.0, np.float32))
+    grad = sp.RowSparseNDArray(
+        sp.jnp.asarray(np.full((1, 2), 1.0, np.float32)),
+        sp.jnp.asarray(np.array([2], np.int32)), (4, 2))
+    before = w.asnumpy().copy()
+    opt.update(0, w, grad, mom)
+    after = w.asnumpy()
+    momn = mom.asnumpy()
+    # untouched rows: no weight change, momentum untouched
+    np.testing.assert_array_equal(after[0], before[0])
+    np.testing.assert_array_equal(momn[0], 10.0 * np.ones(2))
+    # touched row moved and its momentum decayed
+    assert not np.allclose(after[2], before[2])
+    assert not np.allclose(momn[2], 10.0)
+
+
+def test_kvstore_rowsparse_push_pull():
+    kv = mx.kv.create("local")
+    weight = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+    kv.init(3, weight)
+    # server-side optimizer (reference: set_optimizer → updater on server)
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5,
+                                         rescale_grad=1.0))
+    g1 = sp.row_sparse_array((np.ones((1, 2), np.float32), [1]), shape=(6, 2))
+    g2 = sp.row_sparse_array((np.ones((1, 2), np.float32), [1]), shape=(6, 2))
+    kv.push(3, [g1, g2])   # two "devices" push the same sparse row
+    out = sp.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull(3, out=out, row_ids=nd.array(np.array([1, 4])))
+    dense = out.tostype("default").asnumpy()
+    # row1 got -0.5*(1+1) = -1 applied: 2,3 -> 1,2
+    np.testing.assert_allclose(dense[1], [1.0, 2.0])
+    np.testing.assert_allclose(dense[4], [8.0, 9.0])  # untouched
+    assert dense[0].sum() == 0  # not pulled
+
+
+def test_libsvm_iter(tmp_path):
+    f = tmp_path / "train.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5 3:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=4, batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    dense = b0.data[0].tostype("default").asnumpy()
+    np.testing.assert_allclose(dense[0], [1.5, 0, 0, 2.0])
+    np.testing.assert_allclose(b0.label[0].asnumpy(), [1.0, 0.0])
+    # last batch wraps one row; pad reports it (NDArrayIter.getpad contract)
+    assert b0.pad == 0 and batches[1].pad == 1
+    # separate label file overrides column-0 labels
+    lf = tmp_path / "labels.libsvm"
+    lf.write_text("5\n6\n7\n")
+    it2 = mx.io.LibSVMIter(data_libsvm=str(f), label_libsvm=str(lf),
+                           data_shape=4, batch_size=3)
+    np.testing.assert_allclose(next(iter(it2)).label[0].asnumpy(),
+                               [5.0, 6.0, 7.0])
+
+
+def test_factorization_machine_end_to_end(tmp_path):
+    """FM on synthetic libsvm data: csr batches, autograd through sparse
+    dot, rowsparse grads pushed through a kvstore with server-side
+    optimizer, lazy updates. BASELINE config #4 in miniature."""
+    rng = np.random.RandomState(0)
+    dim, n_samples = 30, 200
+    w_true = rng.randn(dim).astype(np.float32)
+    lines = []
+    for _ in range(n_samples):
+        nnz = rng.randint(2, 6)
+        idx = sorted(rng.choice(dim, size=nnz, replace=False))
+        vals = rng.rand(nnz).astype(np.float32)
+        y = 1 if sum(w_true[i] * v for i, v in zip(idx, vals)) > 0 else 0
+        lines.append(str(y) + " " +
+                     " ".join("%d:%.4f" % (i, v) for i, v in zip(idx, vals)))
+    f = tmp_path / "fm.libsvm"
+    f.write_text("\n".join(lines) + "\n")
+
+    batch_size, k = 50, 4
+    w = nd.array(np.zeros((dim, 1), np.float32))
+    v = nd.array((rng.randn(dim, k) * 0.05).astype(np.float32))
+    b = nd.array(np.zeros((1,), np.float32))
+    for p in (w, v, b):
+        p.attach_grad()
+
+    kv = mx.kv.create("local")
+    kv.init(0, w)
+    kv.init(1, v)
+    kv.set_optimizer(mx.optimizer.create("adagrad", learning_rate=0.5,
+                                         rescale_grad=1.0 / batch_size))
+
+    def forward(csr, csr_sq):
+        lin = sp.dot(csr, w)                              # (B,1)
+        xv = sp.dot(csr, v)                               # (B,k)
+        x2v2 = sp.dot(csr_sq, nd.square(v))               # (B,k)
+        pair = 0.5 * nd.sum(nd.square(xv) - x2v2, axis=1, keepdims=True)
+        return lin + pair + b
+
+    losses = []
+    for epoch in range(10):
+        it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=dim,
+                              batch_size=batch_size)
+        total, count = 0.0, 0
+        for batch in it:
+            csr = batch.data[0]
+            sq = sp.CSRNDArray(csr._sp_data * csr._sp_data,
+                               csr._sp_indices, csr._indptr, csr.shape)
+            y = batch.label[0].reshape((-1, 1))
+            with autograd.record():
+                out = forward(csr, sq)
+                # logistic loss
+                loss = nd.mean(nd.log(1 + nd.exp(-(2 * y - 1) * out)))
+            loss.backward()
+            # communicate sparse: only rows this batch touched
+            touched = np.unique(np.asarray(csr._sp_indices))
+            rows = sp.jnp.asarray(touched.astype(np.int32))
+            gw = sp.RowSparseNDArray(w.grad._read()[rows] * batch_size,
+                                     rows, w.shape)
+            gv = sp.RowSparseNDArray(v.grad._read()[rows] * batch_size,
+                                     rows, v.shape)
+            kv.push(0, gw)
+            kv.push(1, gv)
+            # pull only touched rows back into the local dense replicas
+            # (reference: Parameter.row_sparse_data path)
+            for key, param in ((0, w), (1, v)):
+                tmp = sp.zeros("row_sparse", param.shape)
+                kv.row_sparse_pull(key, out=tmp, row_ids=nd.array(touched))
+                param._write(param._read().at[tmp._indices].set(tmp._values))
+            b -= 0.1 * b.grad
+            for p in (w, v, b):
+                p.grad[:] = 0
+            total += float(loss.asnumpy())
+            count += 1
+        losses.append(total / count)
+    assert losses[-1] < 0.55 * losses[0], losses
