@@ -80,13 +80,17 @@ class Context:
 
 
 def _accel_devices():
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs if devs else jax.devices()
+    # local (addressable) devices only: under the multi-controller runtime
+    # each process owns its slice of the pod; committing data to another
+    # process's device is invalid (reference analog: a worker only touches
+    # its own GPUs)
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return devs if devs else jax.local_devices()
 
 
 def _cpu_devices():
     try:
-        return jax.devices("cpu")
+        return jax.local_devices(backend="cpu")
     except RuntimeError:
         return []
 
@@ -98,7 +102,7 @@ def _resolve_device(device_type, device_id):
     devs = _cpu_devices()
     if devs:
         return devs[device_id % len(devs)]
-    return jax.devices()[0]
+    return jax.local_devices()[0]
 
 
 def cpu(device_id=0):
@@ -128,8 +132,9 @@ def tpu(device_id=0):
 
 
 def num_gpus():
-    """reference: python/mxnet/context.py (num_gpus). Counts accelerators."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    """reference: python/mxnet/context.py (num_gpus). Counts this process's
+    accelerators (local, like the reference's cudaGetDeviceCount)."""
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return len(devs)
 
 

@@ -1,0 +1,146 @@
+"""Distributed KVStore over the JAX multi-controller runtime.
+
+TPU-native rebuild of reference src/kvstore/kvstore_dist.h (KVStoreDist),
+kvstore_dist_server.h (KVStoreDistServer), and
+gradient_compression.cc/.cu — with the architecture SURVEY.md §5.8
+prescribes:
+
+* The ps-lite scheduler/server/worker topology collapses into SPMD: every
+  process is a worker on the global mesh; `jax.distributed.initialize`
+  (driven by the DMLC_* env protocol via parallel.dist) is the rendezvous.
+* `push` aggregates across (a) local device replicas (sum, as KVStoreLocal)
+  then (b) all workers — a cross-process allreduce riding ICI/DCN
+  collectives instead of ZMQ round-trips to server processes.
+* Server-side optimizer semantics (`set_optimizer` → updater runs where the
+  merged gradient lives) are preserved: every worker applies the identical
+  update to its replica of the store, which is bitwise-deterministic
+  because the merged gradient is identical after the allreduce (the reason
+  the reference needs servers — a single authoritative copy — does not
+  exist under SPMD).
+* `dist_async` has no SPMD analog (documented in SURVEY §2.3); it degrades
+  to sync with a warning rather than failing.
+* 2-bit gradient compression (reference: gradient_compression.cc) is a
+  worker-side quantize → allreduce → dequantize with error-feedback
+  residual, matching the reference's threshold scheme.
+
+rowsparse push/pull: merged sparsely per KVStoreLocal, then row-union
+allreduced densely over touched rows only.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..parallel import dist
+from .kvstore import KVStoreLocal
+
+__all__ = ["KVStoreDist"]
+
+
+class GradientCompression:
+    """2-bit threshold compression with error feedback. reference:
+    src/kvstore/gradient_compression.cc (GradientCompression, type 2bit):
+    values >= +threshold → +threshold, <= -threshold → -threshold, else 0;
+    the quantization error is carried into the next push."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def compress(self, key, arr):
+        t = self.threshold
+        res = self._residual.get(key)
+        if res is None:
+            res = jnp.zeros(arr.shape, arr.dtype)
+        acc = arr + res
+        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0)
+                      ).astype(arr.dtype)
+        self._residual[key] = acc - q
+        return q
+
+
+class KVStoreDist(KVStoreLocal):
+    """Types dist_sync / dist_device_sync / dist_async / dist (alias)."""
+
+    def __init__(self, type_name="dist_sync"):
+        super().__init__(type_name)
+        if "async" in type_name:
+            warnings.warn(
+                "dist_async has no SPMD analog; running synchronously "
+                "(reference parity note, SURVEY.md §2.3)")
+        dist.initialize()
+        self._gc = None
+
+    @property
+    def rank(self):
+        return dist.rank()
+
+    @property
+    def num_workers(self):
+        return dist.num_workers()
+
+    def set_gradient_compression(self, compression_params):
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype != "2bit":
+            raise ValueError("unsupported compression type %s" % ctype)
+        self._gc = GradientCompression(params.get("threshold", 0.5))
+        self._compression_params = params
+
+    # ------------------------------------------------------------------
+    def _allreduce(self, raw):
+        """Sum a host-local array across all workers (replicated result).
+        On a real pod this is one psum over ICI; in multi-process CPU tests
+        it rides the same pathway via process_allgather."""
+        if dist.num_workers() == 1:
+            return raw
+        from jax.experimental import multihost_utils
+        # host-local numpy in → fully-replicated global out (the gather
+        # itself is a jitted all_gather over the global mesh)
+        gathered = multihost_utils.process_allgather(_np.asarray(raw))
+        return jnp.sum(jnp.asarray(gathered), axis=0)
+
+    def push(self, key, value, priority=0):
+        from ..ndarray import sparse as _sp
+        from .kvstore import _key_list, _val_list
+        keys = _key_list(key)
+        values = _val_list(value, len(keys))
+        assert len(keys) == len(values), "key/value length mismatch"
+        self._check_keys(keys)
+        for k, v in zip(keys, values):
+            merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
+            k = str(k)
+            stored = self._store[k]
+            if isinstance(merged, _sp.RowSparseNDArray):
+                # union of touched rows across workers, dense over the union
+                local_rows = _np.zeros((merged.shape[0],), _np.bool_)
+                local_rows[_np.asarray(merged._indices)] = True
+                all_rows = _np.asarray(self._allreduce(
+                    jnp.asarray(local_rows, jnp.int32))) > 0
+                rows = jnp.asarray(_np.nonzero(all_rows)[0].astype(_np.int32))
+                dense_rows = merged._read()[rows]
+                summed = self._allreduce(dense_rows)
+                merged = _sp.RowSparseNDArray(summed, rows, merged.shape,
+                                              ctx=stored.context)
+            else:
+                raw = merged._read()
+                if self._gc is not None:
+                    raw = self._gc.compress(k, raw)
+                merged = nd.from_jax(self._allreduce(raw),
+                                     ctx=stored.context)
+            if self._updater is not None:
+                idx = int(k) if k.isdigit() else k
+                self._updater(idx, merged, stored)
+            else:
+                stored._write(merged.as_in_context(
+                    stored.context)._read().astype(stored.dtype))
+
+    def barrier(self):
+        nd.waitall()
+        if dist.num_workers() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kv_barrier")

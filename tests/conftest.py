@@ -36,3 +36,7 @@ def _seed_rng(request):
     np.random.seed(seed)
     request.node.user_properties.append(("mxnet_test_seed", seed))
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running (full-size model zoo / multi-process)")

@@ -1,0 +1,117 @@
+"""Multi-process distributed kvstore tests — no real cluster.
+
+reference idiom (SURVEY.md §4): tests/nightly/dist_sync_kvstore.py run via
+`tools/launch.py -n 3 --launcher local`; workers assert allreduced values.
+Here each worker is a CPU-platform process joined by jax.distributed.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse as sp
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+out = {}
+
+# dense push/pull with server-side optimizer
+kv.init(0, nd.array(np.zeros((4,), np.float32)))
+kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0,
+                                     rescale_grad=1.0))
+kv.push(0, nd.array(np.full((4,), float(rank + 1), np.float32)))
+dst = nd.array(np.zeros((4,), np.float32))
+kv.pull(0, out=dst)
+# sum over ranks of (rank+1) = nw*(nw+1)/2, sgd lr 1 → w = -sum
+out["dense"] = dst.asnumpy().tolist()
+
+# rowsparse: each worker touches its own row
+kv.init(1, nd.array(np.zeros((8, 2), np.float32)))
+g = sp.row_sparse_array((np.ones((1, 2), np.float32), [rank]), shape=(8, 2))
+kv.push(1, g)
+rs = sp.zeros("row_sparse", (8, 2))
+kv.row_sparse_pull(1, out=rs, row_ids=nd.array(np.arange(8)))
+out["rsp"] = rs.tostype("default").asnumpy().tolist()
+
+# gradient compression path
+kv2_key = 2
+kv.init(kv2_key, nd.array(np.zeros((3,), np.float32)))
+kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+kv.push(kv2_key, nd.array(np.array([1.0, -1.0, 0.1], np.float32)))
+c = nd.array(np.zeros((3,), np.float32))
+kv.pull(kv2_key, out=c)
+out["compressed"] = c.asnumpy().tolist()
+
+out["rank"] = rank
+out["nw"] = nw
+with open(os.environ["RESULT_FILE_PREFIX"] + str(rank) + ".json", "w") as f:
+    json.dump(out, f)
+"""
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_dist_sync_kvstore_local_launcher(tmp_path):
+    n = 2
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    env.update({
+        "RESULT_FILE_PREFIX": str(tmp_path / "result_"),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "--root-port", str(_free_port()),
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    results = []
+    for r in range(n):
+        with open(str(tmp_path / ("result_%d.json" % r))) as f:
+            results.append(json.load(f))
+    total = n * (n + 1) / 2
+    for res in results:
+        assert res["nw"] == n
+        # dense: sgd applied once to the allreduced grad
+        np.testing.assert_allclose(res["dense"], [-total] * 4)
+        # rowsparse: every worker's row got -1 (its own push, allreduced)
+        rsp = np.asarray(res["rsp"])
+        for r in range(n):
+            np.testing.assert_allclose(rsp[r], [-1.0, -1.0])
+        assert np.abs(rsp[n:]).sum() == 0
+        # compression: |0.1| < threshold quantized to 0, ±1 → ±0.5 per worker
+        np.testing.assert_allclose(res["compressed"],
+                                   [-0.5 * n, 0.5 * n, 0.0])
+
+
+def test_launch_tpu_emits_spec():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "tpu", "echo", "train"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "DMLC_WORKER_ID=0" in proc.stdout
+    assert "DMLC_WORKER_ID=1" in proc.stdout
