@@ -41,4 +41,5 @@ from . import profiler
 from . import runtime
 from . import test_utils
 from . import contrib
+from . import native
 from . import lr_scheduler as _lrs_alias  # noqa: F401

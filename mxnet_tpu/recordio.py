@@ -171,16 +171,71 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
-        if self.flag == "r" and os.path.isfile(self.idx_path):
-            with open(self.idx_path) as fin:
-                for line in fin.readlines():
-                    line = line.strip().split("\t")
-                    key = self.key_type(line[0])
-                    self.idx[key] = int(line[1])
-                    self.keys.append(key)
+        if self.flag == "r":
+            if os.path.isfile(self.idx_path):
+                with open(self.idx_path) as fin:
+                    for line in fin.readlines():
+                        line = line.strip().split("\t")
+                        key = self.key_type(line[0])
+                        self.idx[key] = int(line[1])
+                        self.keys.append(key)
+            else:
+                self.rebuild_index()
             self.fidx = None
         elif self.flag == "w":
             self.fidx = open(self.idx_path, "w")
+
+    # .rec files up to this size are indexed by the native whole-buffer
+    # scanner; larger ones stream header-by-header to bound memory
+    _NATIVE_INDEX_MAX_BYTES = 1 << 30
+
+    def rebuild_index(self, write=False):
+        """Scan the .rec and regenerate the key→offset index (the reference
+        requires a pre-built .idx; here a missing index is recovered by the
+        native framing scanner, with a streaming python fallback). Keys are
+        the record ordinals. write=True also persists the .idx file."""
+        from . import native
+        size = os.path.getsize(self.uri)
+        starts = None
+        if size <= self._NATIVE_INDEX_MAX_BYTES and native.available():
+            with open(self.uri, "rb") as f:
+                indexed = native.index_recordio_buffer(f.read())
+            if indexed is not None:
+                starts = indexed[0].tolist()
+        if starts is None:
+            # streaming scan: headers only, payloads seeked over — bounded
+            # memory for arbitrarily large files. Same logical-record and
+            # truncated-tail semantics as the native scanner.
+            starts = []
+            pend_start = None
+            with open(self.uri, "rb") as f:
+                pos = 0
+                while pos + 8 <= size:
+                    magic, lrec = struct.unpack("<II", f.read(8))
+                    if magic != _MAGIC:
+                        raise IOError("Invalid RecordIO magic number")
+                    cflag, length = _decode_lrec(lrec)
+                    if pos + 8 + length > size:
+                        break          # truncated tail: drop cleanly
+                    if cflag == 0:
+                        starts.append(pos)
+                    elif cflag == 1:
+                        pend_start = pos
+                    elif cflag == 3 and pend_start is not None:
+                        starts.append(pend_start)
+                        pend_start = None
+                    pos += 8 + length + ((4 - length % 4) % 4)
+                    f.seek(pos)
+        self.idx = {}
+        self.keys = []
+        for i, s in enumerate(starts):
+            key = self.key_type(i)
+            self.idx[key] = int(s)
+            self.keys.append(key)
+        if write:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
 
     def close(self):
         if not self.is_open:
