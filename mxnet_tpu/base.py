@@ -103,6 +103,9 @@ register_env("MXNET_SAFE_ACCUMULATION", True, bool,
              "Accumulate reductions of fp16/bf16 in fp32 (reference: MXNET_SAFE_ACCUMULATION).")
 register_env("MXNET_DEFAULT_DTYPE", "float32", str,
              "Default dtype for array creation.")
+register_env("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4, int,
+             "Multi-tensor (fused) optimizer update group size in Trainer; "
+             "0 disables aggregation (reference: optimizer_op.cc multi_sgd).")
 register_env("MXNET_TPU_USE_PALLAS", True, bool,
              "Use Pallas kernels for hot ops (attention, fused optimizer) when on TPU.")
 register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,

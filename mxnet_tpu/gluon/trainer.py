@@ -83,6 +83,12 @@ class Trainer:
         else:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
+        if self._optimizer.aggregate_num == 0:
+            # reference: Trainer enables multi-tensor (aggregated) updates,
+            # sized by MXNET_OPTIMIZER_AGGREGATION_SIZE; 0 disables
+            import os as _os
+            self._optimizer.aggregate_num = int(
+                _os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4"))
         self._updaters = [opt.get_updater(self._optimizer)
                           for _ in self._contexts]
 
@@ -104,17 +110,27 @@ class Trainer:
         kvstore_name = config["kvstore"]
         update_on_kvstore = config["update_on_kvstore"]
         kvstore = None
+        sparse_params = any(p._stype != "default" for p in self._params)
         if kvstore_name:
-            kvstore = kvs.create(kvstore_name) if isinstance(
-                kvstore_name, str) else kvstore_name
+            # single-device non-dist: aggregation is a no-op, skip the store
+            # entirely (reference: _init_kvstore with one context and dense
+            # params also bypasses push/pull via update_on_kvstore=False and
+            # CommCPU short-circuit; here the dispatch cost matters more).
+            # An explicit update_on_kvstore=True keeps the store.
+            single = (isinstance(kvstore_name, str) and
+                      not kvstore_name.startswith("dist") and
+                      len(contexts) == 1 and not sparse_params and
+                      update_on_kvstore is not True)
+            if not single:
+                kvstore = kvs.create(kvstore_name) if isinstance(
+                    kvstore_name, str) else kvstore_name
         self._distributed = "dist" in kvstore.type if kvstore else False
         if kvstore:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
             if update_on_kvstore is None:
                 # reference default: update on kvstore for dist and sparse
-                sparse = any(p._stype != "default" for p in self._params)
-                update_on_kvstore = self._distributed or sparse
+                update_on_kvstore = self._distributed or sparse_params
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
             self._kvstore = kvstore
@@ -234,6 +250,9 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        # aggregate per updater slot so the whole step is ONE fused jitted
+        # optimizer call (reference: Optimizer.aggregate_num / multi_sgd)
+        batched = [[] for _ in self._updaters]
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -245,9 +264,17 @@ class Trainer:
                     idx = self._param2idx[param.name]
                     self._kvstore.pull(idx, param.list_data(), priority=-i)
                 continue
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                upd(i, grad, arr)
+            for slot, (arr, grad) in enumerate(zip(param.list_data(),
+                                                   param.list_grad())):
+                batched[slot].append((i, grad, arr))
+        for upd, entries in zip(self._updaters, batched):
+            if not entries:
+                continue
+            if len(entries) == 1:
+                upd(entries[0][0], entries[0][1], entries[0][2])
+            else:
+                idxs, grads, arrs = zip(*entries)
+                upd(list(idxs), list(grads), list(arrs))
 
     def save_states(self, fname):
         """reference: Trainer.save_states."""

@@ -20,6 +20,7 @@ import pickle
 import warnings
 
 import numpy as _np
+import jax.numpy as jnp
 
 from .. import ndarray as nd
 from ..ndarray import NDArray
@@ -68,6 +69,63 @@ def _run_op(name, *arrays, **kwargs):
             name, len(out), len(targets))
     for target, new in zip(targets, out):
         target._write(new.astype(target._read().dtype))
+
+
+# ---------------------------------------------------------------------------
+# fused multi-parameter updates (reference: src/operator/optimizer_op.cc
+# multi_sgd_update / multi_sgd_mom_update / multi_mp_sgd_*, surfaced by
+# Optimizer.aggregate_num). One jitted call updates every parameter of a
+# step — the dominant eager-trainer cost is per-op dispatch, and XLA fuses
+# the whole bundle. jit caches on the list-of-shapes structure.
+# ---------------------------------------------------------------------------
+_FUSED_CACHE = {}
+
+
+def _fused_fn(kind, momentum_on, clip_on):
+    import jax as _jax
+    key = (kind, momentum_on, clip_on)
+    fn = _FUSED_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def prep(g, w, rescale, clip, wd):
+        g = g.astype(jnp.float32) * rescale
+        if clip_on:
+            g = jnp.clip(g, -clip, clip)
+        return g + wd * w.astype(jnp.float32)
+
+    if kind == "sgd":
+        def impl(ws, gs, moms, lrs, wds, momentum, rescale, clip):
+            new_w, new_m = [], []
+            for i, (w, g) in enumerate(zip(ws, gs)):
+                g32 = prep(g, w, rescale, clip, wds[i])
+                if momentum_on:
+                    m = moms[i].astype(jnp.float32) * momentum - lrs[i] * g32
+                    new_m.append(m.astype(moms[i].dtype))
+                    new_w.append((w.astype(jnp.float32) + m).astype(w.dtype))
+                else:
+                    new_w.append((w.astype(jnp.float32) - lrs[i] * g32)
+                                 .astype(w.dtype))
+            return new_w, new_m
+    elif kind == "adam":
+        def impl(ws, gs, means, variances, lrs, wds, beta1, beta2, eps,
+                 rescale, clip):
+            new_w, new_m, new_v = [], [], []
+            for i, (w, g) in enumerate(zip(ws, gs)):
+                g32 = prep(g, w, rescale, clip, wds[i])
+                m = beta1 * means[i] + (1.0 - beta1) * g32
+                v = beta2 * variances[i] + (1.0 - beta2) * g32 * g32
+                new_m.append(m)
+                new_v.append(v)
+                new_w.append((w.astype(jnp.float32) -
+                              lrs[i] * m / (jnp.sqrt(v) + eps))
+                             .astype(w.dtype))
+            return new_w, new_m, new_v
+    else:
+        raise KeyError(kind)
+
+    fn = _FUSED_CACHE[key] = _jax.jit(impl)
+    return fn
 
 
 class Optimizer:
@@ -291,6 +349,27 @@ class SGD(Optimizer):
     def update_multi_precision(self, index, weight, grad, state):
         use_mp = self.multi_precision and weight.dtype == _np.float16
         self._update_impl(index, weight, grad, state, multi_precision=use_mp)
+
+    def fused_update(self, indices, weights, grads, states):
+        """Aggregated multi-param step in one jitted call (reference:
+        multi_sgd_update / multi_sgd_mom_update)."""
+        for i in indices:
+            self._update_count(i)
+        lrs = [jnp.float32(self._get_lr(i)) for i in indices]
+        wds = [jnp.float32(self._get_wd(i)) for i in indices]
+        clip = self.clip_gradient
+        fn = _fused_fn("sgd", self.momentum != 0.0, clip is not None)
+        ws = [w._read() for w in weights]
+        gs = [g._read() for g in grads]
+        moms = [s._read() for s in states] if self.momentum else []
+        new_w, new_m = fn(ws, gs, moms, lrs, wds,
+                          jnp.float32(self.momentum),
+                          jnp.float32(self.rescale_grad),
+                          jnp.float32(clip if clip is not None else 0.0))
+        for w, nw in zip(weights, new_w):
+            w._write(nw)
+        for s, nm in zip(states, new_m):
+            s._write(nm)
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):
         self._update_count(index)
@@ -551,6 +630,35 @@ class Adam(Optimizer):
                 rescale_grad=self.rescale_grad,
                 clip_gradient=_clip(self.clip_gradient),
                 lazy_update=self.lazy_update)
+
+    def fused_update(self, indices, weights, grads, states):
+        """Aggregated adam step, bias correction folded into per-param lr
+        (same trick as the reference's multi-tensor adam)."""
+        lrs, wds = [], []
+        for i in indices:
+            self._update_count(i)
+            t = self._index_update_count[i]
+            lr = self._get_lr(i) * math.sqrt(1. - self.beta2 ** t) / \
+                (1. - self.beta1 ** t)
+            lrs.append(jnp.float32(lr))
+            wds.append(jnp.float32(self._get_wd(i)))
+        clip = self.clip_gradient
+        fn = _fused_fn("adam", True, clip is not None)
+        ws = [w._read() for w in weights]
+        gs = [g._read() for g in grads]
+        means = [s[0]._read() for s in states]
+        variances = [s[1]._read() for s in states]
+        new_w, new_m, new_v = fn(
+            ws, gs, means, variances, lrs, wds, jnp.float32(self.beta1),
+            jnp.float32(self.beta2), jnp.float32(self.epsilon),
+            jnp.float32(self.rescale_grad),
+            jnp.float32(clip if clip is not None else 0.0))
+        for w, nw in zip(weights, new_w):
+            w._write(nw)
+        # keep state dtype as created (eager _run_op casts the same way)
+        for s, nm, nv, m0, v0 in zip(states, new_m, new_v, means, variances):
+            s[0]._write(nm.astype(m0.dtype))
+            s[1]._write(nv.astype(v0.dtype))
 
 
 @register
@@ -877,7 +985,7 @@ class Updater:
         indices = index if isinstance(index, (list, tuple)) else [index]
         grads = grad if isinstance(grad, (list, tuple)) else [grad]
         weights = weight if isinstance(weight, (list, tuple)) else [weight]
-        for i, (idx, g, w) in enumerate(zip(indices, grads, weights)):
+        for idx, w in zip(indices, weights):
             if idx not in self.states:
                 self.states[idx] = \
                     self.optimizer.create_state_multi_precision(idx, w)
@@ -886,7 +994,26 @@ class Updater:
                 self.states[idx] = self.sync_state_context(self.states[idx],
                                                            w.context)
                 self.states_synced[idx] = True
+        if len(indices) > 1 and self.aggregate_updates and \
+                self._can_fuse(weights, grads):
+            self.optimizer.fused_update(
+                indices, weights, grads,
+                [self.states[i] for i in indices])
+            return
+        for idx, g, w in zip(indices, grads, weights):
             self.optimizer.update_multi_precision(idx, w, g, self.states[idx])
+
+    def _can_fuse(self, weights, grads):
+        """Aggregated update only for exactly SGD/Adam (subclasses override
+        update semantics), dense grads, non-fp16 weights (fp16 goes the
+        multi-precision path). Gated by optimizer.aggregate_num (reference:
+        MXNET_OPTIMIZER_AGGREGATION_SIZE)."""
+        from ..ndarray.sparse import BaseSparseNDArray
+        if type(self.optimizer) not in (SGD, Adam):
+            return False
+        if any(isinstance(g, BaseSparseNDArray) for g in grads):
+            return False
+        return all(w.dtype != _np.float16 for w in weights)
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):

@@ -64,8 +64,12 @@ def take_key(ctx=None):
     if key not in tbl:
         if key is not None and None in tbl:
             # derive device stream from the global seed, like the reference's
-            # per-device generators seeded from one seed + device id
-            tbl[key] = jax.random.fold_in(tbl[None], hash(key) & 0x7FFFFFFF)
+            # per-device generators seeded from one seed + device id.
+            # NB: stable hash — python's hash() is salted per process and
+            # would break cross-process seed determinism
+            import zlib
+            stable = zlib.crc32(key[0].encode()) ^ (key[1] & 0xFFFF)
+            tbl[key] = jax.random.fold_in(tbl[None], stable & 0x7FFFFFFF)
         else:
             tbl[key] = jax.random.key(_DEFAULT_SEED)
     k0, k1 = jax.random.split(tbl[key])
