@@ -1,0 +1,94 @@
+"""`mx.np.random`. reference: python/mxnet/numpy/random.py — numpy-named
+sampling backed by the framework RNG (mx.random.seed applies)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..ndarray.ndarray import invoke
+from .. import random as _random
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
+           "choice", "shuffle", "gamma", "beta", "exponential",
+           "multinomial"]
+
+seed = _random.seed
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    return invoke("_random_uniform", low=float(low), high=float(high),
+                  shape=size if size is not None else (), ctx=ctx,
+                  dtype=dtype or "float32")
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    return invoke("_random_normal", loc=float(loc), scale=float(scale),
+                  shape=size if size is not None else (), ctx=ctx,
+                  dtype=dtype or "float32")
+
+
+def randn(*size, **kwargs):
+    return normal(size=size or (), **kwargs)
+
+
+def rand(*size, **kwargs):
+    return uniform(size=size or (), **kwargs)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None):
+    if high is None:
+        low, high = 0, low
+    return invoke("_random_randint", low=int(low), high=int(high),
+                  shape=size if size is not None else (), ctx=ctx,
+                  dtype=dtype or "int32")
+
+
+def exponential(scale=1.0, size=None, ctx=None):
+    return invoke("_random_exponential", lam=1.0 / scale,
+                  shape=size if size is not None else (), ctx=ctx)
+
+
+def gamma(shape, scale=1.0, size=None, ctx=None):
+    return invoke("_random_gamma", alpha=float(shape), beta=float(scale),
+                  shape=size if size is not None else (), ctx=ctx)
+
+
+def beta(a, b, size=None, ctx=None):
+    # beta(a,b) = ga/(ga+gb) from two gammas (reference implements the same
+    # composition for its numpy namespace)
+    ga = gamma(a, 1.0, size=size, ctx=ctx)
+    gb = gamma(b, 1.0, size=size, ctx=ctx)
+    return ga / (ga + gb)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    import numpy as np
+    from ..ndarray.ndarray import array as nd_array
+    n = int(a) if _onp.isscalar(a) else len(a)
+    if p is None:
+        if replace:
+            idx = randint(0, n, size=size, ctx=ctx)
+        else:
+            perm = _onp.random.permutation(n)
+            count = _onp.prod(size) if size else 1
+            idx = nd_array(perm[:int(count)].reshape(size or ()))
+    else:
+        pv = _onp.asarray(p, dtype=_onp.float64)
+        count = int(_onp.prod(size)) if size else 1
+        samples = _onp.random.choice(n, size=count, replace=replace, p=pv)
+        idx = nd_array(samples.reshape(size or ()).astype("int32"))
+    if _onp.isscalar(a):
+        return idx
+    return nd_array(_onp.asarray(a))[idx]
+
+
+def multinomial(n, pvals, size=None):
+    out = _onp.random.multinomial(n, _onp.asarray(pvals), size=size)
+    from ..ndarray.ndarray import array as nd_array
+    return nd_array(out.astype("float32"))
+
+
+def shuffle(x):
+    """In-place permutation along axis 0 (reference: np.random.shuffle)."""
+    perm = _onp.random.permutation(x.shape[0])
+    from ..ndarray.ndarray import array as nd_array
+    x[:] = x[nd_array(perm.astype("int32"))]

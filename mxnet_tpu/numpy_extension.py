@@ -1,0 +1,75 @@
+"""`mx.npx` — numpy-extension namespace. reference:
+python/mxnet/numpy_extension/ — operators outside the numpy standard
+(neural-net ops, np-mode switches) for use with mx.np arrays."""
+from __future__ import annotations
+
+from .ndarray.ndarray import invoke
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "softmax", "log_softmax", "relu", "sigmoid", "one_hot", "pick",
+           "topk", "batch_dot", "embedding", "gamma"]
+
+_np_mode = {"array": False, "shape": False}
+
+
+def set_np(shape=True, array=True):
+    """reference: npx.set_np — enables numpy semantics globally. The TPU
+    build's arrays are numpy-semantic already (jax.numpy underneath), so
+    this only records the flags for is_np_* queries."""
+    _np_mode["array"] = bool(array)
+    _np_mode["shape"] = bool(shape)
+
+
+def reset_np():
+    set_np(shape=False, array=False)
+
+
+def is_np_array():
+    return _np_mode["array"]
+
+
+def is_np_shape():
+    return _np_mode["shape"]
+
+
+def softmax(data, axis=-1):
+    return invoke("softmax", data, axis=axis)
+
+
+def log_softmax(data, axis=-1):
+    return invoke("log_softmax", data, axis=axis)
+
+
+def relu(data):
+    return invoke("relu", data)
+
+
+def sigmoid(data):
+    return invoke("sigmoid", data)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0):
+    return invoke("one_hot", data, depth=depth, on_value=on_value,
+                  off_value=off_value)
+
+
+def pick(data, index, axis=-1, keepdims=False):
+    return invoke("pick", data, index, axis=axis, keepdims=keepdims)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices"):
+    return invoke("topk", data, k=k, axis=axis, ret_typ=ret_typ)
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    return invoke("batch_dot", lhs, rhs, transpose_a=transpose_a,
+                  transpose_b=transpose_b)
+
+
+def embedding(data, weight, input_dim=None, output_dim=None):
+    return invoke("Embedding", data, weight, input_dim=input_dim,
+                  output_dim=output_dim)
+
+
+def gamma(data):
+    return invoke("gamma", data)

@@ -27,6 +27,8 @@ from .dist import initialize, is_initialized, rank, num_workers
 from .flash_attention import flash_attention
 from .ring_attention import ring_attention
 from .train_step import ShardedTrainStep
+from .checkpoint import (save_sharded, restore_sharded, latest_step,
+                         save_train_state, restore_train_state)
 
 __all__ = [
     "MeshConfig", "create_mesh", "current_mesh", "local_mesh", "mesh_scope",

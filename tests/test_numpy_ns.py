@@ -1,0 +1,77 @@
+"""mx.np / mx.npx namespace tests. reference idiom:
+tests/python/unittest/test_numpy_op.py / test_numpy_ndarray.py."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_np_creation_and_elementwise():
+    a = mx.np.arange(6).reshape((2, 3))
+    b = mx.np.ones((2, 3))
+    out = mx.np.add(a, b)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.arange(6).reshape(2, 3) + 1)
+    assert mx.np.sum(out).asnumpy() == 21
+    onp.testing.assert_allclose(
+        mx.np.exp(mx.np.zeros((3,))).asnumpy(), onp.ones(3))
+
+
+def test_np_matmul_and_reductions():
+    a = mx.np.array(onp.random.rand(3, 4).astype("float32"))
+    b = mx.np.array(onp.random.rand(4, 2).astype("float32"))
+    out = mx.np.matmul(a, b)
+    onp.testing.assert_allclose(out.asnumpy(), a.asnumpy() @ b.asnumpy(),
+                                rtol=1e-5)
+    m = mx.np.mean(a, axis=0)
+    onp.testing.assert_allclose(m.asnumpy(), a.asnumpy().mean(axis=0),
+                                rtol=1e-6)
+    assert int(mx.np.argmax(a).asnumpy()) == int(a.asnumpy().argmax())
+
+
+def test_np_autograd_flows():
+    x = mx.np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.np.sum(mx.np.square(mx.np.sin(x)))
+    y.backward()
+    expect = 2 * onp.sin([1, 2, 3]) * onp.cos([1, 2, 3])
+    onp.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_np_manipulation():
+    a = mx.np.arange(12).reshape((3, 4))
+    st = mx.np.stack([a, a])
+    assert st.shape == (2, 3, 4)
+    sp = mx.np.split(a, 2, axis=1)
+    assert len(sp) == 2 and sp[0].shape == (3, 2)
+    w = mx.np.where(a > 5, a, mx.np.zeros_like(a))
+    assert float(mx.np.sum(w).asnumpy()) == sum(range(6, 12))
+    t = mx.np.transpose(a)
+    assert t.shape == (4, 3)
+
+
+def test_np_random_seeded():
+    mx.np.random.seed(3)
+    a = mx.np.random.uniform(size=(5,)).asnumpy()
+    mx.np.random.seed(3)
+    b = mx.np.random.uniform(size=(5,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+    r = mx.np.random.randint(0, 10, size=(100,)).asnumpy()
+    assert r.min() >= 0 and r.max() < 10
+    n = mx.np.random.normal(2.0, 0.1, size=(2000,)).asnumpy()
+    assert abs(n.mean() - 2.0) < 0.05
+
+
+def test_npx_ops_and_np_mode():
+    x = mx.np.array([[1.0, 2.0, 3.0]])
+    s = mx.npx.softmax(x)
+    onp.testing.assert_allclose(s.asnumpy().sum(), 1.0, rtol=1e-6)
+    assert not mx.npx.is_np_array()
+    mx.npx.set_np()
+    assert mx.npx.is_np_array() and mx.npx.is_np_shape()
+    mx.npx.reset_np()
+    assert not mx.npx.is_np_shape()
+    r = mx.npx.relu(mx.np.array([-1.0, 2.0]))
+    onp.testing.assert_array_equal(r.asnumpy(), [0.0, 2.0])

@@ -1,0 +1,38 @@
+"""Sharded checkpoint save/restore (reference analog: SURVEY §5.4 sharded
+native format for pod-scale models)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.models.llama import CONFIGS, llama_init
+from mxnet_tpu.parallel.mesh import create_mesh
+from mxnet_tpu.parallel.sharding import LLAMA_RULES, shard_pytree
+from mxnet_tpu.parallel import checkpoint as ckpt
+
+
+def test_sharded_save_restore_roundtrip(tmp_path):
+    cfg = CONFIGS["llama_tiny"]
+    mesh = create_mesh(data=2, fsdp=2, model=2)
+    params = shard_pytree(llama_init(jax.random.PRNGKey(0), cfg),
+                          LLAMA_RULES, mesh)
+    path = str(tmp_path / "ckpt")
+    ckpt.save_sharded(path, params, step=3)
+    assert ckpt.latest_step(path) == 3
+
+    restored = ckpt.restore_sharded(path, mesh=mesh, rules=LLAMA_RULES)
+    ref_wq = np.asarray(params["layers"]["0"]["attn"]["wq"])
+    got_wq = restored["layers"]["0"]["attn"]["wq"]
+    np.testing.assert_array_equal(np.asarray(got_wq), ref_wq)
+    # restored with the requested sharding
+    assert "model" in str(got_wq.sharding.spec)
+
+
+def test_train_state_roundtrip(tmp_path):
+    mesh = create_mesh(data=2)
+    params = {"w": jnp.ones((4, 4))}
+    opt = {"mom": jnp.zeros((4, 4))}
+    path = str(tmp_path / "state")
+    ckpt.save_train_state(path, params, opt, step=7)
+    p, s, step = ckpt.restore_train_state(path, mesh=mesh)
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(p["w"]), np.ones((4, 4)))

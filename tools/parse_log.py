@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Parse training logs into a per-epoch table. reference:
+tools/parse_log.py — extracts train/val accuracy and epoch time from the
+logging output of fit()/Speedometer (`Epoch[3] Batch [100] Speed: ...
+accuracy=0.9`, `Epoch[3] Validation-accuracy=0.91`, `Epoch[3] Time
+cost=12.3`)."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric="accuracy"):
+    train_re = re.compile(
+        r"Epoch\[(\d+)\].*?Train-" + metric + r"=([\d.eE+-]+)")
+    batch_re = re.compile(
+        r"Epoch\[(\d+)\].*?" + metric + r"=([\d.eE+-]+)")
+    val_re = re.compile(
+        r"Epoch\[(\d+)\].*?Validation-" + metric + r"=([\d.eE+-]+)")
+    time_re = re.compile(r"Epoch\[(\d+)\].*?Time cost=([\d.eE+-]+)")
+    rows = {}
+
+    def row(e):
+        return rows.setdefault(int(e), {"train": None, "val": None,
+                                        "time": None})
+
+    for line in lines:
+        m = val_re.search(line)
+        if m:
+            row(m.group(1))["val"] = float(m.group(2))
+            continue
+        m = time_re.search(line)
+        if m:
+            row(m.group(1))["time"] = float(m.group(2))
+            continue
+        m = train_re.search(line) or batch_re.search(line)
+        if m:
+            row(m.group(1))["train"] = float(m.group(2))  # last batch wins
+    return rows
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("logfile")
+    parser.add_argument("--format", choices=["markdown", "csv"],
+                        default="markdown")
+    parser.add_argument("--metric", default="accuracy")
+    args = parser.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f, args.metric)
+    if args.format == "markdown":
+        print("| epoch | train-%s | val-%s | time(s) |" % (args.metric,
+                                                           args.metric))
+        print("| --- | --- | --- | --- |")
+        fmt = "| %d | %s | %s | %s |"
+    else:
+        print("epoch,train-%s,val-%s,time" % (args.metric, args.metric))
+        fmt = "%d,%s,%s,%s"
+    for e in sorted(rows):
+        r = rows[e]
+        print(fmt % (e, r["train"], r["val"], r["time"]))
+
+
+if __name__ == "__main__":
+    main()
