@@ -50,6 +50,13 @@ class LlamaConfig:
     dtype: object = jnp.bfloat16
     remat: bool = False
     tie_embeddings: bool = False
+    # One-hot-matmul embedding lookup instead of gather. Used when the vocab
+    # dim of tok_embeddings is sharded over the mesh: the gather's backward
+    # is a scatter-add whose updates are batch-sharded while the table is
+    # vocab-sharded — the SPMD partitioner fully replicates it ("Involuntary
+    # full rematerialization"). As a matmul, fwd and bwd both partition
+    # cleanly (reduce-scatter over the vocab axis) and run on the MXU.
+    embed_onehot: bool = False
 
     @property
     def head_dim(self):
@@ -60,7 +67,8 @@ CONFIGS = {
     # Llama-3-8B — BASELINE.json configs[4] (the pod-scale north star).
     "llama3_8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
                              n_heads=32, n_kv_heads=8, hidden_dim=14336,
-                             rope_theta=500000.0, max_seq_len=8192),
+                             rope_theta=500000.0, max_seq_len=8192,
+                             embed_onehot=True),
     # ~110M single-chip benchmark model.
     "llama_110m": LlamaConfig(vocab_size=32000, dim=768, n_layers=12,
                               n_heads=12, n_kv_heads=12, hidden_dim=2048,
@@ -176,7 +184,12 @@ def llama_forward(params, tokens, cfg: LlamaConfig, seq_axis=None,
     then runs as ring attention (call under shard_map). positions overrides
     the default iota (needed for the sequence-sharded case)."""
     B, S = tokens.shape
-    x = params["tok_embeddings"][tokens]
+    if cfg.embed_onehot:
+        oh = jax.nn.one_hot(tokens, cfg.vocab_size,
+                            dtype=params["tok_embeddings"].dtype)
+        x = oh @ params["tok_embeddings"]
+    else:
+        x = params["tok_embeddings"][tokens]
     if positions is None:
         positions = jnp.arange(S, dtype=jnp.int32)
         if seq_axis is not None:

@@ -29,22 +29,24 @@ _NEG_INF = -1e30
 
 def _ref_attention(q, k, v, causal, sm_scale):
     """Plain-XLA attention, fp32 softmax. Used for CPU fallback and as the
-    recompute body of the backward pass."""
+    recompute body of the backward pass.
+
+    GQA runs as a grouped einsum over (kv_head, group) axes rather than
+    jnp.repeat of K/V: no materialized copies, and the repeat's reshape+sum
+    VJP pattern reshards badly under GSPMD."""
     B, H, Sq, D = q.shape
-    Hkv = k.shape[1]
-    if Hkv != H:
-        rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    Hkv, Sk = k.shape[1], k.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, Hkv, g, Sq, D)
+    logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k,
                         preferred_element_type=jnp.float32) * sm_scale
     if causal:
-        Sk = k.shape[2]
         qi = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0) + (Sk - Sq)
         ki = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
         logits = jnp.where(ki <= qi, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v)
+    return out.reshape(B, H, Sq, D)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_sc, l_sc, *,

@@ -25,8 +25,12 @@ _NEG_INF = -1e30
 
 
 def _block_attend(q, k, v, mask, sm_scale):
-    """One Q-chunk x K-chunk block: returns (unnormalized out, m, l) in f32."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    """One Q-chunk x K-chunk block: returns (unnormalized out, m, l) in f32.
+
+    q is pre-grouped (B, Hkv, G, Sq, D); k/v stay at their Hkv head count —
+    GQA via grouped einsum, so repeated K/V copies are never materialized
+    (and never ppermuted around the ring)."""
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
@@ -34,7 +38,7 @@ def _block_attend(q, k, v, mask, sm_scale):
     m = jnp.maximum(m, -1e9)  # keep fully-masked rows finite
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32),
                    preferred_element_type=jnp.float32)
     return o, m, l
 
@@ -43,7 +47,9 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
     """Attention with K/V rotating around the `axis_name` ring.
 
     q: (B, H, Sq/n, D); k, v: (B, Hkv, Sk/n, D) — the per-device shards.
-    GQA is handled by repeating K/V heads locally.
+    GQA runs as grouped einsum over (kv_head, group): only the Hkv-headed
+    K/V shards travel the ring, so ICI volume and carry HBM stay 1/(H/Hkv)
+    of the repeated form.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -51,11 +57,8 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
     my = lax.axis_index(axis_name)
     B, H, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
-    if Hkv != H:
-        rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-    qf = q.astype(jnp.float32)
+    g = H // Hkv
+    qf = q.reshape(B, Hkv, g, Sq, D).astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, step_idx):
@@ -68,7 +71,7 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
         if causal:
             qi = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0) + my * Sq
             ki = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1) + src * Sk
-            mask = (ki <= qi)[None, None]
+            mask = (ki <= qi)[None, None, None]
         else:
             mask = None
         o, m_blk, l_blk = _block_attend(qf, k_cur.astype(jnp.float32),
@@ -80,9 +83,9 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
         l_new = l_run * alpha + l_blk * beta
         return (acc, m_new, l_new, k_nxt, v_nxt), None
 
-    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
-    m0 = jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, g, Sq, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, g, Sq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, g, Sq, 1), jnp.float32)
     # constants enter the scan carry device-varying (they become varying
     # through the masked block math) — mark them so under shard_map
     try:
@@ -92,4 +95,4 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
     (acc, _, l, _, _), _ = lax.scan(
         step, (acc0, m0, l0, k, v), jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / l).astype(q.dtype)
+    return (acc / l).reshape(B, H, Sq, D).astype(q.dtype)

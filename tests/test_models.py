@@ -30,6 +30,21 @@ def test_llama_forward_shape_dtype():
     assert logits.dtype == jnp.float32
 
 
+def test_llama_embed_onehot_matches_gather():
+    """embed_onehot (the sharded-table path, llama3_8b + dryrun) must be
+    numerically identical to the default gather lookup."""
+    import dataclasses
+    params = llama_init(jax.random.PRNGKey(0), CFG)
+    toks = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                              CFG.vocab_size)
+    ref = llama_forward(params, toks, CFG)
+    oh = llama_forward(params, toks,
+                       dataclasses.replace(CFG, embed_onehot=True))
+    # the two lookups are bit-exact (one-hot rows select single table rows)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(oh),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_llama_loss_decreases_training():
     params = llama_init(jax.random.PRNGKey(0), CFG)
     key = jax.random.PRNGKey(1)

@@ -154,6 +154,26 @@ def test_ring_attention_matches_full(causal):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_gqa_matches_full(causal):
+    """Hkv < H: the grouped-einsum GQA path (no K/V repeat on the ring)."""
+    mesh = par.local_mesh(4, axis="seq")
+    rng = np.random.RandomState(7)
+    B, H, Hkv, S, D = 2, 4, 2, 32, 16
+    q = rng.randn(B, H, S, D).astype(np.float32)
+    k = rng.randn(B, Hkv, S, D).astype(np.float32)
+    v = rng.randn(B, Hkv, S, D).astype(np.float32)
+
+    f = shard_map(
+        lambda q_, k_, v_: par.ring_attention(q_, k_, v_, axis_name="seq",
+                                              causal=causal),
+        mesh=mesh, in_specs=P(None, None, "seq", None),
+        out_specs=P(None, None, "seq", None))
+    out = jax.jit(f)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    ref = _np_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
 def test_sharded_train_step_linear_regression():
     mesh = par.create_mesh(data=2, model=4)
     rng = np.random.RandomState(3)
