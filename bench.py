@@ -1,42 +1,46 @@
-"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip)
+through the USER-FACING Gluon API — `gluon.model_zoo.vision.resnet50_v1` +
+`gluon.Trainer` + `gluon.FusedTrainStep` (the whole train step compiled to
+one XLA program; reference analog: CachedOp + engine-overlapped KVStore +
+optimizer ops, SURVEY.md §3.2).
 
 BASELINE.md: target >= 0.9x A100 per-chip throughput. A100 ResNet-50 train
 (fp16/AMP, batch 256) is ~2500 img/s, so vs_baseline is measured against
-0.9 * 2500 = 2250 img/s. Synthetic data, bf16, fused fwd+bwd+SGD step per
-the BASELINE.md measurement protocol (warm-up, then median-free steady-state
-mean over 50 steps).
+0.9 * 2500 = 2250 img/s. Synthetic data, bf16 conv stack with fp32
+BatchNorm, SGD+momentum, warm-up then steady-state mean over 50 steps.
+
+BENCH=functional selects the raw functional-JAX path (models/resnet.py) for
+comparison; the headline is the Gluon path.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
 """
 import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
-
-from mxnet_tpu.models.resnet import (CONFIGS, resnet_init, resnet_loss,
-                                     update_running_stats)
 
 BASELINE_IMG_S = 2250.0
 LR = 0.1
 MOMENTUM = 0.9
 
 
-def tmap(f, *t):
-    return jax.tree_util.tree_map(f, *t)
+def bench_functional(on_accel):
+    """Functional-JAX comparison path (round-1 headline)."""
+    from mxnet_tpu.models.resnet import (CONFIGS, resnet_init, resnet_loss,
+                                         update_running_stats)
 
+    def tmap(f, *t):
+        return jax.tree_util.tree_map(f, *t)
 
-def main():
-    dev = jax.devices()[0]
-    on_accel = dev.platform != "cpu"
     cfg = CONFIGS["resnet50"] if on_accel else CONFIGS["resnet_tiny"]
     batch = 256 if on_accel else 8
     size = 224 if on_accel else 32
     steps, warmup = (50, 10) if on_accel else (5, 2)
 
-    key = jax.random.PRNGKey(0)
-    params = resnet_init(key, cfg)
+    params = resnet_init(jax.random.PRNGKey(0), cfg)
     mom = tmap(jnp.zeros_like, params)
     images = jax.random.normal(jax.random.PRNGKey(1),
                                (batch, size, size, 3), jnp.bfloat16)
@@ -57,17 +61,74 @@ def main():
     for _ in range(warmup):
         params, mom, loss = step(params, mom, data)
     jax.block_until_ready(loss)
-
     t0 = time.perf_counter()
     for _ in range(steps):
         params, mom, loss = step(params, mom, data)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    return batch * steps / dt, "functional"
 
-    img_s = batch * steps / dt
+
+def bench_gluon(on_accel):
+    """The user-facing path: zoo model + Trainer + FusedTrainStep."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    ctx = mx.tpu() if on_accel else mx.cpu()
+    batch = 256 if on_accel else 8
+    size = 224 if on_accel else 32
+    steps, warmup = (50, 10) if on_accel else (5, 2)
+
+    mx.random.seed(0)
+    with mx.Context(ctx):
+        net = (vision.resnet50_v1(classes=1000) if on_accel
+               else vision.resnet18_v1(classes=10))
+        net.initialize(mx.init.Xavier(rnd_type="gaussian"), ctx=ctx)
+        net.cast("bfloat16")  # conv stack bf16; BatchNorm stays fp32
+        net.hybridize(static_alloc=True)
+
+        rng = np.random.RandomState(1)
+        x = nd.array(rng.randn(batch, 3, size, size), ctx=ctx,
+                     dtype="bfloat16")
+        y = nd.array(rng.randint(0, 10, (batch,)), ctx=ctx, dtype="float32")
+        net(x)  # shape inference + param init
+
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": LR, "momentum": MOMENTUM})
+        fused = gluon.FusedTrainStep(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer)
+
+        for _ in range(warmup):
+            loss = fused(x, y)
+        loss.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = fused(x, y)
+        loss.wait_to_read()
+        dt = time.perf_counter() - t0
+    return batch * steps / dt, "gluon"
+
+
+def main():
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    which = os.environ.get("BENCH", "gluon")
+    img_s, path = (bench_functional if which == "functional"
+                   else bench_gluon)(on_accel)
+    if on_accel:
+        name = "resnet50_train_img_per_sec"
+        if path != "gluon":
+            name += "_" + path
+    else:
+        # CPU smoke paths measure different tiny models — name them honestly
+        # (round-1 key kept for the functional config)
+        name = ("resnet_tiny_cpu_img_per_sec" if path == "functional"
+                else "resnet18_cpu_gluon_img_per_sec")
     print(json.dumps({
-        "metric": "resnet50_train_img_per_sec" if on_accel
-                  else "resnet_tiny_cpu_img_per_sec",
+        "metric": name,
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),

@@ -11,7 +11,8 @@ from . import data
 from . import utils
 from . import model_zoo
 from .trainer import Trainer
+from .fused_step import FusedTrainStep
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "Constant",
            "DeferredInitializationError", "Parameter", "ParameterDict",
-           "Trainer", "nn", "loss", "utils"]
+           "Trainer", "FusedTrainStep", "nn", "loss", "utils"]

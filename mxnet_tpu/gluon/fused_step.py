@@ -1,0 +1,341 @@
+"""One-dispatch Gluon training step: forward + loss + backward + optimizer
+compiled into a single XLA program.
+
+The reference gets per-step speed from three separate subsystems: CachedOp
+for the forward graph (src/imperative/cached_op.cc), the NNVM Gradient pass
+replay for backward, and engine-overlapped KVStore push/pull + per-param
+optimizer ops (SURVEY.md §3.2). Even with all of them, every stage is its
+own dispatch. The TPU-native answer fuses the entire step — the same move
+`parallel.ShardedTrainStep` makes for the functional API, here surfaced for
+the *Gluon* API so `model_zoo` + `Trainer` users get the fused path without
+leaving Gluon:
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(ctx=mx.tpu())
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9})
+    step = gluon.FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                trainer)
+    for data, label in batches:
+        loss = step(data, label)        # ONE jitted call, params updated
+
+Semantics parity with `loss.backward(); trainer.step(batch_size)`:
+  * the backward cotangent is ones over the per-sample loss vector (sum), and
+    `rescale_grad = scale / batch_size` — identical gradient scaling;
+  * optimizer math runs through the SAME registered optimizer ops
+    (ops/optimizer_ops.py) the imperative Updater calls, with lr/wd computed
+    host-side per step by the optimizer's own scheduler logic (exact
+    `_update_count`/`lr_scheduler` semantics) and fed as device scalars so
+    one compilation serves every step;
+  * BatchNorm moving stats update via the CachedOp aux-collector mechanism
+    and are written back each step;
+  * dropout draws from the per-step RNG key (mx.random.seed reproducible).
+
+Weight/optimizer-state buffers are donated to XLA, so the step is in-place
+at the HBM level — the buffer-swap NDArray mutation model at full speed.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import ndarray as nd
+from ..context import current_context
+from .block import _AUX_COLLECTOR, _TRACE_STATE, _flatten, _regroup
+
+__all__ = ["FusedTrainStep"]
+
+
+# ---------------------------------------------------------------------------
+# per-optimizer split: host-side scalar schedule vs traced device update.
+# Each entry: (host_fn(opt, indices) -> dict of (n,) f32 np arrays,
+#              device_fn(opt, w, g, state, lr, wd, rescale) -> (new_w, new_state))
+# The device fns call the registered optimizer ops so numerics are identical
+# to the imperative Updater path (reference: src/operator/optimizer_op.cc).
+# ---------------------------------------------------------------------------
+
+def _count_and_lrs(opt, indices):
+    for i in indices:
+        opt._update_count(i)
+    return (_np.asarray(opt._get_lrs(indices), _np.float32),
+            _np.asarray(opt._get_wds(indices), _np.float32))
+
+
+def _sgd_host(opt, indices):
+    lrs, wds = _count_and_lrs(opt, indices)
+    return {"lrs": lrs, "wds": wds}
+
+
+def _bias_corrected_host(opt, indices):
+    """Adam-family: fold 1/(1-b1^t), sqrt(1-b2^t) into lr host-side, exactly
+    as Optimizer.update does (reference: python Adam folds correction into
+    lr before calling the op)."""
+    lrs, wds = _count_and_lrs(opt, indices)
+    for j, i in enumerate(indices):
+        t = opt._index_update_count[i]
+        lrs[j] *= math.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
+    return {"lrs": lrs, "wds": wds}
+
+
+def _clipv(opt):
+    from ..optimizer.optimizer import _clip
+    return _clip(opt.clip_gradient)
+
+
+def _sgd_device(opt, w, g, state, lr, wd, rescale):
+    from ..ops.registry import get as _get_op
+    kw = dict(lr=lr, wd=wd, rescale_grad=rescale, clip_gradient=_clipv(opt))
+    if state is None:
+        return _get_op("sgd_update").fn(w, g, **kw), None
+    new_w, new_m = _get_op("sgd_mom_update").fn(
+        w, g, state, momentum=opt.momentum, **kw)
+    return new_w, new_m
+
+
+def _nag_device(opt, w, g, state, lr, wd, rescale):
+    from ..ops.registry import get as _get_op
+    kw = dict(lr=lr, wd=wd, rescale_grad=rescale, clip_gradient=_clipv(opt))
+    if state is None:
+        return _get_op("sgd_update").fn(w, g, **kw), None
+    new_w, new_m = _get_op("nag_mom_update").fn(
+        w, g, state, momentum=opt.momentum, **kw)
+    return new_w, new_m
+
+
+def _adam_device(opt, w, g, state, lr, wd, rescale):
+    from ..ops.registry import get as _get_op
+    mean, var = state
+    new_w, new_m, new_v = _get_op("adam_update").fn(
+        w, g, mean, var, lr=lr, wd=wd, beta1=opt.beta1, beta2=opt.beta2,
+        epsilon=opt.epsilon, rescale_grad=rescale,
+        clip_gradient=_clipv(opt))
+    return new_w, (new_m, new_v)
+
+
+def _adamw_device(opt, w, g, state, lr, wd, rescale):
+    from ..ops.registry import get as _get_op
+    mean, var = state
+    new_w, new_m, new_v = _get_op("adamw_update").fn(
+        w, g, mean, var, lr=lr, wd=wd, beta1=opt.beta1, beta2=opt.beta2,
+        epsilon=opt.epsilon, eta=opt.eta, rescale_grad=rescale,
+        clip_gradient=_clipv(opt))
+    return new_w, (new_m, new_v)
+
+
+_FUSABLE = {
+    "sgd": (_sgd_host, _sgd_device),
+    "nag": (_sgd_host, _nag_device),
+    "adam": (_bias_corrected_host, _adam_device),
+    "adamw": (_bias_corrected_host, _adamw_device),
+}
+
+
+def _state_raws(state):
+    """NDArray-pytree (None | NDArray | tuple) -> raw jax arrays."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(_state_raws(s) for s in state)
+    return state._read()
+
+
+def _state_write(state, raws):
+    if state is None:
+        return
+    if isinstance(state, (tuple, list)):
+        for s, r in zip(state, raws):
+            _state_write(s, r)
+        return
+    state._write(raws.astype(state._read().dtype))
+
+
+class FusedTrainStep:
+    """Compile net forward + loss + backward + optimizer into one jit.
+
+    net: a (Hybrid)Block. loss: a gluon Loss block or callable
+    (pred_nd, label_nd) -> per-sample loss NDArray. trainer: gluon.Trainer
+    holding the net's params (its optimizer and schedulers drive the update;
+    num_update/lr_mult/wd_mult semantics are exact).
+
+    Restrictions (fall back to the imperative `Trainer.step` path outside
+    them): single context, dense params, optimizer in %s.
+    """ % sorted(_FUSABLE)
+
+    def __init__(self, net, loss, trainer, donate=True):
+        self._net = net
+        self._loss = loss
+        self._trainer = trainer
+        self._donate = donate
+        self._built = False
+        self._jitted = None
+
+    # ------------------------------------------------------------------
+    def _build(self, ctx, data, label):
+        trainer = self._trainer
+        opt = trainer._optimizer
+        kind = type(opt).__name__.lower()
+        if kind not in _FUSABLE:
+            raise NotImplementedError(
+                "FusedTrainStep supports optimizers %s; %r updates must use "
+                "the imperative Trainer.step path" % (sorted(_FUSABLE), kind))
+        self._host_fn, self._dev_fn = _FUSABLE[kind]
+        if getattr(opt, "multi_precision", False):
+            raise NotImplementedError(
+                "FusedTrainStep: multi_precision state layout not wired; "
+                "bf16 training needs no master copy — use dtype=bfloat16")
+        if len(trainer._contexts) != 1:
+            raise NotImplementedError(
+                "FusedTrainStep is single-context; use kvstore/Trainer.step "
+                "or parallel.ShardedTrainStep for multi-device")
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        if trainer._params_to_init:
+            trainer._init_params()
+        if trainer._kvstore is not None and trainer._update_on_kvstore:
+            raise NotImplementedError(
+                "FusedTrainStep requires update_on_kvstore=False "
+                "(the fused program IS the update)")
+
+        # deferred-shape params: finish init with one eager pre-pass (the
+        # same move HybridBlock.forward makes before building its CachedOp).
+        # predict mode: shape inference must not touch BatchNorm moving
+        # stats or consume RNG keys — step parity with the imperative path
+        # starts from identical state.
+        if any(p._data is None
+               for p in self._net.collect_params().values()):
+            args = data if isinstance(data, (list, tuple)) else [data]
+            prev = getattr(_TRACE_STATE, "ctx", None)
+            _TRACE_STATE.ctx = ctx   # suppress nested CachedOp compiles
+            try:
+                with autograd.pause(train_mode=False):
+                    if hasattr(self._net, "_forward_unhybridized"):
+                        self._net._forward_unhybridized(*args)
+                    else:
+                        self._net(*args)
+            finally:
+                _TRACE_STATE.ctx = prev
+
+        # params: trainable (differentiated + updated) vs aux (inputs only;
+        # BatchNorm stats update through the aux collector)
+        all_params = list(self._net.collect_params().values())
+        for p in all_params:
+            if p._stype != "default":
+                raise NotImplementedError(
+                    "FusedTrainStep does not cover sparse parameters")
+        self._train_params = [p for p in trainer._params
+                              if p.grad_req != "null"]
+        train_set = set(id(p) for p in self._train_params)
+        self._other_params = [p for p in all_params
+                              if id(p) not in train_set]
+        self._train_idx = [trainer._param2idx[p.name]
+                           for p in self._train_params]
+
+        # optimizer state, created by the optimizer itself (same shapes and
+        # dtypes as the imperative Updater would make)
+        self._states = [
+            opt.create_state_multi_precision(i, p.data(ctx))
+            for i, p in zip(self._train_idx, self._train_params)]
+
+        net, loss_blk = self._net, self._loss
+        train_nds = [p.data(ctx) for p in self._train_params]
+        other_nds = [p.data(ctx) for p in self._other_params]
+        self._train_nds, self._other_nds = train_nds, other_nds
+        dev_fn = self._dev_fn
+        holder = {}  # trace-time discoveries: aux targets, loss shape
+        self._holder = holder
+
+        def run(train_raws, other_raws, state_raws, lrs, wds, rescale,
+                data_raws, label_raw, rng_key):
+            def loss_fn(train_raws_):
+                from .. import random as _random
+                param_nds = train_nds + other_nds
+                saved = [(p._data, p._base, p._idx) for p in param_nds]
+                aux_updates = []
+                if not hasattr(_AUX_COLLECTOR, "stack"):
+                    _AUX_COLLECTOR.stack = []
+                _AUX_COLLECTOR.stack.append(aux_updates)
+                prev_trace = getattr(_TRACE_STATE, "ctx", None)
+                _TRACE_STATE.ctx = ctx
+                try:
+                    for p, raw in zip(train_nds, train_raws_):
+                        p._data, p._base, p._idx = raw, None, None
+                    for p, raw in zip(other_nds, other_raws):
+                        p._data, p._base, p._idx = raw, None, None
+                    _random.push_trace_key(rng_key)
+                    try:
+                        with autograd.pause(train_mode=True):
+                            in_nds = [nd.from_jax(r, ctx=ctx)
+                                      for r in data_raws]
+                            args = _regroup(in_nds, holder["in_fmt"])[0]
+                            if not isinstance(args, (list, tuple)):
+                                args = [args]
+                            lab = nd.from_jax(label_raw, ctx=ctx)
+                            out = net(*args)
+                            lvec = loss_blk(out, lab)
+                    finally:
+                        _random.pop_trace_key()
+                finally:
+                    _TRACE_STATE.ctx = prev_trace
+                    _AUX_COLLECTOR.stack.pop()
+                    for p, (d, b, i) in zip(param_nds, saved):
+                        p._data, p._base, p._idx = d, b, i
+                lraw = lvec._read()
+                holder["aux_targets"] = [t for t, _ in aux_updates]
+                # backward(): cotangent of ones over the loss vector = sum
+                return jnp.sum(lraw), (jnp.mean(lraw),
+                                       tuple(v for _, v in aux_updates))
+
+            (unused_total, (loss_mean, aux_new)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(train_raws)
+            new_train, new_states = [], []
+            for j in range(len(train_raws)):
+                w, s = dev_fn(opt, train_raws[j], grads[j], state_raws[j],
+                              lrs[j], wds[j], rescale)
+                new_train.append(w.astype(train_raws[j].dtype))
+                new_states.append(s)
+            return tuple(new_train), tuple(new_states), aux_new, loss_mean
+
+        donate = (0, 2) if self._donate else ()
+        self._jitted = jax.jit(run, donate_argnums=donate)
+        self._built = True
+
+    # ------------------------------------------------------------------
+    def __call__(self, data, label):
+        """Run one fused step; returns the mean loss as an NDArray."""
+        flat_data, in_fmt = _flatten(data, "input")
+        ctx = flat_data[0].context
+        if not self._built:
+            self._build(ctx, data, label)
+        self._holder["in_fmt"] = in_fmt
+
+        from .. import random as _random
+        trainer = self._trainer
+        opt = trainer._optimizer
+        batch_size = flat_data[0].shape[0]
+        opt.rescale_grad = trainer._scale / batch_size
+        scal = self._host_fn(opt, self._train_idx)
+
+        train_raws = tuple(p._read() for p in self._train_nds)
+        other_raws = tuple(p._read() for p in self._other_nds)
+        state_raws = tuple(_state_raws(s) for s in self._states)
+        rng_key = _random.take_key(ctx)
+
+        new_train, new_states, aux_new, loss_mean = self._jitted(
+            train_raws, other_raws, state_raws,
+            jnp.asarray(scal["lrs"]), jnp.asarray(scal["wds"]),
+            jnp.float32(opt.rescale_grad),
+            tuple(a._read() for a in flat_data), label._read(), rng_key)
+
+        with autograd.pause():
+            for p_nd, raw in zip(self._train_nds, new_train):
+                p_nd._write(raw)
+            for s, raws in zip(self._states, new_states):
+                _state_write(s, raws)
+            for t, v in zip(self._holder.get("aux_targets", ()), aux_new):
+                t._write(v)
+        return nd.from_jax(loss_mean, ctx=ctx)

@@ -230,7 +230,13 @@ class BatchNorm(HybridBlock):
             p.shape = (channels,)
 
     def cast(self, dtype):
-        if _np.dtype(dtype).name == "float16":
+        # BN params/stats stay fp32 under half-precision casts (reference AMP
+        # keeps BatchNorm fp32; bfloat16 is the TPU half type)
+        try:
+            name = _np.dtype(dtype).name
+        except TypeError:
+            name = str(dtype)
+        if name in ("float16", "bfloat16"):
             dtype = "float32"
         super().cast(dtype)
 

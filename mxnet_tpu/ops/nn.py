@@ -61,13 +61,14 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    # no preferred_element_type: the MXU accumulates bf16 convs in fp32
+    # natively, and a widened output dtype breaks the conv transpose rule
+    # (fp32 cotangent x bf16 weight) under autograd
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    out = out.astype(data.dtype)
+        feature_group_count=num_group)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -176,9 +177,14 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         mean = jnp.mean(x32, axis=red)
         var = jnp.var(x32, axis=red)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
-    out = (data - mean.reshape(shape).astype(data.dtype)) * \
-        inv.reshape(shape) * g.reshape(shape) + beta.reshape(shape)
+    # normalize in fp32, emit in the input dtype (reference cudnn BN does
+    # fp32 internal math for fp16 inputs) — keeps a bf16 conv chain bf16
+    # even when gamma/beta/stats are kept fp32 by BatchNorm.cast
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    out = ((data.astype(jnp.float32) -
+            mean.reshape(shape).astype(jnp.float32)) * inv.reshape(shape) *
+           g.reshape(shape).astype(jnp.float32) +
+           beta.reshape(shape).astype(jnp.float32)).astype(data.dtype)
     if output_mean_var:
         return out, mean, var
     return out

@@ -1,0 +1,114 @@
+"""FusedTrainStep: one-jit Gluon training must match the imperative
+`loss.backward(); trainer.step()` path exactly (same ops, same scalars).
+reference behavior: SURVEY.md §3.2 call stack."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _mlp(seed, bn=False, dropout=0.0):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        if bn:
+            net.add(nn.BatchNorm())
+        if dropout:
+            net.add(nn.Dropout(dropout))
+        net.add(nn.Dense(8))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian"), ctx=mx.cpu())
+    return net
+
+
+def _data(n=16, d=12, classes=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = nd.array(rng.randn(n, d).astype(np.float32))
+    y = nd.array(rng.randint(0, classes, (n,)).astype(np.float32))
+    return x, y
+
+
+@pytest.mark.parametrize("optimizer,opt_args", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+])
+def test_fused_matches_imperative(optimizer, opt_args):
+    mx.random.seed(7)
+    net_a = _mlp(0)
+    x, y = _data()
+    net_a(x)  # init shapes
+    # clone params into a second net
+    net_b = _mlp(1)
+    net_b(x)
+    for (ka, pa), (kb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        pb.set_data(pa.data().copy())
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr_a = gluon.Trainer(net_a.collect_params(), optimizer, dict(opt_args))
+    tr_b = gluon.Trainer(net_b.collect_params(), optimizer, dict(opt_args))
+    fused = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+
+    for step in range(4):
+        with autograd.record():
+            la = loss_fn(net_a(x), y)
+        la.backward()
+        tr_a.step(x.shape[0])
+        lb = fused(x, y)
+        np.testing.assert_allclose(float(la.mean().asnumpy()),
+                                   float(lb.asnumpy()), rtol=1e-5, atol=1e-6)
+    for (ka, pa), (kb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg="param %s diverged" % ka)
+
+
+def test_fused_bn_dropout_trains():
+    """BatchNorm aux stats update + dropout RNG inside the fused program."""
+    mx.random.seed(11)
+    net = _mlp(2, bn=True, dropout=0.3)
+    x, y = _data(n=32)
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    fused = gluon.FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    bn = [p for name, p in net.collect_params().items()
+          if "running_mean" in name][0]
+    before = bn.data().asnumpy().copy()
+    losses = [float(fused(x, y).asnumpy()) for _ in range(15)]
+    assert losses[-1] < losses[0], losses
+    assert not np.allclose(bn.data().asnumpy(), before), \
+        "BatchNorm running stats did not update through the fused step"
+
+
+def test_fused_lr_scheduler_advances():
+    """Scheduler state (num_update) must advance per fused step — the lr is
+    host-computed and fed as a device scalar each call."""
+    mx.random.seed(13)
+    net = _mlp(3)
+    x, y = _data()
+    net(x)
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.4, "lr_scheduler": sched})
+    fused = gluon.FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    for _ in range(5):
+        fused(x, y)
+    assert tr._optimizer.num_update == 5
+    assert tr.learning_rate < 0.4
+
+
+def test_fused_hybridized_net():
+    """A hybridized net inlines into the fused trace (no nested CachedOp)."""
+    mx.random.seed(17)
+    net = _mlp(4)
+    net.hybridize()
+    x, y = _data()
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    fused = gluon.FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    losses = [float(fused(x, y).asnumpy()) for _ in range(10)]
+    assert losses[-1] < losses[0]
