@@ -144,6 +144,19 @@ def _state_raws(state):
     return state._read()
 
 
+def _state_cast_like(new, ref):
+    """Cast an updated state pytree to the carried state's dtypes INSIDE the
+    traced program, so the host-side write-back never dispatches eager cast
+    ops (bf16 momentum + f32 scalar lr promotes to f32 otherwise; at one tiny
+    eager op per parameter per step those casts dominate wrapper overhead on
+    a busy device)."""
+    if new is None:
+        return None
+    if isinstance(new, (tuple, list)):
+        return tuple(_state_cast_like(n, r) for n, r in zip(new, ref))
+    return new.astype(new.dtype) if ref is None else new.astype(ref.dtype)
+
+
 def _state_write(state, raws):
     if state is None:
         return
@@ -297,11 +310,13 @@ class FusedTrainStep:
                 w, s = dev_fn(opt, train_raws[j], grads[j], state_raws[j],
                               lrs[j], wds[j], rescale)
                 new_train.append(w.astype(train_raws[j].dtype))
-                new_states.append(s)
+                new_states.append(_state_cast_like(s, state_raws[j]))
             return tuple(new_train), tuple(new_states), aux_new, loss_mean
 
-        donate = (0, 2) if self._donate else ()
-        self._jitted = jax.jit(run, donate_argnums=donate)
+        self._run = run
+        self._donate_nums = (0, 2) if self._donate else ()
+        self._programs = {}  # input-nesting key -> jitted program (Weak #10)
+        self._scal_cache = None  # (lrs_np, wds_np, rescale) -> device arrays
         self._built = True
 
     # ------------------------------------------------------------------
@@ -311,7 +326,14 @@ class FusedTrainStep:
         ctx = flat_data[0].context
         if not self._built:
             self._build(ctx, data, label)
+        # programs are keyed by input nesting: a call with equal shapes but a
+        # different pytree structure must not reuse a stale trace
         self._holder["in_fmt"] = in_fmt
+        jitted = self._programs.get(repr(in_fmt))
+        if jitted is None:
+            jitted = jax.jit(self._run, donate_argnums=self._donate_nums)
+            self._programs[repr(in_fmt)] = jitted
+        self._jitted = jitted
 
         from .. import random as _random
         trainer = self._trainer
@@ -320,15 +342,27 @@ class FusedTrainStep:
         opt.rescale_grad = trainer._scale / batch_size
         scal = self._host_fn(opt, self._train_idx)
 
+        # lr/wd/rescale change rarely (only via scheduler / set_learning_rate
+        # / batch-size change); re-upload to device only when the host values
+        # do change, else each step pays three H2D transfers
+        cache = self._scal_cache
+        if (cache is None or cache[0] != opt.rescale_grad
+                or not _np.array_equal(cache[1], scal["lrs"])
+                or not _np.array_equal(cache[2], scal["wds"])):
+            cache = (opt.rescale_grad, scal["lrs"], scal["wds"],
+                     jnp.asarray(scal["lrs"]), jnp.asarray(scal["wds"]),
+                     jnp.float32(opt.rescale_grad))
+            self._scal_cache = cache
+        lrs_dev, wds_dev, rescale_dev = cache[3], cache[4], cache[5]
+
         train_raws = tuple(p._read() for p in self._train_nds)
         other_raws = tuple(p._read() for p in self._other_nds)
         state_raws = tuple(_state_raws(s) for s in self._states)
         rng_key = _random.take_key(ctx)
 
-        new_train, new_states, aux_new, loss_mean = self._jitted(
+        new_train, new_states, aux_new, loss_mean = jitted(
             train_raws, other_raws, state_raws,
-            jnp.asarray(scal["lrs"]), jnp.asarray(scal["wds"]),
-            jnp.float32(opt.rescale_grad),
+            lrs_dev, wds_dev, rescale_dev,
             tuple(a._read() for a in flat_data), label._read(), rng_key)
 
         with autograd.pause():

@@ -1,0 +1,95 @@
+"""Round-3 perf triage: locate the fused-Gluon vs functional gap.
+
+Measures three things at batch 256 / 224x224 on the real chip:
+  A. full user-facing FusedTrainStep call (what bench.py measures)
+  B. the underlying jitted program called directly with pre-staged args
+     (device program throughput, no Python wrapper)
+  C. per-step host wrapper time (A minus B, also measured directly)
+If B matches the functional path, the gap is host overhead -> fix wrapper.
+If B is slow too, the gap is in the compiled graph (layout / graph diff).
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+LR, MOMENTUM = 0.1, 0.9
+BATCH, SIZE, STEPS, WARMUP = 256, 224, 50, 10
+
+ctx = mx.tpu()
+mx.random.seed(0)
+with mx.Context(ctx):
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier(rnd_type="gaussian"), ctx=ctx)
+    net.cast("bfloat16")
+    net.hybridize(static_alloc=True)
+
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(BATCH, 3, SIZE, SIZE), ctx=ctx, dtype="bfloat16")
+    y = nd.array(rng.randint(0, 10, (BATCH,)), ctx=ctx, dtype="float32")
+    net(x)
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": LR, "momentum": MOMENTUM})
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer)
+
+    # ---- A: full user-facing call ----
+    for _ in range(WARMUP):
+        loss = fused(x, y)
+    loss.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss = fused(x, y)
+    loss.wait_to_read()
+    a = (time.perf_counter() - t0) / STEPS
+    print("A full FusedTrainStep call : %.2f ms/step  (%.0f img/s)"
+          % (a * 1e3, BATCH / a))
+
+    # ---- B: raw jitted program, args pre-staged, donation-safe loop ----
+    from mxnet_tpu import random as _random
+    fs = fused
+    opt = trainer._optimizer
+    scal = fs._host_fn(opt, fs._train_idx)
+    lrs = jnp.asarray(scal["lrs"]); wds = jnp.asarray(scal["wds"])
+    rescale = jnp.float32(opt.rescale_grad or (1.0 / BATCH))
+    train_raws = tuple(p._read() for p in fs._train_nds)
+    other_raws = tuple(p._read() for p in fs._other_nds)
+    from mxnet_tpu.gluon.fused_step import _state_raws
+    state_raws = tuple(_state_raws(s) for s in fs._states)
+    data_raws = (x._read(),)
+    label_raw = y._read()
+    key = _random.take_key(ctx)
+
+    def run_once(tr, st):
+        return fs._jitted(tr, other_raws, st, lrs, wds, rescale,
+                          data_raws, label_raw, key)
+
+    for _ in range(WARMUP):
+        train_raws, state_raws, aux, lm = run_once(train_raws, state_raws)
+    jax.block_until_ready(lm)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        train_raws, state_raws, aux, lm = run_once(train_raws, state_raws)
+    jax.block_until_ready(lm)
+    b = (time.perf_counter() - t0) / STEPS
+    print("B raw jitted program       : %.2f ms/step  (%.0f img/s)"
+          % (b * 1e3, BATCH / b))
+    print("C host wrapper overhead    : %.2f ms/step" % ((a - b) * 1e3))
+
+    # XLA cost view: compiled flops estimate
+    lowered = fs._jitted.lower(train_raws, other_raws, state_raws, lrs, wds,
+                               rescale, data_raws, label_raw, key)
+    compiled = lowered.compile()
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        print("flops=%.3e  bytes=%.3e" % (ca.get("flops", -1),
+                                          ca.get("bytes accessed", -1)))
+    except Exception as e:
+        print("cost_analysis unavailable:", e)
