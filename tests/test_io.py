@@ -54,3 +54,246 @@ def test_prefetching_iter():
         n += 1
         assert batch.data[0].shape == (4, 2)
     assert n == 3
+
+
+# ---------------------------------------------------------------------------
+# C++-backed iterator classes (reference: src/io/iter_image_recordio_2.cc,
+# iter_csv.cc, iter_mnist.cc)
+# ---------------------------------------------------------------------------
+
+def _make_rec(tmp_path, n=24, size=40, classes=4, with_idx=True):
+    """Write a tiny .rec(+.idx) pack of random images via recordio.pack_img."""
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, classes, n)
+    if with_idx:
+        w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    else:
+        w = recordio.MXRecordIO(rec_path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+        hdr = recordio.IRHeader(0, float(labels[i]), i, 0)
+        buf = recordio.pack_img(hdr, img, img_fmt=".jpg")
+        if with_idx:
+            w.write_idx(i, buf)
+        else:
+            w.write(buf)
+    w.close()
+    return rec_path, idx_path, labels
+
+
+def test_image_record_iter(tmp_path):
+    rec, idx, labels = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=8, shuffle=False, preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b.data[0].shape == (8, 3, 32, 32)
+    assert b.label[0].shape == (8,)
+    np.testing.assert_array_equal(b.label[0].asnumpy(), labels[:8])
+    # reset reproduces the epoch
+    it.reset()
+    again = list(it)
+    np.testing.assert_allclose(again[0].data[0].asnumpy(),
+                               batches[0].data[0].asnumpy())
+
+
+def test_image_record_iter_no_idx_round_batch(tmp_path):
+    rec, _, labels = _make_rec(tmp_path, n=10, with_idx=False)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 32, 32), batch_size=8,
+        round_batch=True, preprocess_threads=1)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 6  # 10 % 8 -> wraps 6 from the epoch head
+
+
+def test_image_record_iter_augment_and_partition(tmp_path):
+    rec, idx, _ = _make_rec(tmp_path, n=20)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=5, shuffle=True, seed=7, rand_crop=True, rand_mirror=True,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0, std_r=58.0, std_g=57.0,
+        std_b=57.0, part_index=0, num_parts=2, preprocess_threads=2)
+    batches = list(it)
+    assert len(batches) == 2  # 10-record partition / 5
+    x = batches[0].data[0].asnumpy()
+    assert abs(x.mean()) < 2.0  # normalized scale, not raw pixels
+
+
+def test_image_record_iter_trains_zoo_resnet(tmp_path):
+    """The verdict's done-criterion: ImageRecordIter feeds a model_zoo
+    resnet through a real fused training step."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    rec, idx, _ = _make_rec(tmp_path, n=16, size=36, classes=4)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=8, shuffle=True, seed=1, preprocess_threads=2,
+        scale=1.0 / 255)
+    mx.random.seed(11)
+    net = vision.resnet18_v1(classes=4)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    first = next(iter(it))
+    net(first.data[0])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.005, "momentum": 0.9})
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer)
+    losses = []
+    for _ in range(6):  # epochs over the tiny pack (memorization)
+        it.reset()
+        epoch = [float(fused(batch.data[0], batch.label[0]).asnumpy())
+                 for batch in it]
+        losses.append(sum(epoch) / len(epoch))
+    assert losses[-1] < losses[0], losses
+
+
+def test_csv_iter(tmp_path):
+    data_csv = str(tmp_path / "d.csv")
+    label_csv = str(tmp_path / "l.csv")
+    rng = np.random.RandomState(3)
+    d = rng.rand(11, 6).astype(np.float32)
+    l = rng.randint(0, 3, (11, 1)).astype(np.float32)
+    np.savetxt(data_csv, d, delimiter=",")
+    np.savetxt(label_csv, l, delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_csv, data_shape=(2, 3),
+                       label_csv=label_csv, batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2, 3)
+    assert batches[2].pad == 1
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               d[:4].reshape(4, 2, 3), rtol=1e-5)
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(), l[:4, 0])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_mnist_iter(tmp_path):
+    import struct as _struct
+    # synthesize idx-ubyte files (magic 2051 images / 2049 labels)
+    n, h, w = 30, 28, 28
+    rng = np.random.RandomState(5)
+    imgs = rng.randint(0, 255, (n, h, w), dtype=np.uint8)
+    labs = rng.randint(0, 10, n).astype(np.uint8)
+    img_path = str(tmp_path / "images-idx3-ubyte")
+    lab_path = str(tmp_path / "labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(_struct.pack(">IIII", 2051, n, h, w))
+        f.write(imgs.tobytes())
+    with open(lab_path, "wb") as f:
+        f.write(_struct.pack(">II", 2049, n))
+        f.write(labs.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lab_path, batch_size=8,
+                         shuffle=False, silent=True)
+    batches = list(it)
+    assert len(batches) == 3  # tail dropped like the reference
+    assert batches[0].data[0].shape == (8, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy()[:, 0],
+                               imgs[:8].astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(batches[0].label[0].asnumpy(), labs[:8])
+    # flat mode
+    it2 = mx.io.MNISTIter(image=img_path, label=lab_path, batch_size=8,
+                          shuffle=True, flat=True, seed=2, silent=True)
+    b = next(iter(it2))
+    assert b.data[0].shape == (8, 784)
+
+
+def test_image_record_iter_exhaustion_no_hang(tmp_path):
+    """Iterating past the epoch without reset() must raise StopIteration
+    immediately, not block on the prefetch queue."""
+    rec, idx, _ = _make_rec(tmp_path, n=8)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 32, 32), batch_size=8,
+                               preprocess_threads=1)
+    assert len(list(it)) == 1
+    assert len(list(it)) == 0  # immediate, no deadlock
+    it.reset()
+    assert len(list(it)) == 1
+
+
+def test_image_record_iter_seeded_augment_reproducible(tmp_path):
+    rec, idx, _ = _make_rec(tmp_path, n=12)
+    def epoch():
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 24, 24),
+            batch_size=4, shuffle=True, seed=5, rand_crop=True,
+            rand_mirror=True, preprocess_threads=3)
+        return [b.data[0].asnumpy() for b in it]
+    a, b = epoch(), epoch()
+    assert len(a) == len(b) == 3
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_csv_iter_no_round_batch_keeps_tail(tmp_path):
+    data_csv = str(tmp_path / "d2.csv")
+    np.savetxt(data_csv, np.arange(10, dtype=np.float32).reshape(10, 1),
+               delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_csv, data_shape=(1,), batch_size=4,
+                       round_batch=False)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[2].pad == 2
+    # tail values served, pad filled with the last row (not wrapped)
+    np.testing.assert_array_equal(batches[2].data[0].asnumpy()[:2, 0], [8, 9])
+
+
+def test_image_record_iter_pad_exceeds_epoch(tmp_path):
+    """batch_size > 2x records: fill tiles the tiny epoch, no garbage rows."""
+    rec, idx, labels = _make_rec(tmp_path, n=3)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 32, 32), batch_size=8,
+                               round_batch=True, preprocess_threads=1)
+    b = next(iter(it))
+    assert b.pad == 5
+    got = b.label[0].asnumpy()
+    exp = np.tile(labels, 3)[:8]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_image_record_iter_mean_img_computed(tmp_path):
+    """Missing mean_img file is computed over the pack and persisted
+    (reference: src/io/iter_normalize.h)."""
+    rec, idx, _ = _make_rec(tmp_path, n=6, size=32)
+    mean_path = str(tmp_path / "mean.bin")
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 32, 32), batch_size=6,
+                               mean_img=mean_path, preprocess_threads=1)
+    assert os.path.exists(mean_path)
+    b = next(iter(it))
+    # mean-subtracted batch over the whole pack has ~zero mean
+    assert abs(b.data[0].asnumpy().mean()) < 1.0
+    # second iterator loads the saved file and agrees
+    it2 = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                                data_shape=(3, 32, 32), batch_size=6,
+                                mean_img=mean_path, preprocess_threads=1)
+    b2 = next(iter(it2))
+    np.testing.assert_allclose(b2.data[0].asnumpy(), b.data[0].asnumpy())
+
+
+def test_csv_iter_wrapped_lines(tmp_path):
+    """Rows may wrap file lines (np.loadtxt-reshape semantics): 4 logical
+    rows of width 6 written 4 values per line must round-trip exactly."""
+    path = str(tmp_path / "wrap.csv")
+    vals = np.arange(24, dtype=np.float32)
+    with open(path, "w") as f:
+        for i in range(0, 24, 4):
+            f.write(",".join(str(v) for v in vals[i:i + 4]) + "\n")
+    it = mx.io.CSVIter(data_csv=path, data_shape=(6,), batch_size=1)
+    rows = [b.data[0].asnumpy()[0] for b in it]
+    assert len(rows) == 4
+    np.testing.assert_array_equal(np.concatenate(rows), vals)
+    # a single long line holding several rows also works
+    path2 = str(tmp_path / "long.csv")
+    with open(path2, "w") as f:
+        f.write(",".join(str(v) for v in vals) + "\n")
+    it2 = mx.io.CSVIter(data_csv=path2, data_shape=(6,), batch_size=3)
+    b = next(iter(it2))
+    assert b.data[0].shape == (3, 6) and b.pad == 0
