@@ -104,14 +104,15 @@ def predict_mode():
 # ---------------------------------------------------------------------------
 class _Node:
     __slots__ = ("op_name", "inputs", "n_out", "out_meta", "vjp_fn",
-                 "out_cots", "alive", "__weakref__")
+                 "primal_fn", "out_cots", "alive", "__weakref__")
 
-    def __init__(self, op_name, inputs, out_meta, vjp_fn):
+    def __init__(self, op_name, inputs, out_meta, vjp_fn, primal_fn=None):
         self.op_name = op_name
         self.inputs = inputs          # list[NDArray] (object refs)
         self.n_out = len(out_meta)
         self.out_meta = out_meta      # [(shape, dtype)] for zero-filling
         self.vjp_fn = vjp_fn
+        self.primal_fn = primal_fn    # raw-array fn; enables create_graph
         self.out_cots = None          # filled during backward
         self.alive = True
 
@@ -121,11 +122,11 @@ def mark_variable(nd, grad_req="write"):
     nd._grad_req = grad_req
 
 
-def record_op(op_name, input_nds, output_nds, vjp_fn):
+def record_op(op_name, input_nds, output_nds, vjp_fn, primal_fn=None):
     """Append one executed op to the tape (reference: Imperative::RecordOp)."""
     st = _st()
     meta = [(o.shape, o.dtype) for o in output_nds]
-    node = _Node(op_name, list(input_nds), meta, vjp_fn)
+    node = _Node(op_name, list(input_nds), meta, vjp_fn, primal_fn)
     st.tape.append(weakref.ref(node))
     for inp in input_nds:
         inp._tape_used = True   # mutating it now would corrupt grad routing
@@ -232,13 +233,22 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """reference: python/mxnet/autograd.py (grad) — returns grads w.r.t.
-    `variables`; never touches their `.grad` buffers."""
+    `variables`; never touches their `.grad` buffers.
+
+    With create_graph=True the returned gradients are themselves recorded on
+    the tape (differentiable to any order): the recorded subgraph between
+    `variables` and `heads` is re-executed as a pure jax function and the
+    whole gradient computation becomes one new tape node whose pullback is
+    `jax.vjp` of that function — vjp-of-vjp with nothing hand-derived."""
     from .ndarray.ndarray import NDArray, zeros
     heads = heads if isinstance(heads, (list, tuple)) else [heads]
     single = not isinstance(variables, (list, tuple))
     variables = [variables] if single else list(variables)
     if retain_graph is None:
         retain_graph = create_graph
+    if create_graph:
+        outs = _grad_create_graph(heads, variables, head_grads)
+        return outs[0] if single else outs
     if head_grads is None:
         head_grads = [None] * len(heads)
     head_grads = [g._read() if hasattr(g, "_read") else g for g in head_grads]
@@ -252,6 +262,91 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         else:
             outs.append(zeros(v.shape, ctx=v._ctx, dtype=v.dtype))
     return outs[0] if single else outs
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """Differentiable gradients via subgraph re-execution (see grad())."""
+    from .ndarray.ndarray import NDArray
+
+    var_pos0 = {id(v) for v in variables}
+    # topological order of the nodes reachable from `heads` DOWN TO the
+    # `variables` (iterative postorder: the tape can be thousands of ops
+    # deep). Anything strictly upstream of the variables is a constant of
+    # the differentiation — never replayed, so a primal-less node there
+    # (custom Function, etc.) is irrelevant, not an error.
+    ordered, seen = [], set()
+    stack = [(e[0], False) for h in heads
+             if id(h) not in var_pos0
+             and (e := h._autograd_node) is not None]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            ordered.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node.primal_fn is None:
+            raise NotImplementedError(
+                "autograd.grad(create_graph=True): op %r was recorded "
+                "without a re-executable primal (custom autograd.Function); "
+                "higher-order gradients through it are not supported"
+                % node.op_name)
+        stack.append((node, True))
+        for inp in node.inputs:
+            if id(inp) in var_pos0:  # differentiation frontier
+                continue
+            e = inp._autograd_node
+            if e is not None and id(e[0]) not in seen:
+                stack.append((e[0], False))
+
+    var_pos = {id(v): j for j, v in enumerate(variables)}
+    node_ids = seen
+
+    def replay(var_raws):
+        env = {}
+
+        def val(ndv):
+            j = var_pos.get(id(ndv))
+            if j is not None:
+                return var_raws[j]
+            e = ndv._autograd_node
+            if e is not None and id(e[0]) in node_ids:
+                return env[(id(e[0]), e[1])]
+            return ndv._read()  # constant leaf
+
+        for node in ordered:
+            outs = node.primal_fn(*[val(i) for i in node.inputs])
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+            for s, o in enumerate(outs):
+                env[(id(node), s)] = o
+        return tuple(val(h) for h in heads)
+
+    if head_grads is None:
+        cots = tuple(jnp.ones(h.shape, dtype=h.dtype) for h in heads)
+    else:
+        cots = tuple(
+            (g._read() if hasattr(g, "_read") else jnp.asarray(g))
+            if g is not None else jnp.ones(h.shape, dtype=h.dtype)
+            for h, g in zip(heads, head_grads))
+
+    def grad_fn(*var_raws):
+        _, pull = jax.vjp(lambda *vr: replay(vr), *var_raws)
+        gs = tuple(g.astype(v.dtype) for g, v in zip(pull(cots), variables))
+        # single-output nodes carry a bare cotangent on the tape, so a
+        # single-variable grad must return a bare array
+        return gs[0] if len(gs) == 1 else gs
+
+    var_raws = [v._read() for v in variables]
+    out_raws, g_vjp = jax.vjp(grad_fn, *var_raws)
+    if len(variables) == 1:
+        out_raws = (out_raws,)
+    outs = [NDArray(r, ctx=v._ctx) for r, v in zip(out_raws, variables)]
+    # record so the grads are differentiable again (grad-of-grad-of-grad
+    # works: the recorded primal is grad_fn itself)
+    record_op("_grad_create_graph", list(variables), outs, g_vjp,
+              primal_fn=grad_fn)
+    return outs
 
 
 class Function:

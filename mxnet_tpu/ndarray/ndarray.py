@@ -574,7 +574,7 @@ def _invoke(op_name, *args, out=None, **kwargs):
         outputs = _wrap_out(out_raw, ctx)
         autograd.record_op(op_name, [args[p] for p in nd_positions],
                            outputs if isinstance(outputs, list) else [outputs],
-                           vjp_fn)
+                           vjp_fn, primal_fn=closed)
     else:
         out_raw = fn(*raw_args, **kwargs)
         outputs = _wrap_out(out_raw, ctx)

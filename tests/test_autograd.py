@@ -258,3 +258,122 @@ def test_dropout_mode_always():
     y = nd.Dropout(x, p=0.5, mode="always")  # outside any train scope
     frac = (y.asnumpy() == 0).mean()
     assert 0.3 < frac < 0.7
+
+
+# ---------------------------------------------------------------------------
+# higher-order autograd: create_graph=True (reference: python/mxnet/autograd.py
+# (grad) — grad-of-grad)
+# ---------------------------------------------------------------------------
+
+def test_grad_create_graph_second_order():
+    """d2/dx2 of x^3 = 6x, via grad(create_graph=True) then backward()."""
+    x = nd.array(np.array([1.5, -2.0, 0.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        dx = autograd.grad(y, x, create_graph=True)
+        z = dx.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6.0 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_vs_finite_difference():
+    """Hessian-vector via double grad matches finite differences of the
+    first gradient, through a multi-op chain."""
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+
+    def f(t):
+        return (t * t.exp() + nd.sin(t * 0.5)).sum()
+
+    with autograd.record():
+        y = f(x)
+        dx = autograd.grad(y, x, create_graph=True)
+        s = (dx * dx).sum()          # uses the differentiable first grad
+    s.backward()
+    # finite difference of g(x) = sum(grad_f(x)^2)
+    eps = 1e-3
+    def g(v):
+        t = nd.array(v.astype(np.float32))
+        t.attach_grad()
+        with autograd.record():
+            yy = f(t)
+        yy.backward()
+        return float((t.grad * t.grad).sum().asnumpy())
+    fd = np.array([(g(xv + eps * e) - g(xv - eps * e)) / (2 * eps)
+                   for e in np.eye(4, dtype=np.float32)])
+    np.testing.assert_allclose(x.grad.asnumpy(), fd, rtol=2e-2, atol=2e-2)
+
+
+def test_grad_create_graph_third_order():
+    """x^4: third derivative 24x via grad -> grad -> backward."""
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x) * (x * x)
+        d1 = autograd.grad(y, x, create_graph=True)      # 4x^3
+        d2 = autograd.grad(d1.sum(), x, create_graph=True)  # 12x^2
+        s = d2.sum()
+    s.backward()                                          # 24x
+    np.testing.assert_allclose(x.grad.asnumpy(), 24.0 * x.asnumpy(),
+                               rtol=1e-4)
+
+
+def test_grad_create_graph_custom_function_raises():
+    class MyFn(autograd.Function):
+        def forward(self, a):
+            return a * 2
+        def backward(self, dy):
+            return dy * 2
+
+    x = nd.array(np.ones(3, np.float32))
+    x.attach_grad()
+    fn = MyFn()
+    with autograd.record():
+        y = fn(x)
+        try:
+            autograd.grad(y.sum(), x, create_graph=True)
+            raised = False
+        except NotImplementedError as e:
+            raised = True
+            assert "MyFn" in str(e)
+    assert raised
+
+
+def test_grad_create_graph_multi_head_and_head_grads():
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * x        # dy1/dx = 2x
+        y2 = x * x * x    # dy2/dx = 3x^2
+        dx = autograd.grad([y1, y2], x, create_graph=True,
+                           head_grads=[nd.ones((2,)), None])
+        s = dx.sum()      # d/dx (2x + 3x^2) = 2 + 6x
+    s.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2.0 + 6.0 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_stops_at_variables():
+    """Nodes strictly upstream of `variables` are constants of the
+    differentiation: a primal-less custom Function there must not raise."""
+    class MyFn(autograd.Function):
+        def forward(self, a):
+            return a * 2
+        def backward(self, dy):
+            return dy * 2
+
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    fn = MyFn()
+    with autograd.record():
+        y = fn(x)          # primal-less node, upstream of the variable
+        y.attach_grad()    # mark y itself (grad wrt y, not x)
+        z = y * y
+        dy = autograd.grad(z, y, create_graph=True)  # must not raise
+        s = dy.sum()
+    s.backward()
+    # d2z/dy2 = 2
+    np.testing.assert_allclose(y.grad.asnumpy(), [2.0, 2.0], rtol=1e-6)
