@@ -1,6 +1,6 @@
-"""contrib namespace. reference: python/mxnet/contrib/ — AMP now;
-quantization/onnx are documented out-of-scope for the TPU build
-(SURVEY.md §2.1)."""
+"""contrib namespace. reference: python/mxnet/contrib/ — AMP +
+INT8 quantization; onnx remains documented out-of-scope (SURVEY.md §2.1)."""
 from . import amp
+from . import quantization
 
-__all__ = ["amp"]
+__all__ = ["amp", "quantization"]

@@ -11,4 +11,5 @@ from . import nn          # noqa: F401  FC/conv/pool/norm/softmax/dropout
 from . import random_ops  # noqa: F401  sampling ops
 from . import optimizer_ops  # noqa: F401  sgd/adam/... update kernels
 from . import rnn_ops      # noqa: F401  fused RNN/LSTM/GRU via lax.scan
+from . import quantization_ops  # noqa: F401  int8 quantize/dequant/QFC/QConv
 from . import shape_hints  # noqa: F401  FInferShape-style param-shape hints

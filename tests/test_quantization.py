@@ -1,0 +1,160 @@
+"""INT8 quantization (reference suite: tests/python/quantization/
+test_quantization.py — quantize_v2 roundtrip, quantized FC/conv vs fp32,
+calibrated quantize_net accuracy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.contrib import quantization as qz
+from mxnet_tpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(64, 32).astype(np.float32) * 3)
+    q, mn, mxr = nd.invoke("_contrib_quantize_v2", x)
+    assert q.dtype == np.int8
+    back = nd.invoke("_contrib_dequantize", q, mn, mxr)
+    err = np.abs(back.asnumpy() - x.asnumpy()).max()
+    assert err <= float(mxr.asnumpy()) / 127.0 + 1e-6
+
+
+def test_quantize_v2_calibrated_range_clips():
+    x = nd.array(np.array([-10.0, -1.0, 0.5, 20.0], np.float32))
+    q, mn, mxr = nd.invoke("_contrib_quantize_v2", x,
+                           min_calib_range=-2.0, max_calib_range=2.0)
+    back = nd.invoke("_contrib_dequantize", q, mn, mxr).asnumpy()
+    assert back[3] <= 2.0 + 1e-6  # clipped at the calibrated threshold
+    np.testing.assert_allclose(back[2], 0.5, atol=2.0 / 127)
+
+
+def test_quantized_fully_connected_vs_fp32():
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 16).astype(np.float32)
+    w = rng.randn(4, 16).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    xq, xmn, xmx = nd.invoke("_contrib_quantize_v2", nd.array(x))
+    wq, wmn, wmx = nd.invoke("_contrib_quantize_v2", nd.array(w))
+    bq, bmn, bmx = nd.invoke("_contrib_quantize_v2", nd.array(b))
+    out32, omn, omx = nd.invoke(
+        "_contrib_quantized_fully_connected", xq, wq, bq, xmn, xmx,
+        wmn, wmx, bmn, bmx, num_hidden=4)
+    assert out32.dtype == np.int32
+    deq = nd.invoke("_contrib_dequantize", out32, omn, omx).asnumpy()
+    ref = x @ w.T + b
+    # int8 matmul: relative tolerance scales with the value magnitudes
+    assert np.abs(deq - ref).max() / max(np.abs(ref).max(), 1) < 0.05
+
+
+def test_quantized_conv_vs_fp32():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(5, 3, 3, 3).astype(np.float32)
+    xq, xmn, xmx = nd.invoke("_contrib_quantize_v2", nd.array(x))
+    wq, wmn, wmx = nd.invoke("_contrib_quantize_v2", nd.array(w))
+    out32, omn, omx = nd.invoke(
+        "_contrib_quantized_conv", xq, wq, None, xmn, xmx, wmn, wmx,
+        None, None, kernel=(3, 3), pad=(1, 1), num_filter=5, no_bias=True)
+    deq = nd.invoke("_contrib_dequantize", out32, omn, omx).asnumpy()
+    ref = np.asarray(mx.nd.invoke(
+        "Convolution", nd.array(x), nd.array(w), kernel=(3, 3), pad=(1, 1),
+        num_filter=5, no_bias=True).asnumpy())
+    assert np.abs(deq - ref).max() / max(np.abs(ref).max(), 1) < 0.05
+
+
+def test_entropy_threshold_ignores_outliers():
+    """KL calibration should pick a threshold well below a lone outlier."""
+    rng = np.random.RandomState(3)
+    c = qz._Collector()
+    bulk = rng.randn(20000).astype(np.float32)
+    data = np.concatenate([bulk, [500.0]])
+    c.update("layer", data)
+    t_naive = qz.calib_thresholds(c, "naive")["layer"]
+    t_entropy = qz.calib_thresholds(c, "entropy")["layer"]
+    assert t_naive >= 499.0
+    assert t_entropy < 100.0  # far below the 500 outlier
+
+
+def _calib_batches(rng, n, shape):
+    return [nd.array(rng.randn(*shape).astype(np.float32)) for _ in range(n)]
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_net_mlp(calib_mode):
+    mx.random.seed(4)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(32,
+                activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(32, 20).astype(np.float32))
+    ref = net(x).asnumpy()
+    qnet = qz.quantize_net(
+        net, calib_mode=calib_mode,
+        calib_data=_calib_batches(rng, 4, (32, 20))
+        if calib_mode != "none" else None)
+    out = qnet(x).asnumpy()
+    cos = (ref * out).sum() / (np.linalg.norm(ref) * np.linalg.norm(out))
+    # entropy mode deliberately trades tail range for resolution (KL-optimal
+    # clipping) — a random tiny MLP has no classification margin to absorb
+    # it, so its bound is looser than the minmax modes'
+    bound = 0.95 if calib_mode == "entropy" else 0.999
+    assert cos > bound, "cosine %.5f under calib_mode=%s" % (cos, calib_mode)
+
+
+def test_quantize_net_excludes_layers():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16), nn.Dense(8))
+    net.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.randn(4, 10).astype(np.float32))
+    net(x)
+    first_name = list(net._children.values())[0].name
+    qnet = qz.quantize_net(net, calib_mode="none",
+                           exclude_layers=[first_name])
+    kids = list(qnet._children.values())
+    assert isinstance(kids[0], nn.Dense)
+    assert isinstance(kids[1], qz.QuantizedDense)
+
+
+def test_quantize_net_zoo_model():
+    """Verdict done-criterion: quantized zoo model within tolerance of
+    fp32."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    mx.random.seed(6)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(4, 3, 32, 32).astype(np.float32))
+    ref = net(x).asnumpy()
+    qnet = qz.quantize_net(net, calib_mode="naive",
+                           calib_data=_calib_batches(rng, 2, (4, 3, 32, 32)))
+    out = qnet(x).asnumpy()
+    cos = (ref * out).sum() / (np.linalg.norm(ref) * np.linalg.norm(out))
+    assert cos > 0.99, "cosine similarity %.4f" % cos
+    # top-1 agreement on the tiny batch
+    assert (ref.argmax(1) == out.argmax(1)).mean() >= 0.75
+
+
+def test_quantize_net_hybridized_calibrates():
+    """Calibration must see real activations even when the net was
+    hybridized (the cached jit program would bypass python probes)."""
+    mx.random.seed(8)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net.hybridize()
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.randn(8, 6).astype(np.float32))
+    net(x)
+    qnet = qz.quantize_net(net, calib_mode="naive",
+                           calib_data=_calib_batches(rng, 2, (8, 6)))
+    kids = list(qnet._children.values())
+    assert all(isinstance(k, qz.QuantizedDense) for k in kids)
+    assert all(k._act_max is not None for k in kids), \
+        "hybridized calibration produced no thresholds"
+    out = qnet(x)  # runs through a fresh trace
+    assert np.isfinite(out.asnumpy()).all()
