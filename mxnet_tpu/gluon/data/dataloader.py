@@ -52,6 +52,14 @@ _worker_dataset = None
 
 
 def _worker_initializer(dataset):
+    # spawned workers must never initialize the parent's accelerator
+    # backend (a second process grabbing the PjRt tunnel can wedge it);
+    # any incidental jax use in a worker stays on CPU. Only in a real
+    # child process — with thread_pool=True this initializer runs in the
+    # PARENT, whose environment must not be touched.
+    if multiprocessing.parent_process() is not None:
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
     global _worker_dataset
     _worker_dataset = dataset
 
@@ -124,7 +132,26 @@ class DataLoader:
                                         initializer=_worker_initializer,
                                         initargs=(self._dataset,))
             else:
-                ctx = multiprocessing.get_context("fork")
+                # spawn, not fork: the parent holds a live multithreaded JAX
+                # runtime, and forking it risks deadlock in the child (the
+                # suite used to warn on every multiworker test). Fresh
+                # interpreters also never inherit the parent's TPU handle —
+                # workers are numpy-only by design (reference analog:
+                # cpu_shared workers never own a CUDA context either).
+                # spawn workers need a picklable dataset (fork inherited
+                # closures for free; spawn cannot) — fail with a usable
+                # message instead of a deep PicklingError at first batch
+                import pickle
+                try:
+                    pickle.dumps(self._dataset)
+                except Exception as e:
+                    raise ValueError(
+                        "DataLoader(num_workers>0) ships the dataset to "
+                        "spawned worker processes, which requires it to be "
+                        "picklable (%s). Use a module-level transform "
+                        "function instead of a lambda, or pass "
+                        "thread_pool=True." % e) from e
+                ctx = multiprocessing.get_context("spawn")
                 self._pool = ctx.Pool(self._num_workers,
                                       initializer=_worker_initializer,
                                       initargs=(self._dataset,))

@@ -37,6 +37,10 @@ def _is_tracer(x):
     return isinstance(x, jax.core.Tracer)
 
 
+def _is_tracer_in(raw_args):
+    return any(isinstance(a, jax.core.Tracer) for a in raw_args)
+
+
 class NDArray:
     """A mutable-by-convention tensor over an immutable jax.Array payload."""
 
@@ -62,6 +66,11 @@ class NDArray:
     def _read(self):
         """Current payload; views read through their base so writes to the
         base are visible (reference: zero-copy NDArray::Slice)."""
+        if self._deferred is not None:
+            # async engine semantics: the op that produced this array failed;
+            # its stored exception surfaces when the value is touched
+            # (reference: ThreadedVar exception_ptr, test_exc_handling.py)
+            raise self._deferred[0]
         if self._base is None:
             return self._data
         return self._base._read()[self._idx]
@@ -529,6 +538,25 @@ def invoke(op_name, *args, out=None, **kwargs):
     return _invoke(op_name, *args, out=out, **kwargs)
 
 
+def _poisoned_outputs(exc_entry, op, ctx, out=None):
+    """Outputs of an async op whose execution failed: carry the exception
+    to the next sync point instead of raising at dispatch (reference:
+    dependency-chain exception propagation, src/engine/threaded_engine.cc
+    OnCompleteStatic storing exception_ptr on the output vars)."""
+    outs = []
+    for _ in range(max(1, op.num_outputs)):
+        o = NDArray(None, ctx=ctx)
+        o._deferred = exc_entry
+        outs.append(o)
+    if out is not None:
+        dst = out if isinstance(out, (tuple, list)) else [out]
+        for d, s in zip(dst, outs):
+            d._deferred = exc_entry
+            d._data, d._base, d._idx = None, None, None
+        return out
+    return outs[0] if op.num_outputs == 1 and len(outs) == 1 else outs
+
+
 def _invoke(op_name, *args, out=None, **kwargs):
     op = _reg.get(op_name)
     from .. import autograd
@@ -536,14 +564,21 @@ def _invoke(op_name, *args, out=None, **kwargs):
     ctx = None
     raw_args = []
     nd_positions = []
+    poisoned = None
     for i, a in enumerate(args):
         if isinstance(a, NDArray):
-            raw_args.append(a._read())
+            if a._deferred is not None and poisoned is None:
+                poisoned = a._deferred
             nd_positions.append(i)
             if ctx is None:
                 ctx = a._ctx
+            raw_args.append(None if poisoned is not None else a._read())
         else:
             raw_args.append(a)
+    if poisoned is not None:
+        # a dependency already failed: poison downstream, don't raise here
+        return _poisoned_outputs(poisoned, op,
+                                 ctx or current_context(), out)
     if ctx is None:
         ctx = kwargs.pop("ctx", None) or current_context()
     elif "ctx" in kwargs:
@@ -563,21 +598,27 @@ def _invoke(op_name, *args, out=None, **kwargs):
     # queried via autograd.grad()
     recording = (autograd.is_recording() and op.differentiable and nd_positions)
 
-    if recording:
-        def closed(*arrs):
-            full = list(raw_args)
-            for p, a in zip(nd_positions, arrs):
-                full[p] = a
-            return fn(*full, **kwargs)
-        inputs_raw = [raw_args[p] for p in nd_positions]
-        out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
-        outputs = _wrap_out(out_raw, ctx)
-        autograd.record_op(op_name, [args[p] for p in nd_positions],
-                           outputs if isinstance(outputs, list) else [outputs],
-                           vjp_fn, primal_fn=closed)
-    else:
-        out_raw = fn(*raw_args, **kwargs)
-        outputs = _wrap_out(out_raw, ctx)
+    try:
+        if recording:
+            def closed(*arrs):
+                full = list(raw_args)
+                for p, a in zip(nd_positions, arrs):
+                    full[p] = a
+                return fn(*full, **kwargs)
+            inputs_raw = [raw_args[p] for p in nd_positions]
+            out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
+            outputs = _wrap_out(out_raw, ctx)
+            autograd.record_op(op_name, [args[p] for p in nd_positions],
+                               outputs if isinstance(outputs, list)
+                               else [outputs],
+                               vjp_fn, primal_fn=closed)
+        else:
+            out_raw = fn(*raw_args, **kwargs)
+            outputs = _wrap_out(out_raw, ctx)
+    except Exception as e:
+        if _base.is_naive_engine() or _is_tracer_in(raw_args):
+            raise  # sync-debug mode (or inside a jit trace): fail in place
+        return _poisoned_outputs((e, op_name), op, ctx, out)
 
     if _base.is_naive_engine():
         for o in (outputs if isinstance(outputs, list) else [outputs]):

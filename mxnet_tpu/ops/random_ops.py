@@ -33,6 +33,12 @@ def _random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None,
 @register("_random_normal", creation=True, random=True, differentiable=False)
 def _random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
                    key=None):
+    # the reference kernel CHECKs sigma >= 0 (sample_op.h); raising inside
+    # the op makes this the canonical deferred-async-error test vector
+    # (test_exc_handling.py: error surfaces at asnumpy, not at dispatch)
+    if not isinstance(scale, jax.core.Tracer) and float(scale) < 0:
+        raise ValueError("normal: scale (sigma) must be non-negative, "
+                         "got %s" % scale)
     return loc + scale * jax.random.normal(key, _shape(shape),
                                            dtype=np_dtype(dtype))
 

@@ -1,0 +1,57 @@
+"""Async exception semantics (reference suite:
+tests/python/unittest/test_exc_handling.py): a failing async op must NOT
+raise at dispatch — the error is stored on the output and surfaces at the
+next sync point (asnumpy / wait_to_read); dependent ops propagate the
+poison; MXNET_ENGINE_TYPE=NaiveEngine raises in place for debugging."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_error_defers_to_asnumpy():
+    # dispatch must succeed...
+    bad = nd.random.normal(0, -1, shape=(2, 2))
+    good = nd.random.normal(0, 1, shape=(2, 2))
+    # ...and the error surface exactly at the sync point
+    with pytest.raises(ValueError, match="sigma"):
+        bad.asnumpy()
+    assert good.asnumpy().shape == (2, 2)  # other work is unaffected
+
+
+def test_error_defers_to_wait_to_read():
+    bad = nd.random.normal(0, -2.0, shape=(3,))
+    with pytest.raises(ValueError):
+        bad.wait_to_read()
+
+
+def test_poison_propagates_through_dependent_ops():
+    bad = nd.random.normal(0, -1, shape=(4,))
+    c = bad + 1          # dispatch of a dependent op must not raise
+    d = c * 2
+    e = nd.dot(d.reshape((2, 2)), nd.ones((2, 2)))
+    with pytest.raises(ValueError, match="sigma"):
+        e.asnumpy()
+
+
+def test_caught_error_does_not_break_later_ops():
+    bad = nd.random.normal(0, -1, shape=(2,))
+    with pytest.raises(ValueError):
+        bad.asnumpy()
+    ok = nd.ones((2,)) + 1
+    np.testing.assert_array_equal(ok.asnumpy(), [2, 2])
+
+
+def test_naive_engine_raises_in_place(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    with pytest.raises(ValueError, match="sigma"):
+        nd.random.normal(0, -1, shape=(2, 2))
+
+
+def test_deferred_out_kwarg():
+    dst = nd.zeros((2, 2))
+    bad = nd.random.normal(0, -1, shape=(2, 2))
+    out = bad + dst
+    with pytest.raises(ValueError):
+        out.asnumpy()
