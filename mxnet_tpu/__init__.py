@@ -37,6 +37,7 @@ from . import model
 from . import module
 from . import module as mod
 from . import models
+from . import operator
 from . import profiler
 from . import runtime
 from . import test_utils

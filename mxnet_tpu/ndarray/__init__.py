@@ -30,3 +30,20 @@ for _pub, _src in [("uniform", "_random_uniform"), ("normal", "_random_normal"),
 _sys.modules[random.__name__] = random
 
 from . import sparse  # noqa: E402  (row_sparse / csr)
+
+
+def Custom(*args, **kwargs):
+    """Run a registered custom op (reference: mx.nd.Custom → custom.cc)."""
+    from ..operator import invoke_custom
+    return invoke_custom(*args, **kwargs)
+
+
+# mx.nd.contrib.* sub-namespace (reference: python/mxnet/ndarray/contrib.py —
+# every `_contrib_*` registered op under its short name)
+contrib = _types.ModuleType(__name__ + ".contrib")
+from ..ops import registry as _reg_mod  # noqa: E402
+for _full in list(_reg_mod.list_ops()):
+    if _full.startswith("_contrib_"):
+        setattr(contrib, _full[len("_contrib_"):],
+                _register.make_op_func(_full))
+_sys.modules[contrib.__name__] = contrib

@@ -73,3 +73,19 @@ def embedding(data, weight, input_dim=None, output_dim=None):
 
 def gamma(data):
     return invoke("gamma", data)
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """reference: _contrib_interleaved_matmul_selfatt_qk (transformer.cc),
+    the npx spelling GluonNLP's attention cells call."""
+    return invoke("_contrib_interleaved_matmul_selfatt_qk",
+                  queries_keys_values, heads=heads)
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    return invoke("_contrib_interleaved_matmul_selfatt_valatt",
+                  queries_keys_values, attention, heads=heads)
+
+
+__all__ += ["interleaved_matmul_selfatt_qk",
+            "interleaved_matmul_selfatt_valatt"]

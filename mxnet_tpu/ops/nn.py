@@ -539,7 +539,54 @@ def _roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
 @register("Correlation")
 def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
                  stride2=1, pad_size=0, is_multiply=True):
-    raise NotImplementedError("Correlation op: not yet implemented on TPU")
+    """FlowNet patch cross-correlation.
+    reference: src/operator/correlation.cc (CorrelationOp) — for every
+    displacement on a stride2 grid within ±max_displacement, the kernel-
+    window patch dot product (or abs-difference) between data1 and shifted
+    data2, normalized by kernel²·C. The displacement loop is a static
+    Python unroll: D² shifted elementwise products + one box reduction
+    each, which XLA fuses — TPU-friendlier than the reference's per-pixel
+    CUDA gather."""
+    n, c, h, w = data1.shape
+    k = int(kernel_size)
+    kr = (k - 1) // 2                       # kernel radius
+    md, s1, s2 = int(max_displacement), int(stride1), int(stride2)
+    pad = int(pad_size)
+    border = md + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    out_h = int(_np.ceil((ph - 2 * border) / float(s1)))
+    out_w = int(_np.ceil((pw - 2 * border) / float(s1)))
+    ngrid = 2 * (md // s2) + 1              # displacements per axis
+    sublen = float(k * k * c)
+
+    p1 = jnp.pad(data1.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+
+    def box_sum(x):
+        # kernel-window sum at every position (valid), summed over C
+        if k == 1:
+            return jnp.sum(x, axis=1)
+        y = lax.reduce_window(x, 0.0, lax.add,
+                              (1, 1, k, k), (1, 1, 1, 1), "valid")
+        return jnp.sum(y, axis=1)
+
+    maps = []
+    a = p1[:, :, md:ph - md, md:pw - md]
+    for dy in range(-(md // s2), md // s2 + 1):
+        for dx in range(-(md // s2), md // s2 + 1):
+            oy, ox = dy * s2, dx * s2
+            # data2 window shifted by the displacement; slices span
+            # [md, ph-md) so the first valid k-window is CENTERED at
+            # border = md + kr, matching the reference's x1 = x·stride1 +
+            # max_displacement + kernel_radius indexing
+            b = p2[:, :, md + oy:ph - md + oy, md + ox:pw - md + ox]
+            prod = a * b if is_multiply else jnp.abs(a - b)
+            maps.append(box_sum(prod) / sublen)
+    out = jnp.stack(maps, axis=1)           # (n, ngrid², outH', outW')
+    out = out[:, :, ::s1, ::s1]
+    return out[:, :, :out_h, :out_w].astype(data1.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -567,3 +614,47 @@ def _crop(data, *shape_like, offset=None, h_w=None, num_args=1, center_crop=Fals
 
 
 alias("crop", "Crop")
+
+
+# ---------------------------------------------------------------------------
+# fused transformer self-attention op surface
+# reference: src/operator/contrib/transformer.cc
+# (_contrib_interleaved_matmul_selfatt_qk / _valatt, div_sqrt_dim)
+# ---------------------------------------------------------------------------
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data):
+    """reference: transformer.cc (DivSqrtDim) — x / sqrt(last_dim)."""
+    return data / jnp.sqrt(jnp.float32(data.shape[-1])).astype(data.dtype)
+
+
+def _split_interleaved(qkv, heads, which):
+    """(seq, batch, heads*3*hd) interleaved per head -> (batch*heads, seq,
+    hd) for which in {0:q, 1:k, 2:v} — the documented equivalent-code
+    layout of the reference op."""
+    s, b, e = qkv.shape
+    hd = e // (heads * 3)
+    t = qkv.reshape(s, b, heads, 3, hd)[:, :, :, which, :]
+    return t.transpose(1, 2, 0, 3).reshape(b * heads, s, hd)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def _interleaved_matmul_selfatt_qk(queries_keys_values, heads=None):
+    """scores[b*h, q, k] = (q . k) / sqrt(head_dim); one MXU batch-matmul
+    straight off the interleaved QKV projection (no materialized
+    transpose copies — XLA folds the layout into the dot)."""
+    q = _split_interleaved(queries_keys_values, heads, 0)
+    k = _split_interleaved(queries_keys_values, heads, 1)
+    scale = 1.0 / _np.sqrt(q.shape[-1])
+    return jnp.einsum("bqd,bkd->bqk", q * q.dtype.type(scale), k)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def _interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                       heads=None):
+    """(attention @ v) regrouped to (seq, batch, heads*head_dim)."""
+    s, b, e = queries_keys_values.shape
+    hd = e // (heads * 3)
+    v = _split_interleaved(queries_keys_values, heads, 2)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v)
+    return (out.reshape(b, heads, s, hd).transpose(2, 0, 1, 3)
+            .reshape(s, b, heads * hd))

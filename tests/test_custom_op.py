@@ -1,0 +1,103 @@
+"""CustomOp bridge (reference suite: tests/python/unittest/test_operator.py
+(test_custom_op) — forward/backward through mx.operator.CustomOp with
+autograd, shapes inferred by the Prop)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+@mx.operator.register("softsign_t")
+class SoftsignProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softsign()
+
+
+class Softsign(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], x / (1 + abs(x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = in_data[0]
+        g = out_grad[0] / ((1 + abs(x)) * (1 + abs(x)))
+        self.assign(in_grad[0], req[0], g)
+
+
+def test_custom_forward():
+    x = nd.array(np.array([-2.0, 0.0, 3.0], np.float32))
+    y = nd.Custom(x, op_type="softsign_t")
+    np.testing.assert_allclose(y.asnumpy(),
+                               [-2 / 3, 0.0, 3 / 4], rtol=1e-6)
+
+
+def test_custom_backward_through_autograd():
+    xv = np.array([-1.5, 0.5, 2.0], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="softsign_t")
+        loss = (y * nd.array([1.0, 2.0, 3.0])).sum()
+    loss.backward()
+    expect = np.array([1.0, 2.0, 3.0]) / (1 + np.abs(xv)) ** 2
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-6)
+
+
+@mx.operator.register("twin_out")
+class TwinProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return TwinOp()
+
+
+class TwinOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data
+        self.assign(out_data[0], req[0], a + b)
+        self.assign(out_data[1], req[1], a - b)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        gs, gd = out_grad
+        self.assign(in_grad[0], req[0], gs + gd)
+        self.assign(in_grad[1], req[1], gs - gd)
+
+
+def test_custom_multi_input_output():
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    b = nd.array(np.array([0.5, 1.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        s, d = nd.Custom(a, b, op_type="twin_out")
+        loss = (s * 2).sum() + d.sum()
+    loss.backward()
+    np.testing.assert_allclose(s.asnumpy(), [1.5, 3.0])
+    np.testing.assert_allclose(d.asnumpy(), [0.5, 1.0])
+    np.testing.assert_allclose(a.grad.asnumpy(), [3.0, 3.0])  # 2 + 1
+    np.testing.assert_allclose(b.grad.asnumpy(), [1.0, 1.0])  # 2 - 1
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(mx.MXNetError, match="not registered"):
+        nd.Custom(nd.ones((2,)), op_type="no_such_op")

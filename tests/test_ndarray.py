@@ -222,3 +222,101 @@ def test_broadcasting():
     assert c.shape == (5, 3)
     d = nd.broadcast_axis(nd.ones((1, 3)), axis=0, size=4)
     assert d.shape == (4, 3)
+
+
+def test_correlation_op():
+    """reference: src/operator/correlation.cc — verified against a direct
+    numpy loop for a small case."""
+    rng = np.random.RandomState(0)
+    n, c, h, w = 2, 3, 8, 8
+    d1 = rng.randn(n, c, h, w).astype(np.float32)
+    d2 = rng.randn(n, c, h, w).astype(np.float32)
+    md, k = 2, 1
+    out = nd.invoke("Correlation", nd.array(d1), nd.array(d2),
+                    kernel_size=k, max_displacement=md, stride1=1,
+                    stride2=1, pad_size=md).asnumpy()
+    D = 2 * md + 1
+    assert out.shape == (n, D * D, h, w)
+    # numpy reference at a few positions
+    p1 = np.pad(d1, ((0, 0), (0, 0), (md, md), (md, md)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (md, md), (md, md)))
+    for (dy, dx, y, x) in [(0, 0, 3, 3), (-2, 1, 4, 2), (2, -2, 2, 5)]:
+        ch = (dy + md) * D + (dx + md)
+        a = p1[:, :, y + md, x + md]
+        b = p2[:, :, y + md + dy, x + md + dx]
+        expect = (a * b).sum(axis=1) / c
+        np.testing.assert_allclose(out[:, ch, y, x], expect, rtol=1e-4)
+    # abs-difference mode
+    out2 = nd.invoke("Correlation", nd.array(d1), nd.array(d2),
+                     kernel_size=k, max_displacement=md, stride1=1,
+                     stride2=1, pad_size=md, is_multiply=False).asnumpy()
+    a = p1[:, :, 3 + md, 3 + md]
+    b = p2[:, :, 3 + md, 3 + md]
+    np.testing.assert_allclose(out2[:, md * D + md, 3, 3],
+                               np.abs(a - b).sum(axis=1) / c, rtol=1e-4)
+
+
+def test_interleaved_matmul_selfatt_ops():
+    """reference: src/operator/contrib/transformer.cc — checked against the
+    documented equivalent-code layout."""
+    rng = np.random.RandomState(0)
+    S, B, H, D = 6, 2, 4, 8
+    qkv = rng.randn(S, B, H * 3 * D).astype(np.float32)
+    scores = mx.nd.contrib.interleaved_matmul_selfatt_qk(nd.array(qkv),
+                                                         heads=H)
+    assert scores.shape == (B * H, S, S)
+    att = mx.nd.softmax(scores, axis=-1)
+    out = mx.nd.contrib.interleaved_matmul_selfatt_valatt(nd.array(qkv), att,
+                                                          heads=H)
+    assert out.shape == (S, B, H * D)
+    t = qkv.reshape(S, B, H, 3, D)
+    q = t[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * H, S, D) \
+        / np.sqrt(D)
+    k = t[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    v = t[:, :, :, 2, :].transpose(1, 2, 0, 3).reshape(B * H, S, D)
+    sc = np.einsum("bqd,bkd->bqk", q, k)
+    np.testing.assert_allclose(scores.asnumpy(), sc, rtol=1e-5)
+    e = np.exp(sc - sc.max(-1, keepdims=True))
+    a = e / e.sum(-1, keepdims=True)
+    o = (np.einsum("bqk,bkd->bqd", a, v).reshape(B, H, S, D)
+         .transpose(2, 0, 1, 3).reshape(S, B, H * D))
+    np.testing.assert_allclose(out.asnumpy(), o, rtol=1e-4)
+    # gradients flow (it backs real attention layers)
+    x = nd.array(qkv)
+    x.attach_grad()
+    import mxnet_tpu.autograd as ag
+    with ag.record():
+        s2 = mx.nd.contrib.interleaved_matmul_selfatt_qk(x, heads=H)
+        l = s2.sum()
+    l.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_correlation_op_kernel3():
+    """kernel_size=3: window CENTERS at border=md+kr (reference
+    correlation-inl.h indexing), checked against a direct numpy loop."""
+    rng = np.random.RandomState(4)
+    n, c, h, w = 1, 2, 10, 10
+    d1 = rng.randn(n, c, h, w).astype(np.float32)
+    d2 = rng.randn(n, c, h, w).astype(np.float32)
+    md, k, kr = 1, 3, 1
+    pad = md + kr
+    out = nd.invoke("Correlation", nd.array(d1), nd.array(d2),
+                    kernel_size=k, max_displacement=md, stride1=1,
+                    stride2=1, pad_size=pad).asnumpy()
+    D = 2 * md + 1
+    border = md + kr
+    ph = h + 2 * pad
+    out_hw = ph - 2 * border
+    assert out.shape == (n, D * D, out_hw, out_hw)
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    sub = k * k * c
+    for (dy, dx, oy, ox) in [(0, 0, 0, 0), (1, -1, 3, 2), (-1, 1, 5, 5)]:
+        ch = (dy + md) * D + (dx + md)
+        cy, cx = border + oy, border + ox        # window center, data1
+        a = p1[0, :, cy - kr:cy + kr + 1, cx - kr:cx + kr + 1]
+        b = p2[0, :, cy + dy - kr:cy + dy + kr + 1,
+               cx + dx - kr:cx + dx + kr + 1]
+        expect = (a * b).sum() / sub
+        np.testing.assert_allclose(out[0, ch, oy, ox], expect, rtol=1e-4)
