@@ -21,8 +21,7 @@ import jax.numpy as jnp
 from ..ops import registry as _reg
 from ..ndarray.ndarray import NDArray, invoke, array as _nd_array
 from ..context import current_context
-
-ndarray = NDArray
+from .multiarray import ndarray, as_np_ndarray
 
 # (name, differentiable) — jnp callables surfaced 1:1. Integer/boolean
 # producers are non-differentiable (reference marks them the same).
@@ -100,8 +99,12 @@ for _name, _diff in _FUNCS:
     def _make(op_name, seq):
         def _fn(*args, **kwargs):
             if seq and len(args) >= 1 and isinstance(args[0], (list, tuple)):
-                return invoke(op_name, *args[0], *args[1:], **kwargs)
-            return invoke(op_name, *args, **kwargs)
+                out = invoke(op_name, *args[0], *args[1:], **kwargs)
+            else:
+                out = invoke(op_name, *args, **kwargs)
+            if out is kwargs.get("out"):
+                return out  # caller-owned destination: don't retag it
+            return as_np_ndarray(out)
         _fn.__name__ = op_name[4:]
         _fn.__qualname__ = op_name[4:]
         _fn.__doc__ = "numpy-compatible %s (jax.numpy.%s under invoke)" % (
@@ -133,7 +136,9 @@ dtype = _onp.dtype
 
 
 def array(obj, dtype=None, ctx=None):
-    return _nd_array(_onp.asarray(obj), dtype=dtype, ctx=ctx)
+    if isinstance(obj, NDArray):
+        obj = obj.asnumpy()
+    return as_np_ndarray(_nd_array(_onp.asarray(obj), dtype=dtype, ctx=ctx))
 
 
 def _creation(jnp_name):
@@ -141,8 +146,8 @@ def _creation(jnp_name):
 
     def fn(*args, ctx=None, **kwargs):
         from ..ndarray.ndarray import from_jax
-        return from_jax(jfn(*args, **kwargs),
-                        ctx=ctx or current_context())
+        return as_np_ndarray(from_jax(jfn(*args, **kwargs),
+                                      ctx=ctx or current_context()))
     fn.__name__ = jnp_name
     return fn
 

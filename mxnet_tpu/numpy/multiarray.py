@@ -1,0 +1,127 @@
+"""The dedicated `mx.np.ndarray` type.
+
+reference: python/mxnet/numpy/multiarray.py — a distinct array class with
+numpy semantics, separate from the legacy `mx.nd.NDArray`. Here it is a
+zero-storage subclass (same buffer-swap payload, same autograd tape, same
+async engine semantics) whose operations return `mx.np.ndarray` again and
+whose surface follows numpy: `array(...)` repr, `.item()/.tolist()`,
+boolean-mask and fancy indexing, zero-dim arrays, numpy-style `astype`.
+Retagging (not wrapping) keeps interop free in both directions: an
+mx.np.ndarray IS an NDArray everywhere the framework takes one.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["ndarray", "as_np_ndarray"]
+
+
+class ndarray(NDArray):
+    __slots__ = ()
+
+    # -- numpy-flavored surface ---------------------------------------
+    def __repr__(self):
+        try:
+            return repr(self.asnumpy())  # numpy's own 'array(...)' style
+        except Exception:
+            return "array(<unrealized %s>)" % ("x".join(
+                str(d) for d in self.shape))
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True):
+        out = NDArray.astype(self, dtype)
+        return as_np_ndarray(out)
+
+    @property
+    def T(self):
+        return as_np_ndarray(NDArray.T.fget(self))
+
+    def __getitem__(self, key):
+        # numpy semantics include boolean-mask and fancy indexing; the
+        # base class already gathers for advanced keys — just retag
+        if isinstance(key, NDArray):
+            key = key.data_jax
+        return as_np_ndarray(NDArray.__getitem__(self, key))
+
+    def __iter__(self):
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d array")
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    def as_nd_ndarray(self):
+        """Legacy-namespace view of the same payload (reference:
+        ndarray.as_nd_ndarray)."""
+        out = NDArray(self._data, ctx=self._ctx, base=self._base,
+                      idx=self._idx)
+        return out
+
+    def copy(self):
+        return as_np_ndarray(NDArray.copy(self))
+
+
+def as_np_ndarray(x):
+    """Retag NDArray results (and containers of them) as mx.np.ndarray.
+    reference: NDArray.as_np_ndarray."""
+    if isinstance(x, NDArray):
+        if type(x) is NDArray:
+            x.__class__ = ndarray
+        return x
+    if isinstance(x, (list, tuple)):
+        return type(x)(as_np_ndarray(v) for v in x)
+    return x
+
+
+def _retag(name):
+    base_fn = getattr(NDArray, name)
+
+    def method(self, *args, **kwargs):
+        out = base_fn(self, *args, **kwargs)
+        # never retag a caller-owned array handed back through the op
+        # (copyto/out= return their destination): converting someone
+        # else's legacy NDArray in place would change ITS semantics
+        if out is self or any(out is a for a in args) \
+                or out is kwargs.get("out"):
+            return out
+        return as_np_ndarray(out)
+    method.__name__ = name
+    return method
+
+
+# every op-returning method keeps the np type through the operation
+for _name in ["__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+              "__rmul__", "__truediv__", "__rtruediv__", "__mod__",
+              "__rmod__", "__pow__", "__rpow__", "__neg__", "__abs__",
+              "reshape", "transpose", "squeeze", "expand_dims", "swapaxes",
+              "flatten", "broadcast_to", "tile", "repeat", "take", "pick",
+              "slice", "slice_axis", "sum", "mean", "max", "min", "prod",
+              "argmax", "argmin", "clip", "exp", "log", "sqrt", "square",
+              "abs", "sign", "round", "sort", "flip", "as_in_context",
+              "copyto", "detach"]:
+    if hasattr(NDArray, _name):
+        setattr(ndarray, _name, _retag(_name))
+
+
+def _bool_cmp(name):
+    base_fn = getattr(NDArray, name)
+
+    def method(self, other):
+        # numpy semantics: comparisons yield BOOL arrays (usable as masks);
+        # the legacy mx.nd namespace yields 0/1 float32 like the reference
+        out = base_fn(self, other)
+        if isinstance(out, NDArray):
+            return as_np_ndarray(out.astype(_onp.bool_))
+        return out
+    method.__name__ = name
+    return method
+
+
+for _name in ["__eq__", "__ne__", "__lt__", "__le__", "__gt__", "__ge__"]:
+    setattr(ndarray, _name, _bool_cmp(_name))

@@ -4,8 +4,13 @@ from __future__ import annotations
 
 import numpy as _onp
 
-from ..ndarray.ndarray import invoke
+from ..ndarray.ndarray import invoke as _raw_invoke
 from .. import random as _random
+from .multiarray import as_np_ndarray as _as_np
+
+
+def invoke(*args, **kwargs):
+    return _as_np(_raw_invoke(*args, **kwargs))
 
 __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
            "choice", "shuffle", "gamma", "beta", "exponential",

@@ -83,3 +83,16 @@ def is_enabled(feature_name):
     if Features.instance is None:
         Features.instance = Features()
     return Features.instance.is_enabled(feature_name)
+
+
+def honor_jax_platforms_env():
+    """Force jax back onto the platform named by JAX_PLATFORMS.
+
+    The axon sitecustomize re-registers its TPU backend and resets
+    jax_platforms AFTER env vars are read, so scripts documented as
+    `JAX_PLATFORMS=cpu ... python script.py` would silently ignore the env
+    var. Call this before any jax use (examples/ and tools/ do)."""
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -115,3 +115,48 @@ def test_launch_tpu_emits_spec():
     assert proc.returncode == 0
     assert "DMLC_WORKER_ID=0" in proc.stdout
     assert "DMLC_WORKER_ID=1" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2-bit compression wire format (unit; reference: gradient_compression.cc)
+# ---------------------------------------------------------------------------
+
+def test_two_bit_packing_bytes_on_wire():
+    import jax.numpy as jnp
+    from mxnet_tpu.kvstore.kvstore_dist import GradientCompression
+    gc = GradientCompression(threshold=0.5)
+    g = np.array([1.0, -2.0, 0.1, 0.6, -0.5, 0.0, 0.0], np.float32)
+    packed = gc.compress("k", jnp.asarray(g))
+    # 7 values -> 2 bytes on the wire (4 values/byte), not 28 float bytes
+    assert packed.dtype == np.uint8
+    assert packed.nbytes == 2
+    back = np.asarray(gc.decompress(packed, g.shape, g.dtype))
+    np.testing.assert_array_equal(back, [0.5, -0.5, 0, 0.5, -0.5, 0, 0])
+
+
+def test_two_bit_error_feedback_accumulates():
+    import jax.numpy as jnp
+    from mxnet_tpu.kvstore.kvstore_dist import GradientCompression
+    gc = GradientCompression(threshold=0.5)
+    g = jnp.asarray(np.array([0.3, -0.3], np.float32))
+    # 0.3 < t: first push sends 0, residual carries 0.3; second push's
+    # accumulated 0.6 crosses the threshold
+    p1 = gc.compress("k", g)
+    b1 = np.asarray(gc.decompress(p1, (2,), np.float32))
+    np.testing.assert_array_equal(b1, [0, 0])
+    p2 = gc.compress("k", g)
+    b2 = np.asarray(gc.decompress(p2, (2,), np.float32))
+    np.testing.assert_array_equal(b2, [0.5, -0.5])
+
+
+def test_two_bit_packing_2d_and_padding():
+    import jax.numpy as jnp
+    from mxnet_tpu.kvstore.kvstore_dist import GradientCompression
+    gc = GradientCompression(threshold=1.0)
+    rng = np.random.RandomState(0)
+    g = rng.randn(5, 7).astype(np.float32) * 2
+    packed = gc.compress("k", jnp.asarray(g))
+    assert packed.nbytes == (35 + 3) // 4
+    back = np.asarray(gc.decompress(packed, g.shape, g.dtype))
+    expect = np.where(g >= 1.0, 1.0, np.where(g <= -1.0, -1.0, 0.0))
+    np.testing.assert_array_equal(back, expect.astype(np.float32))

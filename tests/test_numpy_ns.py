@@ -75,3 +75,61 @@ def test_npx_ops_and_np_mode():
     assert not mx.npx.is_np_shape()
     r = mx.npx.relu(mx.np.array([-1.0, 2.0]))
     onp.testing.assert_array_equal(r.asnumpy(), [0.0, 2.0])
+
+
+# ---------------------------------------------------------------------------
+# dedicated mx.np.ndarray type (reference: python/mxnet/numpy/multiarray.py)
+# ---------------------------------------------------------------------------
+
+def test_np_ndarray_is_distinct_type():
+    import mxnet_tpu as mx
+    x = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(x, mx.np.ndarray)
+    assert isinstance(x, mx.nd.NDArray)
+    assert type(x) is not mx.nd.NDArray
+    # operations stay in the np type
+    y = (x + 1) * 2
+    assert type(y) is mx.np.ndarray
+    assert type(x.sum()) is mx.np.ndarray
+    assert type(x.T) is mx.np.ndarray
+    assert type(mx.np.exp(x)) is mx.np.ndarray
+    assert type(mx.np.random.normal(size=(2,))) is mx.np.ndarray
+
+
+def test_np_ndarray_numpy_semantics():
+    import numpy as np
+    import mxnet_tpu as mx
+    x = mx.np.array([1.0, -2.0, 3.0, -4.0])
+    # boolean-mask indexing
+    pos = x[x > 0]
+    assert type(pos) is mx.np.ndarray
+    np.testing.assert_array_equal(pos.asnumpy(), [1.0, 3.0])
+    # fancy indexing
+    np.testing.assert_array_equal(x[mx.np.array([0, 3]).astype("int32")]
+                                  .asnumpy(), [1.0, -4.0])
+    # zero-dim
+    s = x.sum()
+    assert s.shape == ()
+    assert abs(s.item() - (-2.0)) < 1e-6
+    assert x.tolist() == [1.0, -2.0, 3.0, -4.0]
+    # numpy-style repr
+    assert repr(x).startswith("array(")
+    # iteration yields np arrays
+    rows = list(mx.np.array([[1, 2], [3, 4]]).astype("float32"))
+    assert len(rows) == 2 and type(rows[0]) is mx.np.ndarray
+
+
+def test_np_nd_interop_and_autograd():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    x = mx.np.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.np.sum(x * x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 6.0])
+    # legacy view shares payload
+    legacy = x.as_nd_ndarray()
+    assert type(legacy) is mx.nd.NDArray
+    np.testing.assert_array_equal(legacy.asnumpy(), x.asnumpy())
