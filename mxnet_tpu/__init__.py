@@ -40,6 +40,17 @@ from . import models
 from . import operator
 from . import profiler
 from . import runtime
+from . import rnn
+from . import visualization
+from . import visualization as viz
+from . import monitor
+from . import monitor as mon
+from . import util
+from . import attribute
+from .attribute import AttrScope
+from . import engine
+from . import libinfo
+from . import log
 from . import test_utils
 from . import contrib
 from . import native

@@ -11,6 +11,7 @@ from . import data
 from . import utils
 from . import model_zoo
 from .trainer import Trainer
+from . import contrib
 from .fused_step import FusedTrainStep
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "Constant",

@@ -64,9 +64,106 @@ def _worker_initializer(dataset):
     _worker_dataset = dataset
 
 
-def _worker_fn(samples, batchify_fn):
+class _ShmBatch:
+    """A batch living in POSIX shared memory: (name, shape, dtype) per
+    array + the nesting structure. The pickled payload is ~100 bytes
+    regardless of batch size — the zero-copy design point of the
+    reference's cpu_shared storage manager
+    (src/storage/cpu_shared_storage_manager.h)."""
+    __slots__ = ("descs", "fmt")
+
+    def __init__(self, descs, fmt):
+        self.descs = descs
+        self.fmt = fmt
+
+
+def _flatten_np(batch):
+    if isinstance(batch, _np.ndarray):
+        return [batch], 0
+    if isinstance(batch, (list, tuple)):
+        arrays, fmt = [], []
+        for b in batch:
+            a, f = _flatten_np(b)
+            arrays.extend(a)
+            fmt.append(f)
+        return arrays, fmt
+    raise TypeError("shm transport expects numpy batches, got %s"
+                    % type(batch))
+
+
+def _regroup_np(arrays, fmt, pos=0):
+    if fmt == 0:
+        return arrays[pos], pos + 1
+    out = []
+    for f in fmt:
+        item, pos = _regroup_np(arrays, f, pos)
+        out.append(item)
+    return out, pos
+
+
+def _batch_to_shm(batch):
+    """Worker side: copy each array once into a fresh shm segment. The
+    worker unregisters from its resource tracker — ownership transfers to
+    the parent, which unlinks after the device upload."""
+    from multiprocessing import shared_memory, resource_tracker
+    arrays, fmt = _flatten_np(batch)
+    descs = []
+    for a in arrays:
+        a = _np.ascontiguousarray(a)
+        shm = shared_memory.SharedMemory(create=True, size=max(1, a.nbytes))
+        _np.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
+        try:  # the parent owns the segment's lifetime now
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        descs.append((shm.name, a.shape, str(a.dtype)))
+        shm.close()
+    return _ShmBatch(descs, fmt)
+
+
+def _discard_shm(sb):
+    """Unlink a batch's segments without reading them."""
+    from multiprocessing import shared_memory
+    for name, _, _ in sb.descs:
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+
+
+def _batch_from_shm(sb, ctx):
+    """Parent side: map each segment and realize the array before
+    unlinking. On an accelerator the device upload reads straight from the
+    shared pages (no host-to-host copy, wait for H2D then unlink); the CPU
+    backend may ALIAS host buffers, so there the view is copied out first
+    — unmapping aliased pages is a use-after-free."""
+    from multiprocessing import shared_memory
+    arrays = []
+    for name, shape, dtype in sb.descs:
+        shm = shared_memory.SharedMemory(name=name)
+        view = _np.ndarray(shape, _np.dtype(dtype), buffer=shm.buf)
+        if ctx.device_type == "cpu":
+            arr = nd.array(view.copy(), ctx=ctx, dtype=view.dtype)
+        else:
+            arr = nd.array(view, ctx=ctx, dtype=view.dtype)
+            arr.wait_to_read()
+        arrays.append(arr)
+        shm.close()
+        shm.unlink()
+    out, _ = _regroup_np(arrays, sb.fmt)
+    return out
+
+
+def _worker_fn(samples, batchify_fn, use_shm=False):
     global _worker_dataset
     batch = batchify_fn([_worker_dataset[i] for i in samples])
+    if use_shm:
+        try:
+            return _batch_to_shm(batch)
+        except TypeError:
+            pass  # non-numpy batchify output: pickle path
     return batch
 
 
@@ -167,7 +264,8 @@ class DataLoader:
         return _MultiWorkerIter(self._pool, self._batchify_fn,
                                 self._batch_sampler,
                                 prefetch=self._prefetch,
-                                timeout=self._timeout)
+                                timeout=self._timeout,
+                                use_shm=not self._thread_pool)
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -182,10 +280,11 @@ class _MultiWorkerIter:
     reference: dataloader.py (_MultiWorkerIter)."""
 
     def __init__(self, pool, batchify_fn, batch_sampler, prefetch=0,
-                 timeout=120):
+                 timeout=120, use_shm=False):
         self._pool = pool
         self._batchify_fn = batchify_fn
         self._batch_sampler = batch_sampler
+        self._use_shm = use_shm
         self._data_buffer = {}
         self._rcvd_idx = 0
         self._sent_idx = 0
@@ -201,8 +300,8 @@ class _MultiWorkerIter:
         r = next(self._iter, None)
         if r is None:
             return
-        async_ret = self._pool.apply_async(_worker_fn,
-                                           (r, self._batchify_fn))
+        async_ret = self._pool.apply_async(
+            _worker_fn, (r, self._batchify_fn, self._use_shm))
         self._data_buffer[self._sent_idx] = async_ret
         self._sent_idx += 1
 
@@ -219,7 +318,24 @@ class _MultiWorkerIter:
         ret = self._data_buffer.pop(self._rcvd_idx)
         batch = ret.get(self._timeout)
         self._rcvd_idx += 1
+        if isinstance(batch, _ShmBatch):
+            return _batch_from_shm(batch, cpu())
         return _as_in_context(batch, cpu())
+
+    def __del__(self):
+        # an abandoned iterator still owns its prefetched shm segments
+        # (workers unregistered them from their resource trackers): drain
+        # and unlink or they outlive the process in /dev/shm
+        try:
+            for ret in self._data_buffer.values():
+                try:
+                    batch = ret.get(1)
+                except Exception:
+                    continue
+                if isinstance(batch, _ShmBatch):
+                    _discard_shm(batch)
+        except Exception:
+            pass
 
     def next(self):
         return self.__next__()

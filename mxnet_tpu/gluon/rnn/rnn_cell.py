@@ -56,11 +56,8 @@ def _format_sequence(length, inputs, layout, merge, in_layout=None):
         assert length is None or len(inputs) == length
         batch_size = inputs[0].shape[batch_axis]
         if merge is True:
-            inputs = nd.stack(*[i.expand_dims(axis) for i in inputs],
-                              axis=axis) if isinstance(inputs[0], nd.NDArray) \
-                else inputs
-            inputs = nd.concat(*[i for i in inputs], dim=axis) \
-                if not isinstance(inputs, nd.NDArray) else inputs
+            inputs = nd.concat(*[i.expand_dims(axis) for i in inputs],
+                               dim=axis)
     if isinstance(inputs, (list, tuple)):
         length = len(inputs)
     else:
@@ -155,12 +152,10 @@ class RecurrentCell(Block):
             outputs = _mask_sequence_variable_length(F, outputs, length,
                                                      valid_length, axis, True)
         if merge_outputs:
-            outputs = nd.stack(*[o.expand_dims(axis) for o in outputs],
-                               axis=0)
-            outputs = nd.concat(*[o for o in outputs], dim=axis) \
-                if isinstance(outputs, list) else outputs
-        if merge_outputs and isinstance(outputs, list):
-            outputs = nd.concat(*outputs, dim=axis)
+            # per-step (N,C) outputs -> one (.., T, ..) tensor on the
+            # layout's time axis
+            outputs = nd.concat(*[o.expand_dims(axis) for o in outputs],
+                                dim=axis)
         return outputs, states
 
     def _get_activation(self, F, inputs, activation, **kwargs):

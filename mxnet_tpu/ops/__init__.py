@@ -12,4 +12,5 @@ from . import random_ops  # noqa: F401  sampling ops
 from . import optimizer_ops  # noqa: F401  sgd/adam/... update kernels
 from . import rnn_ops      # noqa: F401  fused RNN/LSTM/GRU via lax.scan
 from . import quantization_ops  # noqa: F401  int8 quantize/dequant/QFC/QConv
+from . import extended     # noqa: F401  linalg_* / multi_* / LRN / SVM / ST
 from . import shape_hints  # noqa: F401  FInferShape-style param-shape hints

@@ -659,7 +659,9 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     """Create a symbolic variable. reference: symbol.py (var/Variable)."""
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable `name`")
-    attrs = dict(attr or {})
+    from ..attribute import current as _attr_current
+    attrs = _attr_current()  # active AttrScope attrs; explicit ones win
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = str(tuple(shape))
     if lr_mult is not None:
@@ -711,8 +713,10 @@ def load_json(json_str):
             kwargs = {k: _parse_attr(v)
                       for k, v in entry.get("attrs", {}).items()}
             op = _reg.get(entry["op"])
+            n_out = op.num_outputs or int(kwargs.get(
+                "num_outputs", kwargs.get("num_weights", 1)))
             node = Symbol(entry["op"], entry["name"], ins,
-                          kwargs=kwargs, num_outputs=op.num_outputs)
+                          kwargs=kwargs, num_outputs=n_out)
             built.append(node)
     heads = []
     for (idx, out_i, _) in graph["heads"]:
@@ -825,8 +829,16 @@ def _make_op(op_name):
                         v._attrs["__aux__"] = "True"
                     inputs.append(v)
             inputs.extend(extras)
-        return Symbol(op_name, name, inputs, attrs=attr, kwargs=sym_kwargs,
-                      num_outputs=op.num_outputs)
+        # ops with data-dependent output counts register num_outputs=0;
+        # the real count is their own kwarg (split: num_outputs, the
+        # multi_* fused optimizer updates: num_weights)
+        n_out = op.num_outputs or int(sym_kwargs.get(
+            "num_outputs", sym_kwargs.get("num_weights", 1)))
+        from ..attribute import current as _attr_current
+        merged_attr = _attr_current()
+        merged_attr.update(attr or {})
+        return Symbol(op_name, name, inputs, attrs=merged_attr,
+                      kwargs=sym_kwargs, num_outputs=n_out)
 
     sym_op.__name__ = op_name.lstrip("_") or op_name
     sym_op.__doc__ = op.doc or ("%s (symbolic, from shared op registry)"

@@ -1,0 +1,144 @@
+"""Small API namespaces (reference: python/mxnet/{rnn,visualization,
+monitor,util,attribute,engine,libinfo,log}.py + gluon/contrib/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+
+def test_legacy_rnn_lstm_unroll_executes():
+    cell = mx.rnn.LSTMCell(8, prefix="l0_")
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    ex = outputs.simple_bind(mx.cpu(), data=(2, 3, 4),
+                             l0_begin_state_0=(2, 8),
+                             l0_begin_state_1=(2, 8))
+    out = ex.forward(is_train=False,
+                     data=np.random.randn(2, 3, 4).astype(np.float32))
+    assert out[0].shape == (2, 3, 8)
+
+
+def test_legacy_rnn_stack_and_modifiers():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(8, prefix="g0_"))
+    stack.add(mx.rnn.DropoutCell(0.2))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.RNNCell(8, prefix="r0_")))
+    outs, states = stack.unroll(2, inputs=mx.sym.Variable("data"))
+    assert len(outs) == 2
+    assert len(states) == len(stack.state_info)
+
+
+def test_legacy_fused_rnn_unfuse():
+    fused = mx.rnn.FusedRNNCell(16, num_layers=2, mode="lstm")
+    stack = fused.unfuse()
+    assert len(stack._cells) == 2
+    assert isinstance(stack._cells[0], mx.rnn.LSTMCell)
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 50, rng.randint(3, 12)))
+                 for _ in range(100)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[5, 10, 15])
+    batch = next(iter(it))
+    assert batch.bucket_key in (5, 10, 15)
+    assert batch.data[0].shape == (8, batch.bucket_key)
+    assert batch.label[0].shape == (8, batch.bucket_key)
+    # label is data shifted by one
+    d = batch.data[0].asnumpy()
+    l = batch.label[0].asnumpy()
+    np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+
+
+def test_viz_print_summary(capsys):
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(d, mx.sym.Variable("fc_weight"),
+                               mx.sym.Variable("fc_bias"), num_hidden=4)
+    total = mx.viz.print_summary(mx.sym.softmax(fc),
+                                 shape={"data": (2, 8)})
+    assert total == 4 * 8 + 4  # weight + bias counted from inferred shapes
+    out = capsys.readouterr().out
+    assert "FullyConnected" in out
+    with pytest.raises(ImportError):
+        mx.viz.plot_network(fc)
+
+
+def test_monitor_collects_stats():
+    mon = mx.mon.Monitor(interval=1, pattern=".*")
+
+    class FakeExe:
+        arg_names = ["w"]
+        arg_arrays = [nd.array(np.array([1.0, -3.0], np.float32))]
+        grad_arrays = [nd.array(np.array([0.5, 0.5], np.float32))]
+        outputs = [nd.array(np.array([2.0], np.float32))]
+
+    mon.install(FakeExe())
+    mon.tic()
+    res = mon.toc()
+    names = {n for _, n, _ in res}
+    assert names == {"w", "w_grad", "output0"}
+    stats = {n: v for _, n, v in res}
+    assert abs(stats["w"] - 2.0) < 1e-6  # mean |[1,-3]|
+
+
+def test_attr_scope_nests():
+    with mx.AttrScope(__ctx_group__="a", lr_mult="2"):
+        assert mx.attribute.current()["__ctx_group__"] == "a"
+        with mx.AttrScope(__ctx_group__="b"):
+            cur = mx.attribute.current()
+            assert cur["__ctx_group__"] == "b"
+            assert cur["lr_mult"] == "2"
+        assert mx.attribute.current()["__ctx_group__"] == "a"
+    assert "__ctx_group__" not in mx.attribute.current()
+
+
+def test_engine_bulk_scope():
+    prev = mx.engine.set_bulk_size(10)
+    assert mx.engine.set_bulk_size(prev) == 10
+    with mx.engine.bulk(5):
+        pass
+
+
+def test_util_np_scopes_and_libinfo():
+    assert not mx.util.is_np_array()
+    with mx.util.np_array():
+        assert mx.util.is_np_array()
+        arr = mx.util.default_array([1.0, 2.0])
+        assert type(arr) is mx.np.ndarray
+    legacy = mx.util.default_array([1.0])
+    assert type(legacy) is mx.nd.NDArray
+    assert mx.libinfo.__version__
+    feats = mx.libinfo.features()
+    assert isinstance(feats, dict)
+    import os
+    assert os.path.isdir(mx.libinfo.find_include_path())
+
+
+def test_gluon_contrib_layers():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 6).astype(np.float32))
+    net = gluon.contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(4), gluon.nn.Dense(3))
+    net.initialize(ctx=mx.cpu())
+    assert net(x).shape == (2, 7)
+    assert gluon.contrib.nn.Identity()(x).shape == (2, 6)
+    sbn = gluon.contrib.nn.SyncBatchNorm(in_channels=3)
+    sbn.initialize(ctx=mx.cpu())
+    y = sbn(nd.array(rng.randn(2, 3, 4, 4).astype(np.float32)))
+    assert y.shape == (2, 3, 4, 4)
+
+
+def test_gluon_contrib_variational_dropout_trains():
+    from mxnet_tpu import autograd
+    mx.random.seed(3)
+    cell = gluon.contrib.rnn.VariationalDropoutCell(
+        gluon.rnn.GRUCell(8), drop_inputs=0.3, drop_outputs=0.3)
+    cell.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.randn(4, 5, 6).astype(np.float32))
+    with autograd.record():
+        outputs, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+        loss = (outputs * outputs).sum()
+    loss.backward()
+    assert outputs.shape == (4, 5, 8)
