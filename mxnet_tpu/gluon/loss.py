@@ -13,7 +13,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
-           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+           "PoissonNLLLoss", "CosineEmbeddingLoss", "SDMLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -349,3 +349,37 @@ class CosineEmbeddingLoss(Loss):
         eps_arr = 1e-12
         return x_dot_y / F.broadcast_maximum(
             x_norm * y_norm, F.ones_like(x_norm) * eps_arr)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss over paired batches.
+    reference: gluon/loss.py (SDMLLoss) — rows of x1 and x2 are positive
+    pairs; every other row is an in-batch negative. The pairwise-distance
+    softmax with smoothed targets pulls pairs together without explicit
+    negative mining."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing_parameter = smoothing_parameter
+
+    @staticmethod
+    def _pairwise_dist(F, x1, x2):
+        # squared euclidean: |a|^2 - 2ab + |b|^2
+        a2 = F.sum(x1 * x1, axis=1).reshape((-1, 1))
+        b2 = F.sum(x2 * x2, axis=1).reshape((1, -1))
+        ab = F.dot(x1, x2.T)
+        return F.relu(a2 - 2 * ab + b2)
+
+    def hybrid_forward(self, F, x1, x2, sample_weight=None):
+        n = x1.shape[0]
+        dist = self._pairwise_dist(F, x1, x2)
+        logp = F.log_softmax(-dist, axis=1)
+        # smoothed targets: 1-eps on the diagonal pair, eps spread over
+        # the in-batch negatives
+        eps = self._smoothing_parameter
+        eye = F.one_hot(F.arange(0, n), n)
+        labels = eye * (1 - eps) + (1 - eye) * (eps / max(n - 1, 1))
+        loss = -F.sum(labels * logp, axis=1)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)

@@ -142,3 +142,23 @@ def test_gluon_contrib_variational_dropout_trains():
         loss = (outputs * outputs).sum()
     loss.backward()
     assert outputs.shape == (4, 5, 8)
+
+
+def test_sdml_loss():
+    """reference: gluon/loss.py (SDMLLoss) — per-sample smoothed in-batch
+    softmax metric loss; matched pairs score lower than random ones."""
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(0)
+    x1 = nd.array(rng.randn(6, 8).astype(np.float32))
+    x2 = nd.array(rng.randn(6, 8).astype(np.float32))
+    x1.attach_grad()
+    loss_fn = gluon.loss.SDMLLoss()
+    with autograd.record():
+        l = loss_fn(x1, x2)
+        total = l.mean()
+    total.backward()
+    assert l.shape == (6,)
+    assert np.abs(x1.grad.asnumpy()).sum() > 0
+    matched = float(loss_fn(x2, x2).mean().asnumpy())
+    rand = float(total.asnumpy())
+    assert matched < rand
