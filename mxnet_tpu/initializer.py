@@ -80,7 +80,13 @@ class Initializer:
             desc.global_init = self
         init = desc.attrs.get("__init__", "")
         if init:
-            create(json.loads(init)[0], **json.loads(init)[1])._init_weight(desc, arr)
+            # symbol __init__ attrs are either the JSON [name, kwargs] an
+            # Initializer dumps, or a bare registered name ("zeros")
+            try:
+                spec = json.loads(init)
+                create(spec[0], **spec[1])._init_weight(desc, arr)
+            except ValueError:
+                create(init)._init_weight(desc, arr)
             return
         name = str(desc)
         if name.endswith("weight"):
