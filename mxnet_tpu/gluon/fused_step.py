@@ -26,7 +26,11 @@ Semantics parity with `loss.backward(); trainer.step(batch_size)`:
     (ops/optimizer_ops.py) the imperative Updater calls, with lr/wd computed
     host-side per step by the optimizer's own scheduler logic (exact
     `_update_count`/`lr_scheduler` semantics) and fed as device scalars so
-    one compilation serves every step;
+    one compilation serves every step. One deliberate dtype nuance: the
+    scalars arrive as f32 device values (the imperative path feeds weakly
+    typed python floats), so a bf16 parameter's update computes in f32 and
+    rounds once at write-back — bit-identical for f32 params (the parity
+    tests), and at-least-imperative precision for bf16;
   * BatchNorm moving stats update via the CachedOp aux-collector mechanism
     and are written back each step;
   * dropout draws from the per-step RNG key (mx.random.seed reproducible).
@@ -53,10 +57,17 @@ __all__ = ["FusedTrainStep"]
 
 # ---------------------------------------------------------------------------
 # per-optimizer split: host-side scalar schedule vs traced device update.
-# Each entry: (host_fn(opt, indices) -> dict of (n,) f32 np arrays,
-#              device_fn(opt, w, g, state, lr, wd, rescale) -> (new_w, new_state))
+# Each entry: (host_fn(opt, indices) -> dict of (n,) f32 np arrays — the
+#              per-step scalars; always at least {"lrs","wds"}, plus extras
+#              such as "ts" for update-count-dependent math,
+#              device_fn(opt, w, g, state, sc, rescale) -> (new_w, new_state)
+#              with sc a dict of 0-d traced scalars, one per host key).
 # The device fns call the registered optimizer ops so numerics are identical
 # to the imperative Updater path (reference: src/operator/optimizer_op.cc).
+# Scalars that depend on the update count t (Adam bias correction, FTML/
+# Nadam/LAMB schedules) are either folded into lr host-side or passed as
+# traced scalars — never baked into the compiled program as constants, so
+# one compilation serves every step.
 # ---------------------------------------------------------------------------
 
 def _count_and_lrs(opt, indices):
@@ -82,14 +93,64 @@ def _bias_corrected_host(opt, indices):
     return {"lrs": lrs, "wds": wds}
 
 
+def _adamax_host(opt, indices):
+    """Adamax folds only the first-moment correction (Adamax.update)."""
+    lrs, wds = _count_and_lrs(opt, indices)
+    for j, i in enumerate(indices):
+        t = opt._index_update_count[i]
+        lrs[j] /= (1.0 - opt.beta1 ** t)
+    return {"lrs": lrs, "wds": wds}
+
+
+def _t_host(opt, indices):
+    """FTML/LAMB: update count enters the op math — pass t per param."""
+    lrs, wds = _count_and_lrs(opt, indices)
+    ts = _np.asarray([opt._index_update_count[i] for i in indices],
+                     _np.float32)
+    return {"lrs": lrs, "wds": wds, "ts": ts}
+
+
+def _nadam_host(opt, indices):
+    """Nadam: t AND the running m_schedule product, advanced per index in
+    update order — exactly Nadam.update's host bookkeeping."""
+    lrs, wds = _count_and_lrs(opt, indices)
+    ts, mscheds = [], []
+    for i in indices:
+        t = opt._index_update_count[i]
+        ts.append(t)
+        mscheds.append(opt.m_schedule)
+        momentum_t = opt.beta1 * (
+            1.0 - 0.5 * 0.96 ** (t * opt.schedule_decay))
+        opt.m_schedule = opt.m_schedule * momentum_t
+    return {"lrs": lrs, "wds": wds,
+            "ts": _np.asarray(ts, _np.float32),
+            "mscheds": _np.asarray(mscheds, _np.float32)}
+
+
+def _lars_host(opt, indices):
+    """LARS skips rate scaling for gamma/beta/bias params by NAME — a static
+    property, shipped as a 0/1 mask so the device fn stays name-free."""
+    lrs, wds = _count_and_lrs(opt, indices)
+    mask = _np.asarray(
+        [0.0 if opt.idx2name.get(i, str(i)).endswith(
+            ("gamma", "beta", "bias")) else 1.0 for i in indices],
+        _np.float32)
+    return {"lrs": lrs, "wds": wds, "lars_masks": mask}
+
+
 def _clipv(opt):
     from ..optimizer.optimizer import _clip
     return _clip(opt.clip_gradient)
 
 
-def _sgd_device(opt, w, g, state, lr, wd, rescale):
-    from ..ops.registry import get as _get_op
-    kw = dict(lr=lr, wd=wd, rescale_grad=rescale, clip_gradient=_clipv(opt))
+def _get_op(name):
+    from ..ops.registry import get
+    return get(name)
+
+
+def _sgd_device(opt, w, g, state, sc, rescale):
+    kw = dict(lr=sc["lrs"], wd=sc["wds"], rescale_grad=rescale,
+              clip_gradient=_clipv(opt))
     if state is None:
         return _get_op("sgd_update").fn(w, g, **kw), None
     new_w, new_m = _get_op("sgd_mom_update").fn(
@@ -97,9 +158,9 @@ def _sgd_device(opt, w, g, state, lr, wd, rescale):
     return new_w, new_m
 
 
-def _nag_device(opt, w, g, state, lr, wd, rescale):
-    from ..ops.registry import get as _get_op
-    kw = dict(lr=lr, wd=wd, rescale_grad=rescale, clip_gradient=_clipv(opt))
+def _nag_device(opt, w, g, state, sc, rescale):
+    kw = dict(lr=sc["lrs"], wd=sc["wds"], rescale_grad=rescale,
+              clip_gradient=_clipv(opt))
     if state is None:
         return _get_op("sgd_update").fn(w, g, **kw), None
     new_w, new_m = _get_op("nag_mom_update").fn(
@@ -107,24 +168,151 @@ def _nag_device(opt, w, g, state, lr, wd, rescale):
     return new_w, new_m
 
 
-def _adam_device(opt, w, g, state, lr, wd, rescale):
-    from ..ops.registry import get as _get_op
+def _adam_device(opt, w, g, state, sc, rescale):
     mean, var = state
     new_w, new_m, new_v = _get_op("adam_update").fn(
-        w, g, mean, var, lr=lr, wd=wd, beta1=opt.beta1, beta2=opt.beta2,
-        epsilon=opt.epsilon, rescale_grad=rescale,
+        w, g, mean, var, lr=sc["lrs"], wd=sc["wds"], beta1=opt.beta1,
+        beta2=opt.beta2, epsilon=opt.epsilon, rescale_grad=rescale,
         clip_gradient=_clipv(opt))
     return new_w, (new_m, new_v)
 
 
-def _adamw_device(opt, w, g, state, lr, wd, rescale):
-    from ..ops.registry import get as _get_op
+def _adamw_device(opt, w, g, state, sc, rescale):
     mean, var = state
     new_w, new_m, new_v = _get_op("adamw_update").fn(
-        w, g, mean, var, lr=lr, wd=wd, beta1=opt.beta1, beta2=opt.beta2,
-        epsilon=opt.epsilon, eta=opt.eta, rescale_grad=rescale,
-        clip_gradient=_clipv(opt))
+        w, g, mean, var, lr=sc["lrs"], wd=sc["wds"], beta1=opt.beta1,
+        beta2=opt.beta2, epsilon=opt.epsilon, eta=opt.eta,
+        rescale_grad=rescale, clip_gradient=_clipv(opt))
     return new_w, (new_m, new_v)
+
+
+def _signum_device(opt, w, g, state, sc, rescale):
+    kw = dict(lr=sc["lrs"], wd=sc["wds"], rescale_grad=rescale,
+              clip_gradient=_clipv(opt))
+    if state is None:
+        return _get_op("signsgd_update").fn(w, g, **kw), None
+    new_w, new_m = _get_op("signum_update").fn(
+        w, g, state, momentum=opt.momentum, wd_lh=opt.wd_lh, **kw)
+    return new_w, new_m
+
+
+def _ftml_device(opt, w, g, state, sc, rescale):
+    d, v, z = state
+    new_w, new_d, new_v, new_z = _get_op("ftml_update").fn(
+        w, g, d, v, z, lr=sc["lrs"], wd=sc["wds"], beta1=opt.beta1,
+        beta2=opt.beta2, epsilon=opt.epsilon, rescale_grad=rescale,
+        clip_grad=_clipv(opt), t=sc["ts"])
+    return new_w, (new_d, new_v, new_z)
+
+
+def _adagrad_device(opt, w, g, state, sc, rescale):
+    new_w, new_h = _get_op("adagrad_update").fn(
+        w, g, state, lr=sc["lrs"], wd=sc["wds"],
+        epsilon=opt.float_stable_eps, rescale_grad=rescale,
+        clip_gradient=_clipv(opt))
+    return new_w, new_h
+
+
+def _adadelta_device(opt, w, g, state, sc, rescale):
+    acc_g, acc_delta = state
+    new_w, new_g, new_d = _get_op("adadelta_update").fn(
+        w, g, acc_g, acc_delta, rho=opt.rho, epsilon=opt.epsilon,
+        wd=sc["wds"], rescale_grad=rescale, clip_gradient=_clipv(opt))
+    return new_w, (new_g, new_d)
+
+
+def _adamax_device(opt, w, g, state, sc, rescale):
+    mean, u = state
+    new_w, new_m, new_u = _get_op("adamax_update").fn(
+        w, g, mean, u, lr=sc["lrs"], wd=sc["wds"], beta1=opt.beta1,
+        beta2=opt.beta2, rescale_grad=rescale, clip_gradient=_clipv(opt))
+    return new_w, (new_m, new_u)
+
+
+def _nadam_device(opt, w, g, state, sc, rescale):
+    mean, var = state
+    new_w, new_m, new_v = _get_op("nadam_update").fn(
+        w, g, mean, var, lr=sc["lrs"], wd=sc["wds"], beta1=opt.beta1,
+        beta2=opt.beta2, epsilon=opt.epsilon,
+        schedule_decay=opt.schedule_decay, rescale_grad=rescale,
+        clip_gradient=_clipv(opt), t=sc["ts"], m_schedule=sc["mscheds"])
+    return new_w, (new_m, new_v)
+
+
+def _rmsprop_device(opt, w, g, state, sc, rescale):
+    from ..optimizer.optimizer import _clip
+    kw = dict(lr=sc["lrs"], wd=sc["wds"], gamma1=opt.gamma1,
+              epsilon=opt.epsilon, rescale_grad=rescale,
+              clip_gradient=_clipv(opt), clip_weights=_clip(opt.clip_weights))
+    if not opt.centered:
+        new_w, new_n = _get_op("rmsprop_update").fn(w, g, state, **kw)
+        return new_w, new_n
+    n, gbar, delta = state
+    new_w, new_n, new_g, new_d = _get_op("rmspropalex_update").fn(
+        w, g, n, gbar, delta, gamma2=opt.gamma2, **kw)
+    return new_w, (new_n, new_g, new_d)
+
+
+def _ftrl_device(opt, w, g, state, sc, rescale):
+    z, n = state
+    new_w, new_z, new_n = _get_op("ftrl_update").fn(
+        w, g, z, n, lr=sc["lrs"], wd=sc["wds"], lamda1=opt.lamda1,
+        beta=opt.beta, rescale_grad=rescale, clip_gradient=_clipv(opt))
+    return new_w, (new_z, new_n)
+
+
+def _lamb_device(opt, w, g, state, sc, rescale):
+    from ..optimizer.optimizer import _clip
+    mean, var = state
+    g_dir, new_m, new_v = _get_op("lamb_update_phase1").fn(
+        w, g, mean, var, beta1=opt.beta1, beta2=opt.beta2,
+        epsilon=opt.epsilon, t=sc["ts"],
+        bias_correction=opt.bias_correction, wd=sc["wds"],
+        rescale_grad=rescale, clip_gradient=_clipv(opt))
+    r1 = jnp.linalg.norm(w)
+    r2 = jnp.linalg.norm(g_dir)
+    new_w = _get_op("lamb_update_phase2").fn(
+        w, g_dir, r1, r2, lr=sc["lrs"],
+        lower_bound=_clip(opt.lower_bound),
+        upper_bound=_clip(opt.upper_bound))
+    return new_w, (new_m, new_v)
+
+
+def _lars_device(opt, w, g, state, sc, rescale):
+    """LARS.update: layer rate = eta*||w||/(||g||+wd*||w||+eps) on the RAW
+    grad, skipped (mask=0) for gamma/beta/bias, then the plain SGD ops."""
+    lr, wd = sc["lrs"], sc["wds"]
+    w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+    g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+    lars = jnp.where((w_norm > 0.0) & (g_norm > 0.0),
+                     opt.eta * w_norm / (g_norm + wd * w_norm + opt.eps),
+                     1.0)
+    lr = jnp.where(sc["lars_masks"] > 0.0, lars * lr, lr)
+    kw = dict(lr=lr, wd=wd, rescale_grad=rescale,
+              clip_gradient=_clipv(opt))
+    if state is None:
+        return _get_op("sgd_update").fn(w, g, **kw), None
+    new_w, new_m = _get_op("sgd_mom_update").fn(
+        w, g, state, momentum=opt.momentum, **kw)
+    return new_w, new_m
+
+
+def _dcasgd_device(opt, w, g, state, sc, rescale):
+    """DCASGD.update's inline math (delay-compensated step), traced."""
+    lr, wd = sc["lrs"], sc["wds"]
+    graw = g.astype(jnp.float32) * rescale
+    if opt.clip_gradient is not None:
+        graw = jnp.clip(graw, -opt.clip_gradient, opt.clip_gradient)
+    mom, prev_w = state
+    w32 = w.astype(jnp.float32)
+    pw = prev_w.astype(jnp.float32)
+    step = -lr * (graw + wd * w32 + opt.lamda * graw * graw * (w32 - pw))
+    if mom is not None:
+        m = opt.momentum * mom.astype(jnp.float32) + step
+        new_mom, step = m, m
+    else:
+        new_mom = None
+    return (w32 + step).astype(w.dtype), (new_mom, w)
 
 
 _FUSABLE = {
@@ -132,7 +320,22 @@ _FUSABLE = {
     "nag": (_sgd_host, _nag_device),
     "adam": (_bias_corrected_host, _adam_device),
     "adamw": (_bias_corrected_host, _adamw_device),
+    "signum": (_sgd_host, _signum_device),
+    "signsgd": (_sgd_host, _signum_device),
+    "ftml": (_t_host, _ftml_device),
+    "adagrad": (_sgd_host, _adagrad_device),
+    "adadelta": (_sgd_host, _adadelta_device),
+    "adamax": (_adamax_host, _adamax_device),
+    "nadam": (_nadam_host, _nadam_device),
+    "rmsprop": (_sgd_host, _rmsprop_device),
+    "ftrl": (_sgd_host, _ftrl_device),
+    "lamb": (_t_host, _lamb_device),
+    "lars": (_lars_host, _lars_device),
+    "dcasgd": (_sgd_host, _dcasgd_device),
 }
+# SGLD stays imperative-only: its Langevin noise draws from the global RNG
+# stream per update call; a fused replay could not keep that stream's
+# imperative-path reproducibility contract.
 
 
 def _state_raws(state):
@@ -323,7 +526,7 @@ class FusedTrainStep:
             # shared across traces (round-2 verdict Weak #10)
             holder = {"in_fmt": in_fmt}
 
-            def run(train_raws, other_raws, state_raws, lrs, wds, rescale,
+            def run(train_raws, other_raws, state_raws, scal, rescale,
                     data_raws, label_raw, rng_key):
                 def loss_fn(train_raws_):
                     from .. import random as _random
@@ -368,8 +571,9 @@ class FusedTrainStep:
                     jax.value_and_grad(loss_fn, has_aux=True)(train_raws)
                 new_train, new_states = [], []
                 for j in range(len(train_raws)):
+                    sc = {k: v[j] for k, v in scal.items()}
                     w, s = dev_fn(opt, train_raws[j], grads[j], state_raws[j],
-                                  lrs[j], wds[j], rescale)
+                                  sc, rescale)
                     new_train.append(w.astype(train_raws[j].dtype))
                     new_states.append(_state_cast_like(s, state_raws[j]))
                 return tuple(new_train), tuple(new_states), aux_new, loss_mean
@@ -404,22 +608,41 @@ class FusedTrainStep:
         opt.rescale_grad = trainer._scale / batch_size
         scal = self._host_fn(opt, self._train_idx)
 
-        # lr/wd/rescale change rarely (only via scheduler / set_learning_rate
-        # / batch-size change); re-upload to device only when the host values
-        # do change, else each step pays three H2D transfers
+        # the step scalars (lr/wd/rescale, plus t-schedule extras for some
+        # optimizers) change rarely or predictably; re-upload to device only
+        # when the host values change, else each step pays H2D transfers
         cache = self._scal_cache
-        if (cache is None or cache[0] != opt.rescale_grad
-                or not _np.array_equal(cache[1], scal["lrs"])
-                or not _np.array_equal(cache[2], scal["wds"])):
-            cache = (opt.rescale_grad, scal["lrs"], scal["wds"],
-                     jnp.asarray(scal["lrs"]), jnp.asarray(scal["wds"]),
-                     jnp.float32(opt.rescale_grad))
+        if (cache is None or cache["rescale"] != opt.rescale_grad
+                or cache["np"].keys() != scal.keys()
+                or any(not _np.array_equal(cache["np"][k], scal[k])
+                       for k in scal)):
+            cache = {"rescale": opt.rescale_grad, "np": scal,
+                     "dev": {k: jnp.asarray(v) for k, v in scal.items()},
+                     "rescale_dev": jnp.float32(opt.rescale_grad)}
             self._scal_cache = cache
-        lrs_dev, wds_dev, rescale_dev = cache[3], cache[4], cache[5]
+        scal_dev, rescale_dev = cache["dev"], cache["rescale_dev"]
 
         train_raws = tuple(p._read() for p in self._train_nds)
         other_raws = tuple(p._read() for p in self._other_nds)
         state_raws = tuple(_state_raws(s) for s in self._states)
+        if self._donate:
+            # NDArray.copy() shares the immutable buffer (copy-on-write), so
+            # a state that starts as weight.copy() (DCASGD's prev_weight)
+            # aliases a donated weight buffer — XLA rejects donating one
+            # buffer twice. Break the alias with a real device copy.
+            seen = {id(r) for r in train_raws}
+
+            def _break_alias(x):
+                if x is None:
+                    return None
+                if isinstance(x, (tuple, list)):
+                    return tuple(_break_alias(e) for e in x)
+                if id(x) in seen:
+                    return jnp.copy(x)
+                seen.add(id(x))
+                return x
+
+            state_raws = _break_alias(state_raws)
         rng_key = _random.take_key(ctx)
 
         data_raws = tuple(a._read() for a in flat_data)
@@ -431,7 +654,7 @@ class FusedTrainStep:
 
         new_train, new_states, aux_new, loss_mean = jitted(
             train_raws, other_raws, state_raws,
-            lrs_dev, wds_dev, rescale_dev,
+            scal_dev, rescale_dev,
             data_raws, label_raw, rng_key)
 
         with autograd.pause():

@@ -33,6 +33,13 @@ __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "moveaxis", "split_v2"]
 
 
+def _needs_hard_barrier(client):
+    """True for PjRt transports whose block_until_ready acks early (the
+    axon tunnel, observed 2026-07-30) — there WaitToRead must add a 1-elem
+    D2H pull to be a real barrier."""
+    return "axon" in (getattr(client, "platform_version", "") or "").lower()
+
+
 def _is_tracer(x):
     return isinstance(x, jax.core.Tracer)
 
@@ -146,7 +153,22 @@ class NDArray:
         return _np.asarray(self._read())
 
     def wait_to_read(self):
-        jax.block_until_ready(self._read())
+        arr = self._read()
+        jax.block_until_ready(arr)
+        # Some PjRt transports (the axon tunnel, observed 2026-07-30) ack
+        # block_until_ready before execution finishes. MXNet's WaitToRead
+        # contract is a hard barrier — errors and timing key off it — so
+        # also pull one element D2H, which cannot complete early.
+        if isinstance(arr, jax.Array) and not _is_tracer(arr):
+            try:
+                needs = _needs_hard_barrier(next(iter(arr.devices())).client)
+            except Exception:   # committed-less / donated-away arrays
+                needs = False
+            if needs:
+                # device execution errors must propagate — this IS the
+                # barrier where MXNet's contract surfaces them
+                flat = arr.reshape(-1)[:1] if arr.ndim else arr
+                _np.asarray(jax.device_get(flat))
         return self
 
     wait_to_write = wait_to_read

@@ -33,6 +33,19 @@ def _data(n=16, d=12, classes=8, seed=0):
     ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
     ("adam", {"learning_rate": 0.01}),
     ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("signum", {"learning_rate": 0.01, "momentum": 0.9, "wd_lh": 1e-4}),
+    ("signsgd", {"learning_rate": 0.005}),
+    ("ftml", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.05}),
+    ("adadelta", {"rho": 0.9, "epsilon": 1e-5}),
+    ("adamax", {"learning_rate": 0.002}),
+    ("nadam", {"learning_rate": 0.005}),
+    ("rmsprop", {"learning_rate": 0.005}),
+    ("rmsprop", {"learning_rate": 0.005, "centered": True, "gamma2": 0.85}),
+    ("ftrl", {"learning_rate": 0.05, "lamda1": 0.001}),
+    ("lamb", {"learning_rate": 0.01}),
+    ("lars", {"learning_rate": 0.05, "momentum": 0.9, "eta": 0.001}),
+    ("dcasgd", {"learning_rate": 0.05, "momentum": 0.9}),
 ])
 def test_fused_matches_imperative(optimizer, opt_args):
     mx.random.seed(7)
