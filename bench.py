@@ -188,15 +188,74 @@ def bench_bert(on_accel):
     return batch * seq * steps / dt, "bert"
 
 
+def bench_bert_gluon(on_accel):
+    """Config #3 through the USER-FACING Gluon API: model_zoo BERT
+    (fused interleaved-selfatt ops) + Trainer + FusedTrainStep — the BERT
+    analog of the Gluon ResNet headline. Same protocol/bar as BENCH=bert."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo import bert as zoo_bert
+
+    ctx = mx.tpu() if on_accel else mx.cpu()
+    batch, seq = (128, 128) if on_accel else (4, 32)
+    steps, warmup = (50, 10) if on_accel else (4, 2)
+    vocab = 30522 if on_accel else 256
+
+    mx.random.seed(0)
+    with mx.Context(ctx):
+        if on_accel:
+            net = zoo_bert.bert_12_768_12(dropout=0.0)
+        else:
+            net = zoo_bert.BERTModel(vocab_size=vocab, units=64,
+                                     hidden_size=128, num_layers=2,
+                                     num_heads=4, max_length=seq,
+                                     dropout=0.0)
+        net.initialize(mx.init.Normal(0.02), ctx=ctx)
+        net.cast("bfloat16")
+        net.hybridize(static_alloc=True)
+
+        rng = np.random.RandomState(1)
+        x = nd.array(rng.randint(0, vocab, (batch, seq)), ctx=ctx,
+                     dtype="float32")
+        y = nd.array(rng.randint(0, vocab, (batch, seq)), ctx=ctx,
+                     dtype="float32")
+        net(x)
+
+        sce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def mlm_loss(out, label):
+            # out = (seq_out, pooled, nsp_logits, mlm_logits)
+            return sce(out[3], label)
+
+        trainer = gluon.Trainer(net.collect_params(), "adamw",
+                                {"learning_rate": 1e-4, "wd": 0.01})
+        fused = gluon.FusedTrainStep(net, mlm_loss, trainer)
+
+        for _ in range(warmup):
+            loss = fused(x, y)
+        _sync(loss.data_jax)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = fused(x, y)
+        _sync(loss.data_jax)
+        dt = time.perf_counter() - t0
+    return batch * seq * steps / dt, "bert_gluon"
+
+
 def main():
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
     which = os.environ.get("BENCH", "gluon")
-    if which == "bert":
-        tok_s, _ = bench_bert(on_accel)
+    if which in ("bert", "bert_gluon"):
+        tok_s, _ = (bench_bert if which == "bert"
+                    else bench_bert_gluon)(on_accel)
         bert_bar = 126720.0
         name = ("bert_base_train_tok_per_sec" if on_accel
                 else "bert_tiny_cpu_tok_per_sec")
+        if which == "bert_gluon":
+            name = name.replace("tok_per_sec", "gluon_tok_per_sec")
         print(json.dumps({
             "metric": name,
             "value": round(tok_s, 2),

@@ -205,7 +205,8 @@ def size(a):
 
 
 from . import random  # noqa: E402
+from . import linalg  # noqa: E402
 
 __all__ = ["ndarray", "array", "asarray", "zeros", "ones", "full", "arange",
-           "linspace", "eye", "random"] + \
+           "linspace", "eye", "random", "linalg"] + \
     [n for n, _ in _FUNCS if n in _here]

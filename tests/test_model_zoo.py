@@ -57,3 +57,50 @@ def test_hybridize_and_export(tmp_path):
     out2 = net(x)  # cached path
     np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_bert_model_zoo():
+    """gluon.model_zoo.bert (reference: GluonNLP BERTModel on the
+    _contrib_interleaved_matmul_selfatt_* op surface): forward shapes,
+    valid_length masking isolates padding, backward, hybridize."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    m = bert.BERTModel(vocab_size=100, units=32, hidden_size=64,
+                       num_layers=2, num_heads=4, max_length=64,
+                       dropout=0.1)
+    m.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    rng = np.random.RandomState(0)
+    tok = nd.array(rng.randint(0, 100, (3, 10)), dtype="float32")
+    tt = nd.zeros((3, 10))
+    vl = nd.array([10, 7, 4], dtype="float32")
+    seq, pooled, nsp, mlm = m(tok, tt, vl)
+    assert seq.shape == (3, 10, 32)
+    assert pooled.shape == (3, 32)
+    assert nsp.shape == (3, 2)
+    assert mlm.shape == (3, 10, 100)
+
+    # perturbing PADDED tokens must not change valid positions
+    tok2 = tok.asnumpy().copy()
+    tok2[1, 7:] = 55
+    seq2 = m(nd.array(tok2), tt, vl)[0]
+    np.testing.assert_allclose(seq.asnumpy()[1, :7], seq2.asnumpy()[1, :7],
+                               atol=1e-5)
+
+    lossf = gluon.loss.SoftmaxCrossEntropyLoss()
+    lbl = nd.array(rng.randint(0, 2, (3,)), dtype="float32")
+    with autograd.record():
+        logits = m(tok, tt, vl)[2]
+        L = lossf(logits, lbl).mean()
+    L.backward()
+    assert np.isfinite(float(L.asnumpy()))
+
+    m.hybridize()
+    s2 = m(tok, tt, vl)[0]
+    assert s2.shape == (3, 10, 32)
+
+    # presets resolve
+    big = bert.get_bert_model("bert_12_768_12")
+    assert big.encoder._num_heads == 12

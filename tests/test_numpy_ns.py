@@ -133,3 +133,82 @@ def test_np_nd_interop_and_autograd():
     legacy = x.as_nd_ndarray()
     assert type(legacy) is mx.nd.NDArray
     np.testing.assert_array_equal(legacy.asnumpy(), x.asnumpy())
+
+
+def test_np_linalg_namespace():
+    """mx.np.linalg (reference: python/mxnet/numpy/linalg.py) — factor
+    routines roundtrip and the ops ride the autograd tape."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    a = mx.np.array(rng.randn(4, 4).astype(np.float32))
+    spd = mx.np.matmul(a, a.T) + 4 * mx.np.eye(4)
+
+    assert float(mx.np.linalg.norm(a).asnumpy()) > 0
+    L = mx.np.linalg.cholesky(spd)
+    np.testing.assert_allclose(mx.np.matmul(L, L.T).asnumpy(),
+                               spd.asnumpy(), rtol=1e-4)
+    u, s, vt = mx.np.linalg.svd(a)
+    np.testing.assert_allclose((u.asnumpy() * s.asnumpy()) @ vt.asnumpy(),
+                               a.asnumpy(), atol=1e-4)
+    x = mx.np.linalg.solve(spd, mx.np.ones((4,)))
+    np.testing.assert_allclose(mx.np.matmul(spd, x).asnumpy(),
+                               np.ones(4), atol=1e-4)
+    inv = mx.np.linalg.inv(spd)
+    np.testing.assert_allclose(mx.np.matmul(spd, inv).asnumpy(),
+                               np.eye(4), atol=1e-4)
+    sign, logdet = mx.np.linalg.slogdet(spd)
+    assert float(sign.asnumpy()) == 1.0
+    qq, rr = mx.np.linalg.qr(a)
+    np.testing.assert_allclose(mx.np.matmul(qq, rr).asnumpy(),
+                               a.asnumpy(), atol=1e-4)
+    assert type(L) is mx.np.ndarray and type(u) is mx.np.ndarray
+
+    # differentiable: d(det)/dA = det(A) * inv(A).T
+    w = mx.np.array(np.eye(3, dtype=np.float32) * 2.0)
+    w.attach_grad()
+    with autograd.record():
+        y = mx.np.linalg.det(w)
+    y.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), np.eye(3) * 4.0, atol=1e-4)
+
+
+def test_np_linalg_multioutput_backward():
+    """NamedTuple-output linalg ops must differentiate: slogdet, svd
+    (reduced — also the reference's convention), eigh, qr backward."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(1)
+    w = mx.np.array((rng.randn(3, 3) @ rng.randn(3, 3).T +
+                     3 * np.eye(3)).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        sign, ld = mx.np.linalg.slogdet(w)
+    ld.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               np.linalg.inv(w.asnumpy()).T,
+                               rtol=1e-4, atol=1e-5)
+
+    # reduced SVD on a non-square matrix, forward + backward under record
+    a = mx.np.array(rng.randn(3, 5).astype(np.float32))
+    a.attach_grad()
+    with autograd.record():
+        u, s, vt = mx.np.linalg.svd(a)
+        y = mx.np.sum(s)
+    assert u.shape == (3, 3) and s.shape == (3,) and vt.shape == (3, 5)
+    y.backward()
+    assert np.isfinite(a.grad.asnumpy()).all()
+
+    spd = w.asnumpy()
+    h = mx.np.array(spd)
+    h.attach_grad()
+    with autograd.record():
+        vals, vecs = mx.np.linalg.eigh(h)
+        z = mx.np.sum(vals)
+    z.backward()
+    # d(sum eigvals)/dA = d(trace)/dA = I for symmetric A
+    np.testing.assert_allclose(h.grad.asnumpy(), np.eye(3), atol=1e-4)
