@@ -140,6 +140,11 @@ class BERTModel(HybridBlock):
                  token_type_vocab_size=2, dropout=0.1, use_pooler=True,
                  use_decoder=True, use_classifier=True, **kwargs):
         super().__init__(**kwargs)
+        if use_classifier and not use_pooler:
+            # same contract as GluonNLP's BERTModel: the NSP head consumes
+            # the pooled [CLS] vector
+            raise ValueError("BERTModel: use_classifier=True requires "
+                             "use_pooler=True (pass use_classifier=False)")
         self._use_pooler = use_pooler
         self._use_decoder = use_decoder
         self._use_classifier = use_classifier

@@ -1,0 +1,305 @@
+"""Contrib vision / detection operator pack.
+
+reference: src/operator/contrib/ — `bilinear_resize-inl.h`
+(BilinearResize2D), `adaptive_avg_pooling-inl.h` (AdaptiveAvgPooling2D),
+`roi_align.cc` (ROIAlign), `bounding_box.cc` (box_nms / box_iou /
+box_encode / box_decode), `arange_like-inl.h`. These back the GluonCV
+detection/segmentation model family on the reference.
+
+TPU-first notes: everything is static-shape and branch-free so XLA can tile
+it — NMS runs a fixed-trip `lax.fori_loop` over score-sorted candidates
+with a suppression mask (no dynamic early-exit, which would block
+compilation); AdaptiveAvgPooling uses a summed-area table (two cumsums +
+four gathers per output cell) instead of data-dependent window loops;
+ROIAlign vmaps bilinear sampling over rois.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# arange_like (reference: contrib/arange_like-inl.h)
+# ---------------------------------------------------------------------------
+@register("_contrib_arange_like", differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    repeat = max(1, int(repeat))
+    if axis is None:
+        n = 1
+        for d in data.shape:
+            n *= d
+        idx = jnp.arange(n) // repeat
+        return (start + step * idx.astype(data.dtype)).reshape(data.shape)
+    n = data.shape[axis]
+    idx = jnp.arange(n) // repeat
+    return start + step * idx.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# BilinearResize2D (reference: contrib/bilinear_resize-inl.h) — NCHW,
+# align_corners sampling like the reference's kernel
+# ---------------------------------------------------------------------------
+def _linear_coords(out_size, in_size, dtype):
+    if out_size == 1 or in_size == 1:
+        src = jnp.zeros((out_size,), dtype)
+    else:
+        scale = (in_size - 1.0) / (out_size - 1.0)
+        src = jnp.arange(out_size, dtype=dtype) * dtype.type(scale) \
+            if hasattr(dtype, "type") else jnp.arange(out_size) * scale
+        src = jnp.asarray(src, dtype)
+    lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    frac = src - lo.astype(src.dtype)
+    return lo, hi, frac
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, mode="size"):
+    if mode != "size":
+        raise NotImplementedError(
+            "BilinearResize2D: mode=%r not supported (only 'size'; the "
+            "reference's odd/even/like modes are size policies the caller "
+            "can compute and pass as height/width)" % (mode,))
+    n, c, h, w = data.shape
+    # reference defaults height/width to 1 when neither the size nor the
+    # per-axis scale is given
+    oh = (int(height) if height else
+          int(round(h * float(scale_height))) if scale_height else 1)
+    ow = (int(width) if width else
+          int(round(w * float(scale_width))) if scale_width else 1)
+    f32 = data.astype(jnp.float32)
+    ylo, yhi, yf = _linear_coords(oh, h, jnp.float32)
+    xlo, xhi, xf = _linear_coords(ow, w, jnp.float32)
+    top = f32[:, :, ylo, :] * (1 - yf)[None, None, :, None] + \
+        f32[:, :, yhi, :] * yf[None, None, :, None]
+    out = top[:, :, :, xlo] * (1 - xf)[None, None, None, :] + \
+        top[:, :, :, xhi] * xf[None, None, None, :]
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# AdaptiveAvgPooling2D (reference: contrib/adaptive_avg_pooling-inl.h)
+# ---------------------------------------------------------------------------
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool2d(data, output_size=None):
+    n, c, h, w = data.shape
+    if output_size is None:
+        oh = ow = 1
+    elif isinstance(output_size, (tuple, list)):
+        oh, ow = (int(output_size[0]),
+                  int(output_size[1] if len(output_size) > 1
+                      else output_size[0]))
+    else:
+        oh = ow = int(output_size)
+    # summed-area table: S[i, j] = sum(data[:i, :j]); window sums are four
+    # gathers — no data-dependent loop bounds, MXU-friendly
+    f32 = data.astype(jnp.float32)
+    sat = jnp.pad(jnp.cumsum(jnp.cumsum(f32, axis=2), axis=3),
+                  ((0, 0), (0, 0), (1, 0), (1, 0)))
+    h0 = (_np.arange(oh) * h) // oh
+    h1 = -(-(_np.arange(1, oh + 1) * h) // oh)      # ceil
+    w0 = (_np.arange(ow) * w) // ow
+    w1 = -(-(_np.arange(1, ow + 1) * w) // ow)
+    area = ((h1 - h0)[:, None] * (w1 - w0)[None, :]).astype(_np.float32)
+    out = (sat[:, :, h1][:, :, :, w1] - sat[:, :, h0][:, :, :, w1]
+           - sat[:, :, h1][:, :, :, w0] + sat[:, :, h0][:, :, :, w0])
+    return (out / area[None, None]).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign (reference: contrib/roi_align.cc) — NCHW features, rois
+# (R, 5) = [batch_idx, x1, y1, x2, y2] in image coords
+# ---------------------------------------------------------------------------
+@register("_contrib_ROIAlign", arity=2)
+def _roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, aligned=False):
+    if position_sensitive:
+        raise NotImplementedError("ROIAlign: position_sensitive=True")
+    ph, pw = (int(pooled_size[0]), int(pooled_size[1])) \
+        if isinstance(pooled_size, (tuple, list)) else \
+        (int(pooled_size), int(pooled_size))
+    s = 2 if sample_ratio is None or sample_ratio <= 0 else int(sample_ratio)
+    n, c, h, w = data.shape
+    f32 = data.astype(jnp.float32)
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bh, bw = rh / ph, rw / pw
+        # sample grid: (ph*s, pw*s) bilinear taps, mean-pooled s×s per cell
+        ys = y1 + (jnp.arange(ph * s, dtype=jnp.float32) + 0.5) * (bh / s)
+        xs = x1 + (jnp.arange(pw * s, dtype=jnp.float32) + 0.5) * (bw / s)
+        # reference roi_align.cc zeroes samples outside [-1, size]; inside
+        # that band coordinates clamp to the border for interpolation
+        yok = ((ys >= -1.0) & (ys <= h)).astype(jnp.float32)
+        xok = ((xs >= -1.0) & (xs <= w)).astype(jnp.float32)
+        ysc = jnp.clip(ys, 0, h - 1)
+        xsc = jnp.clip(xs, 0, w - 1)
+        y0 = jnp.floor(ysc).astype(jnp.int32)
+        x0 = jnp.floor(xsc).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        yf = ysc - y0
+        xf = xsc - x0
+        img = f32[bidx]                                   # (c, h, w)
+        top = img[:, y0, :] * (1 - yf)[None, :, None] + \
+            img[:, y1i, :] * yf[None, :, None]
+        val = top[:, :, x0] * (1 - xf)[None, None, :] + \
+            top[:, :, x1i] * xf[None, None, :]            # (c, ph*s, pw*s)
+        val = val * (yok[:, None] * xok[None, :])[None]
+        val = val.reshape(c, ph, s, pw, s).mean(axis=(2, 4))
+        # rois with y2<y1 (empty) produce zeros like the reference
+        return val
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32))
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# bounding boxes (reference: contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+def _pair_iou(a, b):
+    """a: (..., N, 4), b: (..., M, 4) corner boxes -> IoU (..., N, M)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(x):
+    xc, yc, w, h = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+    return jnp.stack([xc - w / 2, yc - h / 2, xc + w / 2, yc + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_box_iou", arity=2, differentiable=False)
+def _box_iou(lhs, rhs, format="corner"):
+    a = lhs.astype(jnp.float32)
+    b = rhs.astype(jnp.float32)
+    if format == "center":
+        a, b = _to_corner(a), _to_corner(b)
+    return _pair_iou(a, b)
+
+
+@register("_contrib_box_nms", differentiable=False)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+             in_format="corner", out_format="corner", background_id=-1):
+    """Score-sorted greedy NMS; suppressed/invalid entries get score -1
+    (the reference's convention). Fixed trip count keeps it compilable."""
+    if out_format != in_format:
+        raise NotImplementedError("box_nms: in/out format conversion")
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    b, n, width = data.shape
+    f32 = data.astype(jnp.float32)
+    scores = f32[:, :, score_index]
+    boxes = lax.dynamic_slice_in_dim(f32, coord_start, 4, axis=2)
+    if in_format == "center":
+        boxes = _to_corner(boxes)
+    ids = (f32[:, :, id_index] if id_index is not None and id_index >= 0
+           else jnp.zeros((b, n), jnp.float32))
+
+    valid = scores > valid_thresh
+    if id_index is not None and id_index >= 0 and background_id >= 0:
+        valid &= ids != background_id
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=1)
+    k = n if topk is None or topk <= 0 else min(int(topk), n)
+
+    sb = jnp.take_along_axis(boxes, order[:, :, None], axis=1)
+    sv = jnp.take_along_axis(valid, order, axis=1)
+    sid = jnp.take_along_axis(ids, order, axis=1)
+    iou = _pair_iou(sb, sb)                                # (b, n, n)
+    same_cls = (sid[:, :, None] == sid[:, None, :]) | force_suppress
+
+    def body(i, keep):
+        # candidate i suppresses every later j overlapping it — only if i
+        # itself is still kept
+        act = keep[:, i] & sv[:, i]
+        sup = (iou[:, i, :] > overlap_thresh) & same_cls[:, i, :] & \
+            (jnp.arange(n)[None, :] > i)
+        return keep & ~(sup & act[:, None])
+
+    keep = lax.fori_loop(0, k, body, jnp.ones((b, n), bool)) & sv
+    keep &= jnp.arange(n)[None, :] < k
+
+    # scatter back to sorted order, score -1 where dropped
+    out_sorted = jnp.take_along_axis(f32, order[:, :, None], axis=1)
+    new_scores = jnp.where(keep, out_sorted[:, :, score_index], -1.0)
+    out_sorted = out_sorted.at[:, :, score_index].set(new_scores)
+    out = out_sorted.astype(data.dtype)
+    return out[0] if squeeze else out
+
+
+@register("_contrib_box_encode", arity=6, differentiable=False)
+def _box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+                stds=(0.1, 0.1, 0.2, 0.2)):
+    """SSD target encoding (reference: bounding_box.cc BoxEncode):
+    corner anchors/refs -> normalized center-form offsets."""
+    f = jnp.float32
+    a = _to_center(anchors.astype(f))
+    g = _to_center(jnp.take_along_axis(
+        refs.astype(f), matches[..., None].astype(jnp.int32), axis=1))
+    t0 = (g[..., 0] - a[..., 0]) / a[..., 2]
+    t1 = (g[..., 1] - a[..., 1]) / a[..., 3]
+    t2 = jnp.log(jnp.maximum(g[..., 2] / a[..., 2], 1e-12))
+    t3 = jnp.log(jnp.maximum(g[..., 3] / a[..., 3], 1e-12))
+    t = jnp.stack([t0, t1, t2, t3], axis=-1)
+    t = (t - jnp.asarray(means, f)) / jnp.asarray(stds, f)
+    mask = (samples[..., None] > 0.5).astype(f)
+    return t * mask, mask
+
+
+def _to_center(x):
+    x1, y1, x2, y2 = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2,
+                      jnp.maximum(x2 - x1, 0.0),
+                      jnp.maximum(y2 - y1, 0.0)], axis=-1)
+
+
+@register("_contrib_box_decode", arity=2, differentiable=False)
+def _box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+                clip=-1.0, format="corner"):
+    """Inverse of box_encode (reference: bounding_box.cc BoxDecode)."""
+    f = jnp.float32
+    a = anchors.astype(f)
+    if format == "corner":
+        a = _to_center(a)
+    d = data.astype(f)
+    x = d[..., 0] * std0 * a[..., 2] + a[..., 0]
+    y = d[..., 1] * std1 * a[..., 3] + a[..., 1]
+    dw = d[..., 2] * std2
+    dh = d[..., 3] * std3
+    if clip is not None and clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    w = jnp.exp(dw) * a[..., 2] / 2
+    h = jnp.exp(dh) * a[..., 3] / 2
+    return jnp.stack([x - w, y - h, x + w, y + h],
+                     axis=-1).astype(data.dtype)
+
+
+alias("_contrib_BilinearResize2D", "_contrib_bilinear_resize2d")
+alias("_contrib_AdaptiveAvgPooling2D", "_contrib_adaptive_avg_pooling2d")

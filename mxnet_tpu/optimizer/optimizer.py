@@ -943,12 +943,23 @@ class LBSGD(Optimizer):
                 mult = 1.0
         return mult
 
+    def _get_lars(self, weight, g, wd):
+        """LARS layer rate for warmup_strategy='lars'
+        (reference: LBSGD._get_lars)."""
+        weight2 = float((weight * weight).sum().asscalar())
+        grad2 = float((g * g).sum().asscalar())
+        lars = math.sqrt(weight2 / (grad2 + wd * weight2 + 1e-18))
+        return min(max(lars, 0.01), 100.0)
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        num_update = self.num_update + self.init_updates
-        self.lbmult = self._get_lbmult(num_update)
+        if self.warmup_strategy == "lars":
+            self.lbmult = self._get_lars(weight, grad, wd)
+        else:
+            num_update = self.num_update + self.init_updates
+            self.lbmult = self._get_lbmult(num_update)
         lr = lr * self.lbmult
         kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                       clip_gradient=_clip(self.clip_gradient))

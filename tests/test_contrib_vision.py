@@ -1,0 +1,147 @@
+"""Contrib vision/detection ops (reference: src/operator/contrib/
+bounding_box.cc, roi_align.cc, bilinear_resize-inl.h,
+adaptive_avg_pooling-inl.h) — numeric checks against numpy references."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_arange_like():
+    x = nd.zeros((2, 3))
+    out = nd.contrib.arange_like(x, start=1.0, step=2.0)
+    np.testing.assert_allclose(out.asnumpy(),
+                               (1 + 2 * np.arange(6)).reshape(2, 3))
+    out = nd.contrib.arange_like(x, axis=1)
+    np.testing.assert_allclose(out.asnumpy(), [0, 1, 2])
+
+
+def test_bilinear_resize2d():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 6).astype(np.float32)
+    out = nd.contrib.BilinearResize2D(nd.array(x), height=8, width=12)
+    assert out.shape == (2, 3, 8, 12)
+    # align-corners: the four corners are preserved exactly
+    np.testing.assert_allclose(out.asnumpy()[:, :, 0, 0], x[:, :, 0, 0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(out.asnumpy()[:, :, -1, -1], x[:, :, -1, -1],
+                               rtol=1e-5)
+    # upscale by identity size is identity
+    same = nd.contrib.BilinearResize2D(nd.array(x), height=4, width=6)
+    np.testing.assert_allclose(same.asnumpy(), x, rtol=1e-5)
+
+
+def test_adaptive_avg_pooling2d():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 6, 8).astype(np.float32)
+    # divisible case equals mean pooling
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x), output_size=(3, 4))
+    ref = x.reshape(2, 3, 3, 2, 4, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    # non-divisible: windows follow floor/ceil boundaries
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x), output_size=(4, 3))
+    ref = np.zeros((2, 3, 4, 3), np.float32)
+    for i in range(4):
+        for j in range(3):
+            h0, h1 = (i * 6) // 4, -((-(i + 1) * 6) // 4)
+            w0, w1 = (j * 8) // 3, -((-(j + 1) * 8) // 3)
+            ref[:, :, i, j] = x[:, :, h0:h1, w0:w1].mean(axis=(2, 3))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    # global pooling
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x))
+    np.testing.assert_allclose(out.asnumpy()[..., 0, 0],
+                               x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_roi_align():
+    # constant feature map: every aligned roi pools to the constant
+    x = np.full((1, 2, 8, 8), 5.0, np.float32)
+    rois = np.array([[0, 0, 0, 7, 7], [0, 2, 2, 6, 6]], np.float32)
+    out = mx.nd.contrib.ROIAlign(nd.array(x), nd.array(rois),
+                                 pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), 5.0, rtol=1e-5)
+    # linear ramp in x: pooled values increase along width
+    ramp = np.tile(np.arange(8, dtype=np.float32), (8, 1))[None, None]
+    out = mx.nd.contrib.ROIAlign(nd.array(ramp),
+                                 nd.array(np.array([[0, 0, 0, 7, 7]], np.float32)),
+                                 pooled_size=(1, 4), spatial_scale=1.0)
+    v = out.asnumpy()[0, 0, 0]
+    assert (np.diff(v) > 0).all(), v
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [10, 10, 11, 11]], np.float32)
+    iou = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(iou[1, 0], 1.0 / 7.0, rtol=1e-5)
+    assert iou[0, 1] == 0.0
+    # center format
+    ac = np.array([[1, 1, 2, 2]], np.float32)   # == corner [0,0,2,2]
+    iou_c = nd.contrib.box_iou(nd.array(ac), nd.array(ac),
+                               format="center").asnumpy()
+    np.testing.assert_allclose(iou_c[0, 0], 1.0, rtol=1e-6)
+
+
+def test_box_nms():
+    # [id, score, x1, y1, x2, y2]
+    data = np.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [0, 0.8, 1, 1, 11, 11],     # heavy overlap with #0 -> suppressed
+        [0, 0.7, 20, 20, 30, 30],   # far away -> kept
+        [1, 0.6, 0, 0, 10, 10],     # other class -> kept (no force)
+        [0, 0.0, 0, 0, 1, 1],       # below valid_thresh -> dropped
+    ], np.float32)
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             valid_thresh=0.01, coord_start=2,
+                             score_index=1, id_index=0).asnumpy()
+    kept = out[out[:, 1] > 0]
+    np.testing.assert_allclose(sorted(kept[:, 1].tolist()),
+                               [0.6, 0.7, 0.9])
+    # force_suppress ignores class ids
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             valid_thresh=0.01, coord_start=2,
+                             score_index=1, id_index=0,
+                             force_suppress=True).asnumpy()
+    kept = out[out[:, 1] > 0]
+    np.testing.assert_allclose(sorted(kept[:, 1].tolist()), [0.7, 0.9])
+    # batch dim passthrough
+    out = nd.contrib.box_nms(nd.array(data[None]), overlap_thresh=0.5,
+                             valid_thresh=0.01).asnumpy()
+    assert out.shape == (1, 5, 6)
+
+
+def test_box_decode_roundtrip():
+    anchors = np.array([[[0, 0, 4, 4], [2, 2, 10, 8]]], np.float32)
+    # zero offsets decode to the anchors themselves
+    zeros = np.zeros((1, 2, 4), np.float32)
+    out = nd.contrib.box_decode(nd.array(zeros), nd.array(anchors)).asnumpy()
+    np.testing.assert_allclose(out, anchors, rtol=1e-5, atol=1e-5)
+
+
+def test_roi_align_outside_image_zeroed():
+    """Samples outside [-1, size] contribute zero (reference roi_align.cc
+    skips them), so a fully-outside roi pools to ~0."""
+    x = np.full((1, 1, 8, 8), 1.0, np.float32)
+    rois = np.array([[0, -40, -40, -20, -20]], np.float32)
+    out = mx.nd.contrib.ROIAlign(nd.array(x), nd.array(rois),
+                                 pooled_size=(2, 2), spatial_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy(), 0.0, atol=1e-6)
+
+
+def test_arange_like_repeat_and_resize_defaults():
+    x = nd.zeros((2, 4))
+    out = nd.contrib.arange_like(x, axis=1, repeat=2)
+    np.testing.assert_allclose(out.asnumpy(), [0, 0, 1, 1])
+    # height-only call is valid (reference defaults the other dim to 1)
+    img = nd.array(np.random.RandomState(0).randn(1, 1, 4, 4)
+                   .astype(np.float32))
+    out = nd.contrib.BilinearResize2D(img, height=8)
+    assert out.shape == (1, 1, 8, 1)
+    import pytest
+    with pytest.raises(NotImplementedError):
+        # async exception semantics: the dispatch error surfaces at the
+        # sync point (reference: test_exc_handling.py)
+        nd.contrib.BilinearResize2D(img, height=8, width=8,
+                                    mode="like").asnumpy()

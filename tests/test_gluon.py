@@ -287,3 +287,23 @@ def test_lr_scheduler():
     s = FactorScheduler(step=100, base_lr=1.0, warmup_steps=10,
                         warmup_begin_lr=0.0)
     assert s(5) == 0.5
+
+
+def test_lbsgd_warmup_and_lars():
+    """LBSGD (reference: optimizer.py LBSGD): warmup multiplier ramps to
+    batch_scale; warmup_strategy='lars' computes the layer-adaptive rate."""
+    from mxnet_tpu.optimizer import create as opt_create
+    o = opt_create("lbsgd", learning_rate=0.1, momentum=0.9, batch_scale=4,
+                   warmup_epochs=1, updates_per_epoch=2)
+    w = mx.nd.array(np.full((4,), 2.0, np.float32))
+    g = mx.nd.array(np.full((4,), 0.5, np.float32))
+    st = o.create_state(0, w)
+    for _ in range(4):
+        o.update(0, w, g, st)
+    assert o.lbmult == 4.0, o.lbmult
+
+    o = opt_create("lbsgd", learning_rate=0.1, warmup_strategy="lars")
+    w = mx.nd.array(np.full((4,), 2.0, np.float32))
+    o.update(0, w, g, None)
+    # lars = sqrt(|w|^2 / (|g|^2 + wd|w|^2 + eps)) = sqrt(16/1) = 4
+    assert abs(o.lbmult - 4.0) < 1e-5, o.lbmult
