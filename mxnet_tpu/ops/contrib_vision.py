@@ -303,3 +303,171 @@ def _box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
 
 alias("_contrib_BilinearResize2D", "_contrib_bilinear_resize2d")
 alias("_contrib_AdaptiveAvgPooling2D", "_contrib_adaptive_avg_pooling2d")
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox ops (reference: contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc) — the reference's in-tree SSD
+# training graph: anchor generation, target matching, decode+NMS.
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchors for one feature map: (1, H*W*A, 4) corner boxes in [0, 1],
+    A = len(sizes) + len(ratios) - 1, ordered exactly like the reference
+    kernel (multibox_prior-inl.h): every size at the FIRST ratio first,
+    then ratios[1:] at sizes[0]. Widths carry the reference's
+    in_height/in_width aspect correction so anchors stay square in pixel
+    space on non-square feature maps."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    step_y = 1.0 / h if steps is None or steps[0] <= 0 else float(steps[0])
+    step_x = 1.0 / w if steps is None or steps[1] <= 0 else float(steps[1])
+    cy = (jnp.arange(h, dtype=jnp.float32) + float(offsets[0])) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + float(offsets[1])) * step_x
+    aspect = float(h) / float(w)
+    wh = []
+    for s in sizes:                      # all sizes at ratios[0]
+        sr = _np.sqrt(ratios[0])
+        wh.append((s * aspect * sr / 2.0, s / sr / 2.0))
+    for r in ratios[1:]:                 # remaining ratios at sizes[0]
+        sr = _np.sqrt(r)
+        wh.append((sizes[0] * aspect * sr / 2.0, sizes[0] / sr / 2.0))
+    wh = jnp.asarray(wh, jnp.float32)                     # (A, 2)
+    ctr = jnp.stack(jnp.meshgrid(cx, cy), axis=-1)        # (h, w, 2) [x, y]
+    ctr = ctr.reshape(h * w, 1, 2)
+    boxes = jnp.concatenate([ctr - wh[None], ctr + wh[None]], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+@register("_contrib_MultiBoxTarget", arity=3, differentiable=False,
+          num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth and emit SSD training targets
+    (reference: multibox_target.cc). anchor (1, N, 4) corner; label
+    (B, M, 5) [cls, x1, y1, x2, y2] padded with cls=-1; cls_pred
+    (B, C+1, N) (used only for negative mining). Returns
+    (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N))."""
+    f = jnp.float32
+    a = anchor.astype(f).reshape(-1, 4)                   # (N, 4)
+    n = a.shape[0]
+    lab = label.astype(f)
+    if lab.ndim == 2:
+        lab = lab[None]
+    b, m, _ = lab.shape
+    gt_cls = lab[..., 0]                                  # (B, M), -1 = pad
+    gt_box = lab[..., 1:5]
+    gt_valid = gt_cls >= 0
+
+    iou = _pair_iou(jnp.broadcast_to(a, (b, n, 4)), gt_box)   # (B, N, M)
+    iou = jnp.where(gt_valid[:, None, :], iou, -1.0)
+
+    # stage 1 (bipartite-greedy in the reference; argmax approximation):
+    # each valid GT claims its best anchor unconditionally
+    best_anchor = jnp.argmax(iou, axis=1)                 # (B, M)
+    claimed = jnp.zeros((b, n), bool)
+    claimed_gt = jnp.full((b, n), -1, jnp.int32)
+
+    def claim(j, st):
+        claimed, claimed_gt = st
+        idx = best_anchor[:, j]
+        ok = gt_valid[:, j] & ~jnp.take_along_axis(
+            claimed, idx[:, None], axis=1)[:, 0]
+        claimed = claimed.at[jnp.arange(b), idx].set(
+            claimed[jnp.arange(b), idx] | ok)
+        claimed_gt = claimed_gt.at[jnp.arange(b), idx].set(
+            jnp.where(ok, j, claimed_gt[jnp.arange(b), idx]))
+        return claimed, claimed_gt
+
+    claimed, claimed_gt = lax.fori_loop(0, m, claim, (claimed, claimed_gt))
+
+    # stage 2: remaining anchors match their best GT if IoU > threshold
+    best_gt = jnp.argmax(iou, axis=2)                     # (B, N)
+    best_iou = jnp.max(iou, axis=2)
+    thresh_ok = best_iou >= overlap_threshold
+    match = jnp.where(claimed, claimed_gt,
+                      jnp.where(thresh_ok, best_gt, -1))  # (B, N)
+    pos = match >= 0
+
+    mg = jnp.clip(match, 0, m - 1)
+    g = jnp.take_along_axis(gt_box, mg[..., None], axis=1)    # (B, N, 4)
+    gc = _to_center(g)
+    ac = _to_center(a)[None]
+    v = variances
+    t = jnp.stack([
+        (gc[..., 0] - ac[..., 0]) / jnp.maximum(ac[..., 2], 1e-12) / v[0],
+        (gc[..., 1] - ac[..., 1]) / jnp.maximum(ac[..., 3], 1e-12) / v[1],
+        jnp.log(jnp.maximum(gc[..., 2] / jnp.maximum(ac[..., 2], 1e-12),
+                            1e-12)) / v[2],
+        jnp.log(jnp.maximum(gc[..., 3] / jnp.maximum(ac[..., 3], 1e-12),
+                            1e-12)) / v[3]], axis=-1)
+    box_target = jnp.where(pos[..., None], t, 0.0).reshape(b, n * 4)
+    box_mask = jnp.where(pos[..., None],
+                         jnp.ones((), f), 0.0)
+    box_mask = jnp.broadcast_to(box_mask, (b, n, 4)).reshape(b, n * 4)
+
+    cls_matched = jnp.take_along_axis(gt_cls, mg, axis=1)     # (B, N)
+    cls_target = jnp.where(pos, cls_matched + 1.0, 0.0)       # 0 = background
+
+    if negative_mining_ratio is not None and negative_mining_ratio > 0:
+        # hard-negative mining: keep the ratio*num_pos highest-loss
+        # negatives (proxied by background confidence deficit), rest ignored
+        bg_prob = cls_pred.astype(f)[:, 0, :]                 # (B, N)
+        neg_score = -bg_prob                                  # harder = higher
+        neg = ~pos & (best_iou < negative_mining_thresh)
+        num_pos = jnp.sum(pos, axis=1, keepdims=True).astype(f)
+        quota = jnp.maximum(num_pos * float(negative_mining_ratio),
+                            float(minimum_negative_samples))
+        rank = jnp.argsort(jnp.argsort(
+            jnp.where(neg, neg_score, -jnp.inf), axis=1, descending=True),
+            axis=1).astype(f)
+        keep_neg = neg & (rank < quota)
+        cls_target = jnp.where(pos | keep_neg, cls_target,
+                               float(ignore_label))
+    return box_target, box_mask, cls_target
+
+
+@register("_contrib_MultiBoxDetection", arity=3, differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions against anchors and NMS (reference:
+    multibox_detection.cc). cls_prob (B, C+1, N), loc_pred (B, N*4),
+    anchor (1, N, 4) -> (B, N, 6) rows [cls_id, score, x1, y1, x2, y2],
+    suppressed rows get cls_id -1."""
+    f = jnp.float32
+    p = cls_prob.astype(f)
+    b, _, n = p.shape
+    loc = loc_pred.astype(f).reshape(b, n, 4)
+    v = variances
+    boxes = _box_decode(loc, anchor.astype(f).reshape(1, -1, 4),
+                        std0=v[0], std1=v[1], std2=v[2], std3=v[3],
+                        format="corner")
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+
+    # per-anchor best foreground class
+    fg = jnp.concatenate([p[:, :background_id], p[:, background_id + 1:]],
+                         axis=1)                              # (B, C, N)
+    cls_id = jnp.argmax(fg, axis=1).astype(f)                 # (B, N)
+    score = jnp.max(fg, axis=1)
+    valid = score > threshold
+    rows = jnp.concatenate([
+        jnp.where(valid, cls_id, -1.0)[..., None],
+        jnp.where(valid, score, -1.0)[..., None], boxes], axis=-1)
+    out = _box_nms(rows, overlap_thresh=nms_threshold,
+                   valid_thresh=threshold, topk=nms_topk,
+                   coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+    # reference convention: suppressed rows flagged via cls_id -1
+    sup = out[..., 1] <= 0
+    out = out.at[..., 0].set(jnp.where(sup, -1.0, out[..., 0]))
+    return out

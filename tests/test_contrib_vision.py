@@ -145,3 +145,81 @@ def test_arange_like_repeat_and_resize_defaults():
         # sync point (reference: test_exc_handling.py)
         nd.contrib.BilinearResize2D(img, height=8, width=8,
                                     mode="like").asnumpy()
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 8, 2, 2))   # 2x2 feature map
+    out = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    # A = len(sizes) + len(ratios) - 1 = 3 anchors per cell
+    assert out.shape == (1, 2 * 2 * 3, 4)
+    a = out.asnumpy()[0]
+    # first cell center is ((0+0.5)/2, (0+0.5)/2) = (0.25, 0.25)
+    first = a[0]
+    np.testing.assert_allclose((first[0] + first[2]) / 2, 0.25, atol=1e-6)
+    np.testing.assert_allclose((first[1] + first[3]) / 2, 0.25, atol=1e-6)
+    # anchor 0: size 0.5 ratio 1 -> width == height == 0.5
+    np.testing.assert_allclose(first[2] - first[0], 0.5, atol=1e-6)
+    np.testing.assert_allclose(first[3] - first[1], 0.5, atol=1e-6)
+    # reference ordering: sizes first (anchor 1 = size 0.25 ratio 1),
+    # then ratios[1:] at sizes[0] (anchor 2 = size 0.5 ratio 2)
+    second = a[1]
+    np.testing.assert_allclose(second[2] - second[0], 0.25, atol=1e-6)
+    np.testing.assert_allclose(second[3] - second[1], 0.25, atol=1e-6)
+    third = a[2]
+    np.testing.assert_allclose(third[2] - third[0], 0.5 * np.sqrt(2),
+                               atol=1e-6)
+    np.testing.assert_allclose(third[3] - third[1], 0.5 / np.sqrt(2),
+                               atol=1e-6)
+
+
+def test_multibox_target_and_detection():
+    # 2 anchors; 1 GT box exactly equal to anchor 0
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    label = np.array([[[2, 0.1, 0.1, 0.4, 0.4],
+                       [-1, 0, 0, 0, 0]]], np.float32)   # one GT, cls 2
+    cls_pred = np.zeros((1, 4, 2), np.float32)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(nd.array(anchors),
+                                           nd.array(label),
+                                           nd.array(cls_pred))
+    bt, bm, ct = bt.asnumpy(), bm.asnumpy(), ct.asnumpy()
+    assert bt.shape == (1, 8) and bm.shape == (1, 8) and ct.shape == (1, 2)
+    # anchor 0 is positive with zero offsets (perfect match), cls target 3
+    np.testing.assert_allclose(bt[0, :4], 0.0, atol=1e-5)
+    np.testing.assert_allclose(bm[0, :4], 1.0)
+    np.testing.assert_allclose(bm[0, 4:], 0.0)
+    assert ct[0, 0] == 3.0 and ct[0, 1] == 0.0
+
+    # detection: feed probabilities putting cls 0 on anchor 0
+    cls_prob = np.zeros((1, 3, 2), np.float32)
+    cls_prob[0, 0] = [0.05, 0.9]     # background
+    cls_prob[0, 1] = [0.9, 0.05]     # class 0 confident on anchor 0
+    cls_prob[0, 2] = [0.05, 0.05]
+    loc_pred = np.zeros((1, 8), np.float32)  # zero offsets -> anchors
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob),
+                                       nd.array(loc_pred),
+                                       nd.array(anchors),
+                                       threshold=0.1).asnumpy()
+    assert out.shape == (1, 2, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    assert len(kept) == 1
+    np.testing.assert_allclose(kept[0, 0], 0.0)          # class id
+    np.testing.assert_allclose(kept[0, 1], 0.9, atol=1e-6)
+    np.testing.assert_allclose(kept[0, 2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.5, 0.5, 0.7, 0.7],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    label = np.array([[[0, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+    cls_pred = np.zeros((1, 2, 3), np.float32)
+    cls_pred[0, 0] = [0.9, 0.1, 0.8]   # background confidence per anchor
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred),
+        negative_mining_ratio=1.0, ignore_label=-1.0)
+    ct = ct.asnumpy()
+    # 1 positive -> quota 1 negative: the hardest (lowest bg prob, anchor 1)
+    assert ct[0, 0] == 1.0           # positive, cls 0 -> target 1
+    assert ct[0, 1] == 0.0           # kept negative
+    assert ct[0, 2] == -1.0          # ignored

@@ -58,6 +58,7 @@ interleaved_matmul_selfatt_qk interleaved_matmul_selfatt_valatt
 div_sqrt_dim adamw_update
 box_nms box_iou box_encode box_decode ROIAlign BilinearResize2D
 AdaptiveAvgPooling2D arange_like
+MultiBoxPrior MultiBoxTarget MultiBoxDetection
 """.split()
 
 
