@@ -155,6 +155,8 @@ class Augmenter:
         for k, v in kwargs.items():
             if isinstance(v, nd.NDArray):
                 kwargs[k] = v.asnumpy().tolist()
+            elif isinstance(v, _np.ndarray):
+                kwargs[k] = v.tolist()
 
     def dumps(self):
         import json
@@ -601,3 +603,12 @@ class ImageIter:
         for aug in self.auglist:
             data = aug(data)
         return data
+
+# Detection iterator + label-aware augmenters (reference: image/detection.py)
+from .image_detection import (DetAugmenter, DetBorrowAug,   # noqa: E402,F401
+                              DetRandomSelectAug, DetHorizontalFlipAug,
+                              DetRandomCropAug, DetRandomPadAug,
+                              CreateDetAugmenter, ImageDetIter)
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "CreateDetAugmenter", "ImageDetIter"]

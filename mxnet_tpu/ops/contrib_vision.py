@@ -378,7 +378,10 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     def claim(j, st):
         claimed, claimed_gt = st
         idx = best_anchor[:, j]
-        ok = gt_valid[:, j] & ~jnp.take_along_axis(
+        # a GT with zero IoU against every anchor (degenerate box) must not
+        # claim one — the reference skips unmatched GTs
+        has_overlap = jnp.max(iou[:, :, j], axis=1) > 0
+        ok = gt_valid[:, j] & has_overlap & ~jnp.take_along_axis(
             claimed, idx[:, None], axis=1)[:, 0]
         claimed = claimed.at[jnp.arange(b), idx].set(
             claimed[jnp.arange(b), idx] | ok)

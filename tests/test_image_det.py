@@ -1,0 +1,97 @@
+"""ImageDetIter + detection augmenters (reference:
+python/mxnet/image/detection.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image
+
+
+def _make_dataset(tmp_path, n=6, size=32):
+    """PNG files + packed det labels [A=2, B=5, id,x1,y1,x2,y2]."""
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    entries = []
+    for i in range(n):
+        arr = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+        fname = "img%d.png" % i
+        Image.fromarray(arr).save(str(tmp_path / fname))
+        n_obj = 1 + i % 2
+        label = [2, 5]
+        for j in range(n_obj):
+            label += [float(j), 0.2, 0.2, 0.7, 0.7]
+        entries.append((np.array(label, np.float32), fname))
+    return entries
+
+
+def test_image_det_iter(tmp_path):
+    entries = _make_dataset(tmp_path)
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                            imglist=entries, path_root=str(tmp_path))
+    assert it.max_objects == 2 and it.obj_width == 5
+    batch = next(it)
+    data = batch.data[0]
+    label = batch.label[0]
+    assert data.shape == (4, 3, 16, 16)
+    assert label.shape == (4, 2, 5)
+    lab = label.asnumpy()
+    # first image has one object, padded row is -1
+    assert lab[0, 0, 0] == 0.0
+    assert (lab[0, 1] == -1.0).all()
+    np.testing.assert_allclose(lab[0, 0, 1:], [0.2, 0.2, 0.7, 0.7],
+                               atol=1e-6)
+    # provide_label matches emitted shape
+    assert tuple(it.provide_label[0].shape) == (4, 2, 5)
+
+
+def test_det_horizontal_flip_flips_boxes():
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    src = mx.nd.array(np.zeros((8, 8, 3), np.float32))
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6],
+                      [-1, -1, -1, -1, -1]], np.float32)
+    _, out = aug(src, label.copy())
+    np.testing.assert_allclose(out[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    assert (out[1] == -1).all()
+
+
+def test_det_random_pad_keeps_boxes_inside():
+    aug = image.DetRandomPadAug(area_range=(2.0, 2.0),
+                                aspect_ratio_range=(1.0, 1.0))
+    src = mx.nd.array(np.full((8, 8, 3), 255.0, np.float32))
+    label = np.array([[1, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out_img, out = aug(src, label.copy())
+    # padded to ~sqrt(2)*8 per side; the box shrinks proportionally
+    w = out[0, 3] - out[0, 1]
+    assert 0.5 < w < 1.0
+    assert out_img.shape[0] >= 8 and out_img.shape[1] >= 8
+
+
+def test_det_random_crop_updates_labels():
+    np.random.seed(3)
+    import random as _r
+    _r.seed(3)
+    aug = image.DetRandomCropAug(min_object_covered=0.5,
+                                 area_range=(0.5, 0.9),
+                                 aspect_ratio_range=(1.0, 1.0))
+    src = mx.nd.array(np.zeros((32, 32, 3), np.float32))
+    label = np.array([[2, 0.25, 0.25, 0.75, 0.75]], np.float32)
+    _, out = aug(src, label.copy())
+    # object survives with normalized coords inside [0, 1]
+    assert out[0, 0] == 2
+    assert (out[0, 1:] >= -1e-6).all() and (out[0, 1:] <= 1 + 1e-6).all()
+
+
+def test_create_det_augmenter_chain():
+    augs = image.CreateDetAugmenter((3, 16, 16), rand_mirror=True,
+                                    rand_crop=0.5, rand_pad=0.5,
+                                    mean=True, std=True)
+    src = mx.nd.array(np.random.RandomState(0).randint(
+        0, 255, (24, 24, 3)).astype(np.uint8), dtype="uint8")
+    label = np.array([[0, 0.1, 0.1, 0.8, 0.8]], np.float32)
+    out, lab = src, label
+    for a in augs:
+        out, lab = a(out, lab)
+    assert out.shape == (16, 16, 3)
+    assert lab.shape == label.shape
