@@ -1,6 +1,8 @@
-"""contrib namespace. reference: python/mxnet/contrib/ — AMP +
-INT8 quantization; onnx remains documented out-of-scope (SURVEY.md §2.1)."""
+"""contrib namespace. reference: python/mxnet/contrib/ — AMP,
+INT8 quantization, text (vocab/embeddings); onnx remains documented
+out-of-scope (SURVEY.md §2.1)."""
 from . import amp
 from . import quantization
+from . import text
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "text"]

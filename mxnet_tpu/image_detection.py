@@ -165,10 +165,13 @@ class DetRandomCropAug(DetAugmenter):
                     iy2 - iy1, 0)
                 area = _box_area(bx)
                 cov = _np.where(area > 0, inter / _np.maximum(area, 1e-12),
-                                1.0)
-                # reference _check_satisfy_constraints: EVERY object must
-                # reach the coverage floor, not just the best-covered one
-                if cov.min() < self.min_object_covered:
+                                0.0)
+                # reference _check_satisfy_constraints: every object the
+                # crop OVERLAPS must reach the coverage floor; objects the
+                # crop excludes entirely (cov == 0) are allowed here and
+                # ejected from the label by min_eject_coverage below
+                touched = cov[cov > 0]
+                if touched.size == 0 or                         touched.min() < self.min_object_covered:
                     continue
             new_label = self._update_labels(label, (x0, y0, cw, ch),
                                             height, width)
@@ -321,13 +324,30 @@ class ImageDetIter(ImageIter):
         n = objs.size // b
         return objs[:n * b].reshape(n, b).copy()
 
+    def _next_label(self):
+        """Label of the next sample WITHOUT decoding its image — a
+        construction-time scan over a big .rec must not pay the decode."""
+        from .recordio import unpack
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                header, _ = unpack(self.imgrec.read_idx(idx))
+                return header.label
+            return self.imglist[idx][0]
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        return unpack(s)[0].label
+
     def _estimate_label_shape(self):
         max_n, width = 0, 5
         self.reset()
         try:
             while True:
-                label, _ = self.next_sample()
-                parsed = self._parse_label(label)
+                parsed = self._parse_label(self._next_label())
                 max_n = max(max_n, parsed.shape[0])
                 width = max(width, parsed.shape[1])
         except StopIteration:

@@ -162,3 +162,56 @@ def test_sdml_loss():
     matched = float(loss_fn(x2, x2).mean().asnumpy())
     rand = float(total.asnumpy())
     assert matched < rand
+
+
+def test_contrib_text():
+    """mx.contrib.text (reference: python/mxnet/contrib/text/): Vocabulary
+    indexing, CustomEmbedding loading, composite lookup."""
+    import collections
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import text
+
+    counter = text.utils.count_tokens_from_str(
+        "the quick brown fox the lazy dog the fox")
+    assert counter["the"] == 3 and counter["fox"] == 2
+
+    vocab = text.Vocabulary(counter, most_freq_count=4, min_freq=1,
+                            reserved_tokens=["<pad>"])
+    # 0=<unk>, 1=<pad>, then freq-desc/alpha: the, fox, then 2 more
+    assert vocab.to_indices("the") == 2
+    assert vocab.to_indices("fox") == 3
+    assert vocab.to_indices("zebra") == 0
+    assert vocab.to_tokens(1) == "<pad>"
+    assert len(vocab) == 6
+    assert vocab.to_indices(["the", "dog"]) == [2, vocab.token_to_idx["dog"]] \
+        if "dog" in vocab.token_to_idx else True
+
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "vec.txt")
+        with open(path, "w") as f:
+            f.write("the 1.0 2.0\nfox 3.0 4.0\n")
+        emb = text.embedding.CustomEmbedding(path)
+        assert emb.vec_len == 2
+        v = emb.get_vecs_by_tokens(["the", "missing"]).asnumpy()
+        np.testing.assert_allclose(v[0], [1.0, 2.0])
+        np.testing.assert_allclose(v[1], [0.0, 0.0])   # unknown -> zeros
+        emb.update_token_vectors("fox", mx.nd.array([[9.0, 9.0]]))
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("fox").asnumpy(), [9.0, 9.0])
+
+        comp = text.embedding.CompositeEmbedding(vocab, [emb, emb])
+        assert comp.vec_len == 4
+        np.testing.assert_allclose(
+            comp.get_vecs_by_tokens("the").asnumpy(), [1, 2, 1, 2])
+
+    # registry mechanism
+    assert "glove" in text.embedding.get_pretrained_file_names()
+    names = text.embedding.get_pretrained_file_names("glove")
+    assert "glove.6B.50d.txt" in names
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt",
+                              embedding_root=str(d))
