@@ -70,6 +70,7 @@ class TokenEmbedding:
         """Parse `token v0 v1 ...` lines (the GloVe/fastText text format).
         reference: embedding.py (_load_embedding)."""
         vectors = []
+        loaded_unk = None
         with io.open(path, "r", encoding=encoding) as f:
             for lineno, line in enumerate(f):
                 parts = line.rstrip().split(elem_delim)
@@ -81,8 +82,6 @@ class TokenEmbedding:
                     logging.warning("line %d: token with no vector, skipped",
                                     lineno + 1)
                     continue
-                if token in self._token_to_idx:
-                    continue
                 vec = _np.asarray([float(e) for e in elems], _np.float32)
                 if self._vec_len == 0:
                     self._vec_len = vec.shape[0]
@@ -90,10 +89,18 @@ class TokenEmbedding:
                     logging.warning("line %d: dim %d != %d, skipped",
                                     lineno + 1, vec.shape[0], self._vec_len)
                     continue
+                if token == self._unknown_token:
+                    # the file ships a trained unknown vector — prefer it
+                    # over init_unknown_vec (reference _load_embedding)
+                    loaded_unk = vec
+                    continue
+                if token in self._token_to_idx:
+                    continue
                 self._token_to_idx[token] = len(self._idx_to_token)
                 self._idx_to_token.append(token)
                 vectors.append(vec)
-        unk = self._init_unknown_vec((self._vec_len,)).astype(_np.float32)
+        unk = (loaded_unk if loaded_unk is not None else
+               self._init_unknown_vec((self._vec_len,))).astype(_np.float32)
         self._idx_to_vec = nd.array(
             _np.vstack([unk[None]] + [v[None] for v in vectors]))
 

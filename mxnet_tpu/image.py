@@ -540,8 +540,10 @@ class ImageIter:
             self.imgrec.reset()
         self.cur = 0
 
-    def next_sample(self):
-        """Returns (label, decoded image)."""
+    def next_sample(self, decode=True):
+        """Returns (label, decoded image); decode=False returns the raw
+        payload (record bytes / file name) so construction-time label
+        scans need not pay the image decode."""
         from .recordio import unpack
         if self.seq is not None:
             if self.cur >= len(self.seq):
@@ -551,14 +553,16 @@ class ImageIter:
             if self.imgrec is not None:
                 s = self.imgrec.read_idx(idx)
                 header, img = unpack(s)
-                return header.label, imdecode(img)
+                return header.label, (imdecode(img) if decode else img)
             label, fname = self.imglist[idx]
+            if not decode:
+                return label, fname
             return label, imread(os.path.join(self.path_root, fname))
         s = self.imgrec.read()
         if s is None:
             raise StopIteration
         header, img = unpack(s)
-        return header.label, imdecode(img)
+        return header.label, (imdecode(img) if decode else img)
 
     def next(self):
         """Returns the next DataBatch."""

@@ -171,7 +171,8 @@ class DetRandomCropAug(DetAugmenter):
                 # crop excludes entirely (cov == 0) are allowed here and
                 # ejected from the label by min_eject_coverage below
                 touched = cov[cov > 0]
-                if touched.size == 0 or                         touched.min() < self.min_object_covered:
+                if touched.size == 0 or \
+                        touched.min() < self.min_object_covered:
                     continue
             new_label = self._update_labels(label, (x0, y0, cw, ch),
                                             height, width)
@@ -327,20 +328,7 @@ class ImageDetIter(ImageIter):
     def _next_label(self):
         """Label of the next sample WITHOUT decoding its image — a
         construction-time scan over a big .rec must not pay the decode."""
-        from .recordio import unpack
-        if self.seq is not None:
-            if self.cur >= len(self.seq):
-                raise StopIteration
-            idx = self.seq[self.cur]
-            self.cur += 1
-            if self.imgrec is not None:
-                header, _ = unpack(self.imgrec.read_idx(idx))
-                return header.label
-            return self.imglist[idx][0]
-        s = self.imgrec.read()
-        if s is None:
-            raise StopIteration
-        return unpack(s)[0].label
+        return self.next_sample(decode=False)[0]
 
     def _estimate_label_shape(self):
         max_n, width = 0, 5

@@ -1,0 +1,145 @@
+"""Per-op gradient sweep: autograd vs finite differences through the
+Symbol executor, the reference's core op-testing idiom
+(tests/python/unittest/test_operator.py + test_utils.check_numeric_gradient,
+SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState(7)
+
+
+def _x(shape=(3, 4), lo=-2.0, hi=2.0):
+    return RNG.uniform(lo, hi, size=shape)
+
+
+# (name, symbol builder, {input: value}) — positive-domain ops get shifted
+# inputs; ops non-differentiable at ties/kinks get inputs away from them.
+UNARY = [
+    ("tanh", lambda x: sym.tanh(x), _x()),
+    ("sigmoid", lambda x: sym.sigmoid(x), _x()),
+    ("softsign", lambda x: sym.softsign(x), _x()),
+    ("exp", lambda x: sym.exp(x), _x(hi=1.5)),
+    ("log", lambda x: sym.log(x), _x(lo=0.5, hi=3.0)),
+    ("log1p", lambda x: sym.log1p(x), _x(lo=-0.5, hi=2.0)),
+    ("expm1", lambda x: sym.expm1(x), _x(hi=1.5)),
+    ("sqrt", lambda x: sym.sqrt(x), _x(lo=0.5, hi=3.0)),
+    ("rsqrt", lambda x: sym.rsqrt(x), _x(lo=0.5, hi=3.0)),
+    ("cbrt", lambda x: sym.cbrt(x), _x(lo=0.5, hi=3.0)),
+    ("square", lambda x: sym.square(x), _x()),
+    ("sin", lambda x: sym.sin(x), _x()),
+    ("cos", lambda x: sym.cos(x), _x()),
+    ("tan", lambda x: sym.tan(x), _x(lo=-1.0, hi=1.0)),
+    ("arcsin", lambda x: sym.arcsin(x), _x(lo=-0.8, hi=0.8)),
+    ("arccos", lambda x: sym.arccos(x), _x(lo=-0.8, hi=0.8)),
+    ("arctan", lambda x: sym.arctan(x), _x()),
+    ("sinh", lambda x: sym.sinh(x), _x(lo=-1.5, hi=1.5)),
+    ("cosh", lambda x: sym.cosh(x), _x(lo=-1.5, hi=1.5)),
+    ("arcsinh", lambda x: sym.arcsinh(x), _x()),
+    ("arccosh", lambda x: sym.arccosh(x), _x(lo=1.5, hi=3.0)),
+    ("arctanh", lambda x: sym.arctanh(x), _x(lo=-0.8, hi=0.8)),
+    ("erf", lambda x: sym.erf(x), _x(lo=-1.2, hi=1.2)),
+    ("abs", lambda x: sym.abs(x), _x(lo=0.3, hi=2.0)),
+    ("negative", lambda x: sym.negative(x), _x()),
+    ("reciprocal", lambda x: sym.reciprocal(x), _x(lo=0.5, hi=3.0)),
+    ("relu", lambda x: sym.relu(x), _x(lo=0.2, hi=2.0)),
+    ("softmax", lambda x: sym.square(sym.softmax(x, axis=-1)), _x()),
+    ("log_softmax", lambda x: sym.log_softmax(x, axis=-1), _x()),
+    ("sum", lambda x: sym.sum(x), _x()),
+    ("mean", lambda x: sym.mean(x), _x()),
+    ("prod", lambda x: sym.prod(x), _x(lo=0.5, hi=1.5)),
+    ("nansum", lambda x: sym.nansum(x), _x()),
+    ("norm", lambda x: sym.norm(x), _x(lo=0.3, hi=2.0)),
+    ("transpose", lambda x: sym.transpose(x), _x()),
+    ("reshape", lambda x: sym.reshape(x, shape=(4, 3)), _x()),
+    ("flip", lambda x: sym.flip(x, axis=1), _x()),
+    ("LayerNorm_data",
+     lambda x: sym.square(sym.LayerNorm(x, sym.Variable("g"),
+                                        sym.Variable("b"), axis=-1)),
+     _x()),
+]
+
+
+@pytest.mark.parametrize("name,build,xval",
+                         UNARY, ids=[u[0] for u in UNARY])
+def test_unary_grad(name, build, xval):
+    x = sym.Variable("x")
+    s = build(x)
+    loc = {"x": xval}
+    if name == "LayerNorm_data":
+        loc["g"] = RNG.uniform(0.5, 1.5, size=(xval.shape[-1],))
+        loc["b"] = RNG.uniform(-0.5, 0.5, size=(xval.shape[-1],))
+    eps = 1e-2 if name in ("softmax", "LayerNorm_data") else 1e-4
+    check_numeric_gradient(s, loc, rtol=2e-2, atol=1e-3,
+                           numeric_eps=eps)
+
+
+BINARY = [
+    ("broadcast_add", lambda a, b: sym.broadcast_add(a, b),
+     (3, 4), (1, 4)),
+    ("broadcast_sub", lambda a, b: sym.broadcast_sub(a, b),
+     (3, 4), (3, 1)),
+    ("broadcast_mul", lambda a, b: sym.broadcast_mul(a, b),
+     (3, 4), (1, 4)),
+    ("broadcast_div", lambda a, b: sym.broadcast_div(a, b),
+     (3, 4), (1, 4)),
+    ("broadcast_power", lambda a, b: sym.broadcast_power(a, b),
+     (3, 4), (1, 4)),
+    ("dot", lambda a, b: sym.dot(a, b), (3, 4), (4, 2)),
+    ("batch_dot", lambda a, b: sym.batch_dot(a, b), (2, 3, 4), (2, 4, 2)),
+    ("elemwise_add", lambda a, b: sym.elemwise_add(a, b), (3, 4), (3, 4)),
+    ("elemwise_mul", lambda a, b: sym.elemwise_mul(a, b), (3, 4), (3, 4)),
+    ("hypot", lambda a, b: sym.broadcast_hypot(a, b), (3, 4), (1, 4)),
+]
+
+
+@pytest.mark.parametrize("name,build,sa,sb",
+                         BINARY, ids=[b[0] for b in BINARY])
+def test_binary_grad(name, build, sa, sb):
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    s = build(a, b)
+    lo = 0.5 if name in ("broadcast_div", "broadcast_power", "hypot") else \
+        -2.0
+    loc = {"a": RNG.uniform(max(lo, 0.5) if lo > 0 else lo, 2.0, size=sa),
+           "b": RNG.uniform(max(lo, 0.5) if lo > 0 else lo, 2.0, size=sb)}
+    check_numeric_gradient(s, loc, rtol=2e-2, atol=1e-4)
+
+
+def test_fully_connected_grad():
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    b = sym.Variable("b")
+    s = sym.FullyConnected(x, w, b, num_hidden=5)
+    check_numeric_gradient(s, {"x": _x((2, 3)), "w": _x((5, 3)),
+                               "b": _x((5,))}, rtol=2e-2, atol=1e-4)
+
+
+def test_convolution_grad():
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    b = sym.Variable("b")
+    s = sym.Convolution(x, w, b, kernel=(3, 3), num_filter=2, pad=(1, 1))
+    # f32 executor: FD noise scales ~1/eps, conv sums amplify it — use
+    # the coarser eps the reference's f32 op tests use
+    check_numeric_gradient(
+        s, {"x": _x((1, 2, 5, 5)), "w": _x((2, 2, 3, 3)), "b": _x((2,))},
+        rtol=5e-2, atol=5e-3, numeric_eps=1e-2)
+
+
+def test_pooling_grad():
+    x = sym.Variable("x")
+    s = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    check_numeric_gradient(s, {"x": _x((1, 2, 4, 4))}, rtol=2e-2,
+                           atol=1e-4)
+
+
+def test_take_pick_grad():
+    x = sym.Variable("x")
+    s = sym.pick(x, sym.Variable("idx"), axis=-1)
+    idx = RNG.randint(0, 4, size=(3,)).astype(np.float64)
+    check_numeric_gradient(s, {"x": _x((3, 4)), "idx": idx},
+                           grad_nodes=["x"], rtol=2e-2, atol=1e-4)
