@@ -35,9 +35,12 @@ class _Conv(HybridBlock):
         with self.name_scope():
             self._channels = channels
             self._in_channels = in_channels
-            assert layout.startswith("NC"), \
-                "Only channels-first layouts (NCW, NCHW, NCDHW) are " \
-                "supported; got %s" % layout
+            nd_sp = len(kernel_size)
+            spatial = "DHW"[3 - nd_sp:]
+            allowed = ("NC" + spatial, "N" + spatial + "C")
+            assert layout in allowed, \
+                "layout must be one of %s; got %s" % (allowed, layout)
+            self._channels_last = layout == allowed[1]
             self._kwargs = {
                 "kernel": kernel_size, "stride": strides, "dilate": dilation,
                 "pad": padding, "num_filter": channels, "num_group": groups,
@@ -47,8 +50,15 @@ class _Conv(HybridBlock):
             self._op_name = op_name
 
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups) + kernel_size
+                if self._channels_last:
+                    # reference NHWC weight layout: (O, *kernel, I/groups)
+                    wshape = (channels,) + kernel_size + \
+                        (in_channels // groups,)
+                else:
+                    wshape = (channels, in_channels // groups) + kernel_size
             else:  # Deconvolution: weight is (in, out//groups, *k)
+                assert not self._channels_last, \
+                    "Deconvolution supports channels-first layouts only"
                 wshape = (in_channels, channels // groups) + kernel_size
             self.weight = self.params.get(
                 "weight", shape=wshape, init=weight_initializer,
@@ -65,11 +75,16 @@ class _Conv(HybridBlock):
                 self.act = None
 
     def _shape_from_input(self, x, *args):
-        in_channels = x.shape[1]
+        in_channels = x.shape[-1 if self._channels_last else 1]
         k = self._kwargs["kernel"]
         groups = self._kwargs["num_group"]
         if self._op_name == "Convolution":
-            self.weight.shape = (self._channels, in_channels // groups) + k
+            if self._channels_last:
+                self.weight.shape = (self._channels,) + k + \
+                    (in_channels // groups,)
+            else:
+                self.weight.shape = \
+                    (self._channels, in_channels // groups) + k
         else:
             self.weight.shape = (in_channels, self._channels // groups) + k
         self._in_channels = in_channels
@@ -104,9 +119,10 @@ class _Conv(HybridBlock):
             s += ", {}".format(self.act)
         s += ")"
         shape = self.weight.shape
+        in_ch = shape[-1] if self._channels_last else shape[1]
         return s.format(name=self.__class__.__name__,
                         mapping="{0} -> {1}".format(
-                            shape[1] if shape[1] else None, shape[0]),
+                            in_ch if in_ch else None, shape[0]),
                         **self._kwargs)
 
 
@@ -224,9 +240,14 @@ class _Pooling(HybridBlock):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
+        spatial = "DHW"[3 - len(pool_size):]
+        allowed = ("NC" + spatial, "N" + spatial + "C")
+        assert layout in allowed, \
+            "layout must be one of %s; got %s" % (allowed, layout)
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
+            "layout": layout,
             "pooling_convention": "full" if ceil_mode else "valid"}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
@@ -250,7 +271,6 @@ class MaxPool1D(_Pooling):
 
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
-        assert layout.startswith("NC")
         super().__init__(_to_tuple(pool_size, 1),
                          strides if strides is None else _to_tuple(strides, 1),
                          _to_tuple(padding, 1), ceil_mode, False, "max",
@@ -262,7 +282,6 @@ class MaxPool2D(_Pooling):
 
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kwargs):
-        assert layout.startswith("NC")
         super().__init__(_to_tuple(pool_size, 2),
                          strides if strides is None else _to_tuple(strides, 2),
                          _to_tuple(padding, 2), ceil_mode, False, "max",
@@ -274,7 +293,6 @@ class MaxPool3D(_Pooling):
 
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
-        assert layout.startswith("NC")
         super().__init__(_to_tuple(pool_size, 3),
                          strides if strides is None else _to_tuple(strides, 3),
                          _to_tuple(padding, 3), ceil_mode, False, "max",
@@ -286,7 +304,6 @@ class AvgPool1D(_Pooling):
 
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
-        assert layout.startswith("NC")
         super().__init__(_to_tuple(pool_size, 1),
                          strides if strides is None else _to_tuple(strides, 1),
                          _to_tuple(padding, 1), ceil_mode, False, "avg",
@@ -299,7 +316,6 @@ class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
-        assert layout.startswith("NC")
         super().__init__(_to_tuple(pool_size, 2),
                          strides if strides is None else _to_tuple(strides, 2),
                          _to_tuple(padding, 2), ceil_mode, False, "avg",
@@ -312,7 +328,6 @@ class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
-        assert layout.startswith("NC")
         super().__init__(_to_tuple(pool_size, 3),
                          strides if strides is None else _to_tuple(strides, 3),
                          _to_tuple(padding, 3), ceil_mode, False, "avg",
@@ -323,7 +338,6 @@ class GlobalMaxPool1D(_Pooling):
     """reference: nn/conv_layers.py (GlobalMaxPool1D)."""
 
     def __init__(self, layout="NCW", **kwargs):
-        assert layout.startswith("NC")
         super().__init__((1,), None, (0,), True, True, "max", layout,
                          **kwargs)
 
@@ -332,7 +346,6 @@ class GlobalMaxPool2D(_Pooling):
     """reference: nn/conv_layers.py (GlobalMaxPool2D)."""
 
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout.startswith("NC")
         super().__init__((1, 1), None, (0, 0), True, True, "max", layout,
                          **kwargs)
 
@@ -341,7 +354,6 @@ class GlobalMaxPool3D(_Pooling):
     """reference: nn/conv_layers.py (GlobalMaxPool3D)."""
 
     def __init__(self, layout="NCDHW", **kwargs):
-        assert layout.startswith("NC")
         super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max",
                          layout, **kwargs)
 
@@ -350,7 +362,6 @@ class GlobalAvgPool1D(_Pooling):
     """reference: nn/conv_layers.py (GlobalAvgPool1D)."""
 
     def __init__(self, layout="NCW", **kwargs):
-        assert layout.startswith("NC")
         super().__init__((1,), None, (0,), True, True, "avg", layout,
                          **kwargs)
 
@@ -359,7 +370,6 @@ class GlobalAvgPool2D(_Pooling):
     """reference: nn/conv_layers.py (GlobalAvgPool2D)."""
 
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout.startswith("NC")
         super().__init__((1, 1), None, (0, 0), True, True, "avg", layout,
                          **kwargs)
 
@@ -368,7 +378,6 @@ class GlobalAvgPool3D(_Pooling):
     """reference: nn/conv_layers.py (GlobalAvgPool3D)."""
 
     def __init__(self, layout="NCDHW", **kwargs):
-        assert layout.startswith("NC")
         super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg",
                          layout, **kwargs)
 

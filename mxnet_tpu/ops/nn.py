@@ -49,6 +49,21 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
 # Convolution (reference: src/operator/nn/convolution.cc) — NCHW/OIHW layout
 # to match the reference API; XLA relayouts internally for the MXU.
 # ---------------------------------------------------------------------------
+def _conv_layouts(layout, nd):
+    """MXNet layout string -> (data_layout, weight_layout). Channels-first
+    weights are OI+spatial; channels-last (reference: NHWC convs, GPU-only
+    there) use O+spatial+I — weight (num_filter, *kernel, C/groups)."""
+    spatial = "DHW"[3 - nd:]
+    if layout is None:
+        layout = "NC" + spatial
+    if layout == "NC" + spatial:
+        return layout, "OI" + spatial
+    if layout == "N" + spatial + "C":
+        return layout, "O" + spatial + "I"
+    raise ValueError("Convolution: unsupported layout %r for %dD" %
+                     (layout, nd))
+
+
 @register("Convolution")
 def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                  pad=None, num_filter=None, num_group=1, no_bias=False,
@@ -57,10 +72,9 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride = _pair(stride if stride else 1, nd)
     dilate = _pair(dilate if dilate else 1, nd)
     pad = _pair(pad if pad else 0, nd)
-    spatial = "DHW"[3 - nd:]
-    dn = lax.conv_dimension_numbers(
-        data.shape, weight.shape,
-        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    dlay, wlay = _conv_layouts(layout, nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (dlay, wlay, dlay))
     # no preferred_element_type: the MXU accumulates bf16 convs in fp32
     # natively, and a widened output dtype breaks the conv transpose rule
     # (fp32 cotangent x bf16 weight) under autograd
@@ -70,7 +84,9 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=num_group)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        shape = [1] * out.ndim
+        shape[dlay.index("C")] = -1
+        out = out + bias.reshape(shape)
     return out
 
 
@@ -111,26 +127,41 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False,
              stride=None, pad=None, pooling_convention="valid",
              count_include_pad=True, cudnn_off=None, layout=None, p_value=2):
     nd = data.ndim - 2
+    spatial_lay = "DHW"[3 - nd:]
+    if layout is not None and layout not in ("NC" + spatial_lay,
+                                             "N" + spatial_lay + "C"):
+        raise ValueError("Pooling: unsupported layout %r for %dD input"
+                         % (layout, nd))
+    channels_last = layout == "N" + spatial_lay + "C"
+    spatial_axes = (tuple(range(1, 1 + nd)) if channels_last
+                    else tuple(range(2, data.ndim)))
     if global_pool:
-        axes = tuple(range(2, data.ndim))
         if pool_type == "max":
-            return jnp.max(data, axis=axes, keepdims=True)
-        return jnp.mean(data, axis=axes, keepdims=True)
+            return jnp.max(data, axis=spatial_axes, keepdims=True)
+        return jnp.mean(data, axis=spatial_axes, keepdims=True)
     kernel = _pair(kernel, nd)
     stride = _pair(stride if stride else 1, nd)
     pad = _pair(pad if pad else 0, nd)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
     if pooling_convention == "full":
         # ceil-mode: pad high side enough that ceil division is covered
-        pads = [(0, 0), (0, 0)]
-        for i in range(nd):
-            in_sz = data.shape[2 + i]
+        sp_pads = []
+        for i, ax in enumerate(spatial_axes):
+            in_sz = data.shape[ax]
             out_sz = -(-(in_sz + 2 * pad[i] - kernel[i]) // stride[i]) + 1
             needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz - pad[i]
-            pads.append((pad[i], max(pad[i], needed)))
+            sp_pads.append((pad[i], max(pad[i], needed)))
     else:
-        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+        sp_pads = [(p, p) for p in pad]
+    if channels_last:
+        pads = [(0, 0)] + sp_pads + [(0, 0)]
+    else:
+        pads = [(0, 0), (0, 0)] + sp_pads
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, pads)

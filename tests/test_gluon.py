@@ -307,3 +307,34 @@ def test_lbsgd_warmup_and_lars():
     o.update(0, w, g, None)
     # lars = sqrt(|w|^2 / (|g|^2 + wd|w|^2 + eps)) = sqrt(16/1) = 4
     assert abs(o.lbmult - 4.0) < 1e-5, o.lbmult
+
+
+def test_conv_pooling_nhwc_layout():
+    """Channels-last convolution/pooling (reference: NHWC conv support,
+    GPU-only there; first-class here) match the NCHW math."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")     # OIHW
+    b = rng.randn(4).astype("float32")
+    out1 = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                             kernel=(3, 3), num_filter=4,
+                             pad=(1, 1)).asnumpy()
+    xl = np.transpose(x, (0, 2, 3, 1))
+    wl = np.transpose(w, (0, 2, 3, 1))              # OHWI
+    out2 = mx.nd.Convolution(mx.nd.array(xl), mx.nd.array(wl),
+                             mx.nd.array(b), kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), layout="NHWC").asnumpy()
+    np.testing.assert_allclose(np.transpose(out2, (0, 3, 1, 2)), out1,
+                               rtol=1e-4, atol=1e-5)
+    p1 = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                       pool_type="max").asnumpy()
+    p2 = mx.nd.Pooling(mx.nd.array(xl), kernel=(2, 2), stride=(2, 2),
+                       pool_type="max", layout="NHWC").asnumpy()
+    np.testing.assert_allclose(np.transpose(p2, (0, 3, 1, 2)), p1)
+
+    # gluon layer: deferred init infers channels from the LAST axis
+    net = nn.Conv2D(4, 3, padding=1, layout="NHWC")
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    y = net(mx.nd.array(xl))
+    assert y.shape == (2, 8, 8, 4)
+    assert net.weight.shape == (4, 3, 3, 3)   # (O, kh, kw, I)
