@@ -81,8 +81,10 @@ def bench_functional(on_accel):
     return batch * steps / dt, "functional"
 
 
-def bench_gluon(on_accel):
-    """The user-facing path: zoo model + Trainer + FusedTrainStep."""
+def bench_gluon(on_accel, layout="NCHW"):
+    """The user-facing path: zoo model + Trainer + FusedTrainStep.
+    layout='NHWC' runs the zoo model channels-last (the TPU-native
+    layout the functional path uses)."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -96,15 +98,16 @@ def bench_gluon(on_accel):
 
     mx.random.seed(0)
     with mx.Context(ctx):
-        net = (vision.resnet50_v1(classes=1000) if on_accel
-               else vision.resnet18_v1(classes=10))
+        net = (vision.resnet50_v1(classes=1000, layout=layout) if on_accel
+               else vision.resnet18_v1(classes=10, layout=layout))
         net.initialize(mx.init.Xavier(rnd_type="gaussian"), ctx=ctx)
         net.cast("bfloat16")  # conv stack bf16; BatchNorm stays fp32
         net.hybridize(static_alloc=True)
 
         rng = np.random.RandomState(1)
-        x = nd.array(rng.randn(batch, 3, size, size), ctx=ctx,
-                     dtype="bfloat16")
+        shape = ((batch, 3, size, size) if layout == "NCHW"
+                 else (batch, size, size, 3))
+        x = nd.array(rng.randn(*shape), ctx=ctx, dtype="bfloat16")
         y = nd.array(rng.randint(0, 10, (batch,)), ctx=ctx, dtype="float32")
         net(x)  # shape inference + param init
 
@@ -263,8 +266,13 @@ def main():
             "vs_baseline": round(tok_s / bert_bar, 4),
         }))
         return
-    img_s, path = (bench_functional if which == "functional"
-                   else bench_gluon)(on_accel)
+    if which == "functional":
+        img_s, path = bench_functional(on_accel)
+    elif which == "gluon_nhwc":
+        img_s, path = bench_gluon(on_accel, layout="NHWC")
+        path = "gluon_nhwc"
+    else:
+        img_s, path = bench_gluon(on_accel)
     if on_accel:
         name = "resnet50_train_img_per_sec"
         if path != "gluon":
@@ -273,7 +281,7 @@ def main():
         # CPU smoke paths measure different tiny models — name them honestly
         # (round-1 key kept for the functional config)
         name = ("resnet_tiny_cpu_img_per_sec" if path == "functional"
-                else "resnet18_cpu_gluon_img_per_sec")
+                else "resnet18_cpu_%s_img_per_sec" % path)
     print(json.dumps({
         "metric": name,
         "value": round(img_s, 2),

@@ -47,7 +47,8 @@ def make_corpus(rng, n, seq_len=12):
 
 
 def encode(vocab, sents, seq_len=12):
-    out = np.zeros((len(sents), seq_len), np.float32)
+    pad = vocab.to_indices("<pad>")
+    out = np.full((len(sents), seq_len), float(pad), np.float32)
     for i, words in enumerate(sents):
         idx = vocab.to_indices(words)[:seq_len]
         out[i, :len(idx)] = idx

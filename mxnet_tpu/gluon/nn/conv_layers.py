@@ -1,9 +1,9 @@
 """Convolution & pooling layers.
 reference: python/mxnet/gluon/nn/conv_layers.py.
 
-NCHW/OIHW "channels-first" layouts are kept for API parity with the
-reference; XLA relayouts to the TPU-native tiling internally, so this costs
-nothing at runtime.
+Both channels-first (NCW/NCHW/NCDHW, the reference default) and
+channels-last (NWC/NHWC/NDHWC) layouts are supported end-to-end; XLA
+relayouts to the TPU-native tiling internally either way.
 """
 from __future__ import annotations
 
@@ -35,12 +35,9 @@ class _Conv(HybridBlock):
         with self.name_scope():
             self._channels = channels
             self._in_channels = in_channels
-            nd_sp = len(kernel_size)
-            spatial = "DHW"[3 - nd_sp:]
-            allowed = ("NC" + spatial, "N" + spatial + "C")
-            assert layout in allowed, \
-                "layout must be one of %s; got %s" % (allowed, layout)
-            self._channels_last = layout == allowed[1]
+            from ...ops.nn import layout_info
+            _, self._channels_last = layout_info(
+                layout, len(kernel_size), type(self).__name__)
             self._kwargs = {
                 "kernel": kernel_size, "stride": strides, "dilate": dilation,
                 "pad": padding, "num_filter": channels, "num_group": groups,
@@ -240,10 +237,8 @@ class _Pooling(HybridBlock):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
-        spatial = "DHW"[3 - len(pool_size):]
-        allowed = ("NC" + spatial, "N" + spatial + "C")
-        assert layout in allowed, \
-            "layout must be one of %s; got %s" % (allowed, layout)
+        from ...ops.nn import layout_info
+        layout_info(layout, len(pool_size), type(self).__name__)
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,

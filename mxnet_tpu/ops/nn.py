@@ -49,19 +49,29 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
 # Convolution (reference: src/operator/nn/convolution.cc) — NCHW/OIHW layout
 # to match the reference API; XLA relayouts internally for the MXU.
 # ---------------------------------------------------------------------------
-def _conv_layouts(layout, nd):
-    """MXNet layout string -> (data_layout, weight_layout). Channels-first
-    weights are OI+spatial; channels-last (reference: NHWC convs, GPU-only
-    there) use O+spatial+I — weight (num_filter, *kernel, C/groups)."""
+def layout_info(layout, nd, op="Convolution"):
+    """Validate an MXNet layout string for nd spatial dims. Returns
+    (layout, channels_last). The single source of truth for which layouts
+    exist — gluon layers and ops all consult this."""
     spatial = "DHW"[3 - nd:]
     if layout is None:
         layout = "NC" + spatial
     if layout == "NC" + spatial:
-        return layout, "OI" + spatial
+        return layout, False
     if layout == "N" + spatial + "C":
-        return layout, "O" + spatial + "I"
-    raise ValueError("Convolution: unsupported layout %r for %dD" %
-                     (layout, nd))
+        return layout, True
+    raise ValueError("%s: unsupported layout %r for %dD (expected %r or %r)"
+                     % (op, layout, nd, "NC" + spatial,
+                        "N" + spatial + "C"))
+
+
+def _conv_layouts(layout, nd):
+    """layout -> (data_layout, weight_layout). Channels-first weights are
+    OI+spatial; channels-last (reference: NHWC convs, GPU-only there) use
+    O+spatial+I — weight (num_filter, *kernel, C/groups)."""
+    layout, last = layout_info(layout, nd)
+    spatial = "DHW"[3 - nd:]
+    return layout, ("O" + spatial + "I") if last else ("OI" + spatial)
 
 
 @register("Convolution")
@@ -101,6 +111,10 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None,
     pad = _pair(pad if pad else 0, nd)
     adj = _pair(adj if adj else 0, nd)
     spatial = "DHW"[3 - nd:]
+    _, last = layout_info(layout, nd, "Deconvolution")
+    if last:
+        raise NotImplementedError(
+            "Deconvolution: channels-last layouts not implemented")
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ("NC" + spatial, "IO" + spatial, "NC" + spatial))
@@ -127,12 +141,7 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False,
              stride=None, pad=None, pooling_convention="valid",
              count_include_pad=True, cudnn_off=None, layout=None, p_value=2):
     nd = data.ndim - 2
-    spatial_lay = "DHW"[3 - nd:]
-    if layout is not None and layout not in ("NC" + spatial_lay,
-                                             "N" + spatial_lay + "C"):
-        raise ValueError("Pooling: unsupported layout %r for %dD input"
-                         % (layout, nd))
-    channels_last = layout == "N" + spatial_lay + "C"
+    _, channels_last = layout_info(layout, nd, "Pooling")
     spatial_axes = (tuple(range(1, 1 + nd)) if channels_last
                     else tuple(range(2, data.ndim)))
     if global_pool:

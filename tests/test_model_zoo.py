@@ -104,3 +104,33 @@ def test_bert_model_zoo():
     # presets resolve
     big = bert.get_bert_model("bert_12_768_12")
     assert big.encoder._num_heads == 12
+
+
+def test_resnet_nhwc_layout_matches_nchw():
+    """Zoo resnet layout='NHWC' (channels-last, the TPU-native layout)
+    computes the same function as NCHW given transposed weights."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(5)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 32, 32).astype(np.float32)
+
+    n1 = vision.resnet18_v1(classes=10)
+    n1.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    y1 = n1(nd.array(x)).asnumpy()
+
+    n2 = vision.resnet18_v1(classes=10, layout="NHWC")
+    n2.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    xl = nd.array(np.transpose(x, (0, 2, 3, 1)))
+    n2(xl)
+    for (k1, a), (k2, b) in zip(sorted(n1.collect_params().items()),
+                                sorted(n2.collect_params().items())):
+        arr = a.data().asnumpy()
+        if arr.ndim == 4 and b.shape != arr.shape:
+            arr = np.transpose(arr, (0, 2, 3, 1))   # OIHW -> OHWI
+        b.set_data(nd.array(arr))
+    y2 = n2(xl).asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-4)
