@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, alias
+from .nn import _pair
 
 __all__ = []
 
@@ -474,3 +475,129 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
     sup = out[..., 1] <= 0
     out = out.at[..., 0].set(jnp.where(sup, -1.0, out[..., 0]))
     return out
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (reference: contrib/deformable_convolution.cc,
+# Dai et al. 2017) and PSROIPooling (contrib/psroi_pooling.cc, R-FCN).
+# TPU-first: the deformable sampling is a static unroll over kernel taps —
+# each tap is one vectorized bilinear gather over the whole batch, and the
+# channel contraction stays a single einsum on the MXU per tap group.
+# ---------------------------------------------------------------------------
+def _bilinear_gather(img, ys, xs):
+    """img (C, H, W); ys/xs (Ho, Wo) fractional coords -> (C, Ho, Wo).
+    Corner taps outside the image contribute zero — the value decays
+    bilinearly to zero across the border instead of clamping to the edge
+    pixel, exactly the reference's dmcn_im2col_bilinear behavior (also
+    what keeps the offset gradient alive at image edges)."""
+    h, w = img.shape[1], img.shape[2]
+    y0f = jnp.floor(ys)
+    x0f = jnp.floor(xs)
+    yf = (ys - y0f)[None]
+    xf = (xs - x0f)[None]
+    y0 = y0f.astype(jnp.int32)
+    x0 = x0f.astype(jnp.int32)
+
+    def corner(yi, xi):
+        ok = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)) \
+            .astype(jnp.float32)
+        v = img[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+        return v * ok[None]
+
+    return (corner(y0, x0) * (1 - yf) * (1 - xf) +
+            corner(y0, x0 + 1) * (1 - yf) * xf +
+            corner(y0 + 1, x0) * yf * (1 - xf) +
+            corner(y0 + 1, x0 + 1) * yf * xf)
+
+
+@register("_contrib_DeformableConvolution", arity=3)
+def _deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                            stride=None, dilate=None, pad=None,
+                            num_filter=None, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            layout=None, workspace=None):
+    """data (N, C, H, W); offset (N, 2*dg*kh*kw, Ho, Wo) ordered
+    [y, x] per tap per deformable group; weight (O, C/g, kh, kw)."""
+    if num_group != 1:
+        raise NotImplementedError("DeformableConvolution: num_group > 1")
+    from .nn import layout_info
+    _, last = layout_info(layout, 2, "DeformableConvolution")
+    if last:
+        raise NotImplementedError(
+            "DeformableConvolution: channels-last layouts not implemented")
+    kh, kw = kernel
+    stride = _pair(stride if stride else 1, 2)
+    dilate = _pair(dilate if dilate else 1, 2)
+    pad = _pair(pad if pad else 0, 2)
+    n, c, h, w = data.shape
+    ho = (h + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
+    wo = (w + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
+    dg = num_deformable_group
+    cg = c // dg
+    f32 = data.astype(jnp.float32)
+    off = offset.astype(jnp.float32).reshape(n, dg, kh * kw, 2, ho, wo)
+
+    base_y = (jnp.arange(ho) * stride[0] - pad[0])[:, None]      # (Ho, 1)
+    base_x = (jnp.arange(wo) * stride[1] - pad[1])[None, :]      # (1, Wo)
+
+    out = jnp.zeros((n, num_filter, ho, wo), jnp.float32)
+    wgt = weight.astype(jnp.float32)
+    for k in range(kh * kw):
+        ky, kx = k // kw, k % kw
+        for g in range(dg):
+            ys = base_y + ky * dilate[0] + off[:, g, k, 0]       # (N, Ho, Wo)
+            xs = base_x + kx * dilate[1] + off[:, g, k, 1]
+            sampled = jax.vmap(_bilinear_gather)(
+                f32[:, g * cg:(g + 1) * cg], ys, xs)             # (N,cg,Ho,Wo)
+            out = out + jnp.einsum("nchw,oc->nohw", sampled,
+                                   wgt[:, g * cg:(g + 1) * cg, ky, kx])
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32).reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_PSROIPooling", arity=2)
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                   pooled_size=None, group_size=None):
+    """Position-sensitive ROI pooling (reference: psroi_pooling.cc).
+    data (N, output_dim*ps*ps, H, W); rois (R, 5) [b, x1, y1, x2, y2];
+    output (R, output_dim, ps, ps) — bin (i, j) averages its OWN channel
+    slice over its sub-window. Masked means keep every shape static."""
+    ps = int(pooled_size)
+    if group_size is not None and int(group_size) != ps:
+        raise NotImplementedError("PSROIPooling: group_size != pooled_size")
+    n, ctot, h, w = data.shape
+    od = int(output_dim)
+    f32 = data.astype(jnp.float32).reshape(n, od, ps, ps, h, w)
+
+    hh = jnp.arange(h, dtype=jnp.float32)
+    ww = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        # reference psroi_pooling.cc: start = round(coord)*scale,
+        # end = (round(coord)+1)*scale — the window includes the end pixel
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ps, rw / ps
+        # bin windows [floor(start), ceil(end)) as row/col masks
+        i = jnp.arange(ps, dtype=jnp.float32)
+        hs = jnp.floor(y1 + i * bh)            # (ps,)
+        he = jnp.ceil(y1 + (i + 1) * bh)
+        ws_ = jnp.floor(x1 + i * bw)
+        we = jnp.ceil(x1 + (i + 1) * bw)
+        rmask = ((hh[None, :] >= hs[:, None]) &
+                 (hh[None, :] < he[:, None])).astype(jnp.float32)  # (ps, H)
+        cmask = ((ww[None, :] >= ws_[:, None]) &
+                 (ww[None, :] < we[:, None])).astype(jnp.float32)  # (ps, W)
+        img = f32[bidx]                                  # (od, ps, ps, H, W)
+        num = jnp.einsum("dijhw,ih,jw->dij", img, rmask, cmask)
+        cnt = jnp.einsum("ih,jw->ij", rmask, cmask)
+        return num / jnp.maximum(cnt, 1.0)[None]
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32))
+    return out.astype(data.dtype)

@@ -223,3 +223,102 @@ def test_multibox_target_negative_mining():
     assert ct[0, 0] == 1.0           # positive, cls 0 -> target 1
     assert ct[0, 1] == 0.0           # kept negative
     assert ct[0, 2] == -1.0          # ignored
+
+
+def test_deformable_convolution_zero_offset_equals_conv():
+    """With zero offsets, DeformableConvolution reduces to a plain conv
+    (reference: deformable_convolution.cc semantics)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=4, pad=(1, 1), no_bias=True).asnumpy()
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        num_filter=4, pad=(1, 1), no_bias=True).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_shifts():
+    """A constant +1-pixel x-offset equals shifting the input."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 6, 6).astype(np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 1] = 1.0   # x-offset +1
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(1, 1),
+        num_filter=1, no_bias=True).asnumpy()
+    # out[..., :, j] == x[..., :, j+1]; last column samples x=6 -> zero
+    np.testing.assert_allclose(out[0, 0, :, :-1], x[0, 0, :, 1:],
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, :, -1], 0.0, atol=1e-6)
+
+
+def test_deformable_convolution_backward():
+    """Gradients flow to data, offsets, and weights."""
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    off = nd.array(0.1 * rng.randn(1, 2 * 9, 6, 6).astype(np.float32))
+    w = nd.array(rng.randn(3, 2, 3, 3).astype(np.float32))
+    for a in (x, off, w):
+        a.attach_grad()
+    with autograd.record():
+        y = nd.contrib.DeformableConvolution(
+            x, off, w, kernel=(3, 3), num_filter=3, pad=(1, 1),
+            no_bias=True).sum()
+    y.backward()
+    for name, a in (("data", x), ("offset", off), ("weight", w)):
+        g = a.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0, name
+
+
+def test_psroi_pooling():
+    """Each output bin pools from its own channel group: feeding channel
+    value = its (d, i, j) code recovers the code per bin."""
+    od, ps, h, w = 2, 3, 9, 9
+    data = np.zeros((1, od * ps * ps, h, w), np.float32)
+    for d in range(od):
+        for i in range(ps):
+            for j in range(ps):
+                data[0, d * ps * ps + i * ps + j] = d * 100 + i * 10 + j
+    rois = np.array([[0, 0, 0, 9, 9]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                     spatial_scale=1.0, output_dim=od,
+                                     pooled_size=ps).asnumpy()
+    assert out.shape == (1, od, ps, ps)
+    for d in range(od):
+        for i in range(ps):
+            for j in range(ps):
+                np.testing.assert_allclose(out[0, d, i, j],
+                                           d * 100 + i * 10 + j, atol=1e-5)
+
+
+def test_deformable_edge_decay_and_psroi_grad():
+    """Edge samples decay bilinearly to zero (reference
+    dmcn_im2col_bilinear); PSROIPooling is differentiable."""
+    from mxnet_tpu import autograd
+    # 1x1 kernel, offset placing the sample at y = -0.5: value must be
+    # half the first row, not the full clamped row
+    x = np.full((1, 1, 4, 4), 2.0, np.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[:, 0, 0, :] = -0.5    # y-offset -0.5 on the first output row
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(np.ones((1, 1, 1, 1),
+                                                     np.float32)),
+        kernel=(1, 1), num_filter=1, no_bias=True).asnumpy()
+    np.testing.assert_allclose(out[0, 0, 0], 1.0, atol=1e-6)   # 0.5 * 2.0
+    np.testing.assert_allclose(out[0, 0, 1], 2.0, atol=1e-6)
+
+    data = nd.array(np.random.RandomState(0).randn(1, 2 * 2 * 2, 6, 6)
+                    .astype(np.float32))
+    rois = nd.array(np.array([[0, 1, 1, 4, 4]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.PSROIPooling(data, rois, spatial_scale=1.0,
+                                       output_dim=2, pooled_size=2).sum()
+    y.backward()
+    g = data.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0

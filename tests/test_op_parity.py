@@ -59,6 +59,7 @@ div_sqrt_dim adamw_update
 box_nms box_iou box_encode box_decode ROIAlign BilinearResize2D
 AdaptiveAvgPooling2D arange_like
 MultiBoxPrior MultiBoxTarget MultiBoxDetection
+DeformableConvolution PSROIPooling
 """.split()
 
 
