@@ -1,5 +1,6 @@
 """`gluon.contrib` (reference: python/mxnet/gluon/contrib/)."""
+from . import cnn
 from . import nn
 from . import rnn
 
-__all__ = ["nn", "rnn"]
+__all__ = ["cnn", "nn", "rnn"]

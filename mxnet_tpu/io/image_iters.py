@@ -29,7 +29,8 @@ from ..base import MXNetError
 from ..ndarray.ndarray import array
 from .io import DataBatch, DataDesc, DataIter
 
-__all__ = ["ImageRecordIter", "CSVIter", "MNISTIter"]
+__all__ = ["ImageRecordIter", "CSVIter", "MNISTIter",
+           "ImageDetRecordIter"]
 
 
 def _resize_short(img, size):
@@ -552,3 +553,37 @@ class MNISTIter(DataIter):
                          label=[array(self._labels[idx])], pad=0,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+_DET_ITER_KNOWN = {
+    "path_imglist", "path_root", "imglist", "aug_list", "data_name",
+    "label_name", "shuffle", "part_index", "num_parts", "dtype",
+    "last_batch_handle", "resize", "rand_crop", "rand_pad", "rand_gray",
+    "rand_mirror", "mean", "std", "brightness", "contrast", "saturation",
+    "pca_noise", "hue", "inter_method", "min_object_covered",
+    "aspect_ratio_range", "area_range", "min_eject_coverage",
+    "max_attempts", "pad_val", "label_width"}
+
+
+def ImageDetRecordIter(path_imgrec=None, batch_size=None, data_shape=None,
+                       mean_r=None, mean_g=None, mean_b=None, std_r=None,
+                       std_g=None, std_b=None, **kwargs):
+    """`mx.io.ImageDetRecordIter` — detection-record iterator name from the
+    reference's C++ surface (src/io/iter_image_det_recordio.cc); a factory
+    over the label-aware `mx.image.ImageDetIter` for the same .rec files.
+    The C++ per-channel mean_r/std_r args translate to the mean/std chain;
+    unknown kwargs raise instead of silently dropping augmentations."""
+    from ..image_detection import ImageDetIter
+    if any(v is not None for v in (mean_r, mean_g, mean_b)):
+        kwargs.setdefault("mean", (mean_r or 0.0, mean_g or 0.0,
+                                   mean_b or 0.0))
+    if any(v is not None for v in (std_r, std_g, std_b)):
+        kwargs.setdefault("std", (std_r or 1.0, std_g or 1.0, std_b or 1.0))
+    unknown = set(kwargs) - _DET_ITER_KNOWN
+    if unknown:
+        raise MXNetError(
+            "ImageDetRecordIter: unsupported arguments %s (the C++ "
+            "iterator's remaining knobs are not implemented here — pass an "
+            "explicit aug_list instead)" % sorted(unknown))
+    return ImageDetIter(batch_size=batch_size, data_shape=data_shape,
+                        path_imgrec=path_imgrec, **kwargs)

@@ -533,6 +533,10 @@ def _deformable_convolution(data, offset, weight, bias=None, kernel=None,
     ho = (h + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
     wo = (w + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
     dg = num_deformable_group
+    if c % dg != 0:
+        raise ValueError(
+            "DeformableConvolution: channels (%d) must divide evenly into "
+            "num_deformable_group (%d)" % (c, dg))
     cg = c // dg
     f32 = data.astype(jnp.float32)
     off = offset.astype(jnp.float32).reshape(n, dg, kh * kw, 2, ho, wo)
@@ -576,11 +580,13 @@ def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
         # reference psroi_pooling.cc: start = round(coord)*scale,
-        # end = (round(coord)+1)*scale — the window includes the end pixel
-        x1 = jnp.round(roi[1]) * spatial_scale
-        y1 = jnp.round(roi[2]) * spatial_scale
-        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
-        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        # end = (round(coord)+1)*scale — the window includes the end
+        # pixel. C round() is half-away-from-zero: floor(x+0.5) for the
+        # non-negative coords here (jnp.round is half-to-even).
+        x1 = jnp.floor(roi[1] + 0.5) * spatial_scale
+        y1 = jnp.floor(roi[2] + 0.5) * spatial_scale
+        x2 = (jnp.floor(roi[3] + 0.5) + 1.0) * spatial_scale
+        y2 = (jnp.floor(roi[4] + 0.5) + 1.0) * spatial_scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bh, bw = rh / ps, rw / ps

@@ -322,3 +322,29 @@ def test_deformable_edge_decay_and_psroi_grad():
     y.backward()
     g = data.grad.asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_gluon_deformable_convolution_block():
+    """gluon.contrib.cnn.DeformableConvolution (reference:
+    gluon/contrib/cnn/conv_layers.py): zero-initialized offsets start as a
+    plain conv; the block hybridizes and backprops into the offset conv."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+
+    mx.random.seed(0)
+    net = DeformableConvolution(8, kernel_size=3, padding=1)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(0).randn(2, 4, 8, 8)
+                 .astype(np.float32))
+    y = net(x)
+    ref = nd.Convolution(x, net.weight.data(), net.bias.data(),
+                         kernel=(3, 3), num_filter=8, pad=(1, 1))
+    np.testing.assert_allclose(y.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    assert np.isfinite(net.offset.weight.grad().asnumpy()).all()
+    net.hybridize()
+    np.testing.assert_allclose(net(x).asnumpy(), y.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
