@@ -22,3 +22,13 @@ for _pub, _src in [("uniform", "_random_uniform"),
                    ("shuffle", "_shuffle")]:
     setattr(random, _pub, _mk(_src))
 _sys.modules[random.__name__] = random
+
+# mx.sym.contrib.* sub-namespace (reference: python/mxnet/symbol/contrib.py
+# — every `_contrib_*` registered op under its short name, composable into
+# graphs exactly like the core ops)
+from ..ops import registry as _reg_mod  # noqa: E402
+contrib = _types.ModuleType(__name__ + ".contrib")
+for _full in list(_reg_mod.list_ops()):
+    if _full.startswith("_contrib_"):
+        setattr(contrib, _full[len("_contrib_"):], _mk(_full))
+_sys.modules[contrib.__name__] = contrib

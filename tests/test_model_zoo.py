@@ -134,3 +134,28 @@ def test_resnet_nhwc_layout_matches_nchw():
         b.set_data(nd.array(arr))
     y2 = n2(xl).asnumpy()
     np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-4)
+
+
+def test_bert_save_load_roundtrip():
+    """Zoo BERT parameters roundtrip through the .params format."""
+    import os
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    kw = dict(vocab_size=50, units=16, hidden_size=32, num_layers=1,
+              num_heads=2, max_length=16, dropout=0.0)
+    m = bert.BERTModel(**kw)
+    m.initialize(mx.init.Normal(0.02), ctx=mx.cpu())
+    tok = nd.array(np.random.RandomState(0).randint(0, 50, (2, 8)),
+                   dtype="float32")
+    y1 = m(tok)[0].asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "bert.params")
+        m.save_parameters(p)
+        m2 = bert.BERTModel(**kw)
+        m2.load_parameters(p, ctx=mx.cpu())
+        y2 = m2(tok)[0].asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)

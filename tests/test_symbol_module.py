@@ -173,3 +173,19 @@ def test_bucketing_module():
     batch5.bucket_key = 5
     mod.forward(batch5, is_train=True)
     assert mod.get_outputs()[0].shape == (8, 4)
+
+
+def test_sym_contrib_namespace():
+    """mx.sym.contrib (reference: python/mxnet/symbol/contrib.py): contrib
+    ops compose into graphs and bind like core ops."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, nd
+    data = sym.Variable("feat")
+    anchors = sym.contrib.MultiBoxPrior(data, sizes=(0.4,),
+                                        ratios=(1.0, 2.0))
+    assert anchors.list_arguments() == ["feat"]
+    ex = anchors.bind(mx.cpu(), {"feat": nd.zeros((1, 8, 2, 2))})
+    out = ex.forward()[0]
+    assert out.shape == (1, 2 * 2 * 2, 4)
+    assert hasattr(sym.contrib, "interleaved_matmul_selfatt_qk")
+    assert hasattr(sym.contrib, "box_nms")
