@@ -46,4 +46,11 @@ for _full in list(_reg_mod.list_ops()):
     if _full.startswith("_contrib_"):
         setattr(contrib, _full[len("_contrib_"):],
                 _register.make_op_func(_full))
+# control-flow contrib ops are python-level (they take function-valued
+# args, like the reference's contrib.foreach/while_loop/cond)
+from .contrib_flow import foreach as _foreach, \
+    while_loop as _while_loop, cond as _cond  # noqa: E402
+contrib.foreach = _foreach
+contrib.while_loop = _while_loop
+contrib.cond = _cond
 _sys.modules[contrib.__name__] = contrib

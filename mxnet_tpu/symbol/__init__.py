@@ -31,4 +31,11 @@ contrib = _types.ModuleType(__name__ + ".contrib")
 for _full in list(_reg_mod.list_ops()):
     if _full.startswith("_contrib_"):
         setattr(contrib, _full[len("_contrib_"):], _mk(_full))
+# control-flow contrib ops are F-generic python functions (tracing runs
+# through nd with tracer payloads), same objects as nd.contrib's
+from ..ndarray.contrib_flow import foreach as _cf_foreach, \
+    while_loop as _cf_while_loop, cond as _cf_cond  # noqa: E402
+contrib.foreach = _cf_foreach
+contrib.while_loop = _cf_while_loop
+contrib.cond = _cf_cond
 _sys.modules[contrib.__name__] = contrib

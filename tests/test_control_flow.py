@@ -1,0 +1,140 @@
+"""Control-flow contrib ops (reference: src/operator/control_flow.cc,
+python/mxnet/ndarray/contrib.py foreach/while_loop/cond) — lax.scan/
+while_loop/cond under the hood, so they stay compiled control flow
+inside hybridized/jitted programs."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(6, 1))
+    init = nd.zeros((1,))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    np.testing.assert_allclose(outs.asnumpy().ravel(),
+                               np.cumsum(np.arange(6)))
+    np.testing.assert_allclose(final.asnumpy(), [15.0])
+
+
+def test_foreach_multiple_states_and_grad():
+    data = nd.array(np.ones((4, 2), np.float32))
+    w = nd.array(np.full((2,), 2.0, np.float32))
+    w.attach_grad()
+
+    def body(x, state):
+        s = state + x * w
+        return s, s
+
+    with autograd.record():
+        outs, final = nd.contrib.foreach(body, data, nd.zeros((2,)))
+        loss = final.sum()
+    loss.backward()
+    # final = sum_i x_i * w = 4 * w elementwise -> d(loss)/dw = 4 per elem
+    np.testing.assert_allclose(w.grad.asnumpy(), [4.0, 4.0])
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, (i + 1, s + i)   # output, new loop vars
+
+    outs, (i_fin, s_fin) = nd.contrib.while_loop(
+        cond_fn, func, (nd.zeros((1,)), nd.zeros((1,))),
+        max_iterations=8)
+    # steps: s accumulates 0+0,+1,+2,+3,+4 = 10
+    np.testing.assert_allclose(float(s_fin.asnumpy()), 10.0)
+    np.testing.assert_allclose(float(i_fin.asnumpy()), 5.0)
+    assert outs.shape == (8, 1)          # max_iterations buffer
+    np.testing.assert_allclose(outs.asnumpy().ravel()[:5],
+                               [0, 1, 3, 6, 10])
+    np.testing.assert_allclose(outs.asnumpy().ravel()[5:], 0.0)  # padding
+
+
+def test_cond():
+    x = nd.array(np.array([3.0], np.float32))
+
+    out = nd.contrib.cond(nd.array([1.0]),
+                          lambda a: a * 2,
+                          lambda a: a - 1, (x,))
+    np.testing.assert_allclose(out.asnumpy(), [6.0])
+    out = nd.contrib.cond(nd.array([0.0]),
+                          lambda a: a * 2,
+                          lambda a: a - 1, (x,))
+    np.testing.assert_allclose(out.asnumpy(), [2.0])
+
+
+def test_foreach_inside_hybridized_block():
+    """foreach stays ONE lax.scan inside a hybridized forward."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class Scanner(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.proj = nn.Dense(4, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h = self.proj(x)                      # (N, T, 4)
+            seq = F.transpose(h, axes=(1, 0, 2))  # (T, N, 4)
+
+            def body(xt, state):
+                s = F.tanh(state + xt)
+                return s, s
+
+            outs, final = F.contrib.foreach(body, seq,
+                                            F.zeros_like(seq[0]))
+            return final
+
+    net = Scanner()
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    x = nd.array(np.random.RandomState(0).randn(2, 5, 3).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(y_eager, y_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_while_loop_single_var_and_zero_trip():
+    """Single (non-tuple) loop var works traced and eager; zero-trip loops
+    return the zero buffer in both regimes."""
+    # single loop var, hybridized
+    from mxnet_tpu import gluon
+
+    class Loop(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, (final,) = F.contrib.while_loop(
+                lambda i: nd.sum(i) < 10.0,
+                lambda i: (i * 2, i + 3),
+                (x,), max_iterations=6)
+            return final
+
+    net = Loop()
+    net.initialize()
+    x = nd.array(np.array([1.0], np.float32))
+    eager = float(net(x).asnumpy())
+    net.hybridize()
+    hyb = float(net(x).asnumpy())
+    assert eager == hyb == 10.0    # 1 -> 4 -> 7 -> 10, stop
+
+    # zero-trip
+    outs, (v,) = nd.contrib.while_loop(
+        lambda i: nd.sum(i) < 0.0, lambda i: (i * 2, i + 1),
+        (nd.array(np.array([5.0], np.float32)),), max_iterations=4)
+    assert outs.shape == (4, 1)
+    np.testing.assert_allclose(outs.asnumpy(), 0.0)
+    np.testing.assert_allclose(v.asnumpy(), [5.0])
+
+    # sym.contrib exposes the trio
+    from mxnet_tpu import sym
+    assert hasattr(sym.contrib, "foreach")
+    assert hasattr(sym.contrib, "while_loop")
+    assert hasattr(sym.contrib, "cond")
