@@ -1,0 +1,442 @@
+"""mx.np vs real numpy behavior sweep.
+
+reference idiom: tests/python/unittest/test_numpy_op.py — every op checked
+against numpy's own result on random inputs. Sweep families: elementwise,
+reductions, manipulation, indexing edge semantics (boolean masks, fancy
+indexing, zero-dim), operator protocols, and the documented mx.np
+deviations (float32 default dtype for python lists).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+
+
+def _cmp(mx_out, onp_out, rtol=1e-5, atol=1e-6):
+    if isinstance(onp_out, (tuple, list)):
+        assert len(mx_out) == len(onp_out)
+        for m, o in zip(mx_out, onp_out):
+            _cmp(m, o, rtol, atol)
+        return
+    got = mx_out.asnumpy() if hasattr(mx_out, "asnumpy") else onp.asarray(
+        mx_out)
+    onp.testing.assert_allclose(got, onp_out, rtol=rtol, atol=atol)
+
+
+A = onp.random.RandomState(7).randn(3, 4).astype("float32")
+B = onp.random.RandomState(8).randn(3, 4).astype("float32")
+P = onp.abs(A) + 0.5          # strictly positive
+I4 = onp.array([[3, 1, 2, 0], [1, 0, 3, 2], [0, 2, 1, 3]], dtype="int32")
+
+UNARY = [
+    ("exp", A), ("expm1", A), ("log", P), ("log2", P), ("log10", P),
+    ("log1p", P), ("sqrt", P), ("cbrt", A), ("square", A), ("abs", A),
+    ("fabs", A), ("sign", A), ("sin", A), ("cos", A), ("tan", A),
+    ("arcsin", A / 10), ("arccos", A / 10), ("arctan", A), ("sinh", A),
+    ("cosh", A), ("tanh", A), ("arcsinh", A), ("arccosh", P + 1),
+    ("arctanh", A / 10), ("floor", A), ("ceil", A), ("trunc", A),
+    ("rint", A), ("fix", A), ("degrees", A), ("radians", A),
+    ("deg2rad", A), ("rad2deg", A), ("negative", A), ("positive", A),
+    ("reciprocal", P), ("exp2", A), ("sinc", A), ("i0", A),
+    ("nan_to_num", A), ("isnan", A), ("isinf", A), ("isfinite", A),
+    ("signbit", A), ("real", A), ("imag", A), ("conj", A),
+]
+
+BINARY = [
+    ("add", A, B), ("subtract", A, B), ("multiply", A, B),
+    ("divide", A, P), ("true_divide", A, P), ("power", P, B),
+    ("float_power", P, B), ("maximum", A, B), ("minimum", A, B),
+    ("fmax", A, B), ("fmin", A, B), ("hypot", A, B),
+    ("arctan2", A, B), ("atan2", A, B), ("copysign", A, B),
+    ("logaddexp", A, B), ("logaddexp2", A, B), ("mod", A, P),
+    ("remainder", A, P), ("fmod", A, P), ("heaviside", A, B),
+    ("nextafter", A, B), ("floor_divide", A, P),
+    ("equal", A, B), ("not_equal", A, B), ("greater", A, B),
+    ("less", A, B), ("greater_equal", A, B), ("less_equal", A, B),
+    ("logical_and", A, B), ("logical_or", A, B), ("logical_xor", A, B),
+]
+
+REDUCTIONS = [
+    ("sum", {}), ("sum", {"axis": 0}), ("sum", {"axis": 1, "keepdims": True}),
+    ("prod", {}), ("mean", {"axis": 0}), ("std", {}), ("var", {"axis": 1}),
+    ("max", {}), ("min", {"axis": 0}), ("amax", {"axis": 1}),
+    ("amin", {}), ("ptp", {}), ("median", {}), ("cumsum", {"axis": 1}),
+    ("cumprod", {"axis": 0}), ("nansum", {}), ("nanmean", {"axis": 0}),
+    ("nanmax", {}), ("nanmin", {}), ("argmax", {}), ("argmin", {"axis": 1}),
+    ("count_nonzero", {}), ("all", {}), ("any", {}),
+]
+
+MANIP = [
+    ("reshape", (A, (4, 3))), ("ravel", (A,)), ("transpose", (A,)),
+    ("swapaxes", (A, 0, 1)), ("moveaxis", (A, 0, 1)),
+    ("rollaxis", (A, 1)), ("expand_dims", (A, 0)),
+    ("squeeze", (A[None],)), ("broadcast_to", (A[0], (3, 4))),
+    ("tile", (A, 2)), ("repeat", (A, 2)), ("roll", (A, 1)),
+    ("flip", (A,)), ("fliplr", (A,)), ("flipud", (A,)), ("rot90", (A,)),
+    ("atleast_1d", (A[0, 0],)), ("atleast_2d", (A[0],)),
+    ("atleast_3d", (A,)), ("diag", (A[0],)), ("diagflat", (A[0],)),
+    ("tril", (A,)), ("triu", (A,)), ("vander", (A[0],)),
+    ("sort", (A,)), ("argsort", (A,)), ("diff", (A,)),
+    ("ediff1d", (A,)), ("gradient", (A[0],)), ("trim_zeros",
+        (onp.array([0., 1., 2., 0.]),)),
+    ("append", (A, B)), ("delete", (A, 1, 0)), ("insert", (A, 1, 5.0, 0)),
+    ("interp", (onp.array([1.5, 2.5]), onp.array([1., 2., 3.]),
+                onp.array([3., 4., 5.]))),
+    ("convolve", (A[0], B[0])), ("correlate", (A[0], B[0])),
+    ("unwrap", (A[0],)), ("take", (A, I4[0], 1)),
+    ("compress", (onp.array([True, False, True]), A, 0)),
+    ("polyval", (onp.array([1., -2., 3.]), A[0],)),
+]
+
+
+@pytest.mark.parametrize("name,arg", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_matches_numpy(name, arg):
+    _cmp(getattr(np, name)(np.array(arg)), getattr(onp, name)(arg),
+         rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,a,b", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_matches_numpy(name, a, b):
+    _cmp(getattr(np, name)(np.array(a), np.array(b)),
+         getattr(onp, name)(a, b), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,kw", REDUCTIONS,
+                         ids=["%s-%s" % (r[0], r[1]) for r in REDUCTIONS])
+def test_reduction_matches_numpy(name, kw):
+    _cmp(getattr(np, name)(np.array(A), **kw), getattr(onp, name)(A, **kw),
+         rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,args", MANIP, ids=[m[0] for m in MANIP])
+def test_manipulation_matches_numpy(name, args):
+    mx_args = [np.array(a) if isinstance(a, onp.ndarray) else a
+               for a in args]
+    _cmp(getattr(np, name)(*mx_args), getattr(onp, name)(*args),
+         rtol=1e-4, atol=1e-5)
+
+
+def test_multioutput_matches_numpy():
+    _cmp(np.divmod(np.array(P), 2.0), onp.divmod(P, 2.0))
+    _cmp(np.modf(np.array(A)), onp.modf(A))
+    _cmp(np.frexp(np.array(P)), onp.frexp(P), rtol=1e-6)
+    h, e = np.histogram(np.array(A.ravel()), bins=5)
+    h2, e2 = onp.histogram(A.ravel(), bins=5)
+    _cmp(h, h2); _cmp(e, e2, rtol=1e-5)
+    _cmp(np.unravel_index(np.array([7], dtype="int32"), (3, 4)),
+         onp.unravel_index(onp.array([7]), (3, 4)))
+    u, inv = np.unique(np.array(I4), return_inverse=True)
+    u2, inv2 = onp.unique(I4, return_inverse=True)
+    _cmp(u, u2); _cmp(inv.reshape(inv2.shape), inv2)
+    _cmp(np.meshgrid(np.array(A[0]), np.array(B[0])),
+         onp.meshgrid(A[0], B[0]))
+
+
+def test_sets_match_numpy():
+    x = onp.array([1, 2, 3, 4], dtype="int32")
+    y = onp.array([3, 4, 5], dtype="int32")
+    for name in ["union1d", "intersect1d", "setdiff1d", "setxor1d"]:
+        _cmp(getattr(np, name)(np.array(x), np.array(y)),
+             getattr(onp, name)(x, y))
+    _cmp(np.isin(np.array(x), np.array(y)), onp.isin(x, y))
+
+
+def test_integer_and_bit_ops_match_numpy():
+    x = onp.array([12, 7, 255], dtype="int32")
+    y = onp.array([10, 3, 15], dtype="int32")
+    for name in ["bitwise_and", "bitwise_or", "bitwise_xor", "gcd",
+                 "lcm", "left_shift"]:
+        _cmp(getattr(np, name)(np.array(x), np.array(y % 4)),
+             getattr(onp, name)(x, y % 4))
+    _cmp(np.invert(np.array(x)), onp.invert(x))
+    _cmp(np.bincount(np.array(y)), onp.bincount(y))
+    _cmp(np.packbits(np.array([1, 0, 1], dtype="uint8")),
+         onp.packbits(onp.array([1, 0, 1], dtype="uint8")))
+
+
+# ---------------------------------------------------------------------------
+# indexing edge semantics (reference: test_numpy_ndarray.py indexing sweeps)
+# ---------------------------------------------------------------------------
+def test_basic_indexing_matches_numpy():
+    x = onp.arange(24.0, dtype="float32").reshape(2, 3, 4)
+    m = np.array(x)
+    for key in [0, -1, (0, 1), (slice(None), 1), (slice(1, None),),
+                (0, slice(None, None, 2)), (Ellipsis, 0),
+                (None, 0), (0, None, 1), (slice(None), slice(None, None, -1)),
+                (1, slice(2, 0, -1), slice(None))]:
+        got = m[key]
+        _cmp(got, x[key])
+
+
+def test_boolean_mask_get_set():
+    x = onp.arange(12.0, dtype="float32").reshape(3, 4)
+    m = np.array(x)
+    mask = m > 5
+    _cmp(m[mask], x[x > 5])
+    m[mask] = -1.0
+    x[x > 5] = -1.0
+    _cmp(m, x)
+    # row-mask
+    rm = np.array(onp.array([True, False, True]))
+    _cmp(m[rm], x[onp.array([True, False, True])])
+
+
+def test_fancy_indexing_get_set():
+    x = onp.arange(12.0, dtype="float32").reshape(3, 4)
+    m = np.array(x)
+    idx = onp.array([2, 0], dtype="int32")
+    _cmp(m[np.array(idx)], x[idx])
+    _cmp(m[np.array(idx), 1], x[idx, 1])
+    _cmp(m[np.array(idx), np.array(idx)], x[idx, idx])
+    # list index
+    _cmp(m[[0, 2]], x[[0, 2]])
+    # set through fancy index
+    m[np.array(idx)] = 9.0
+    x[idx] = 9.0
+    _cmp(m, x)
+    m[np.array([0], dtype="int32"), np.array([3], dtype="int32")] = 7.0
+    x[onp.array([0]), onp.array([3])] = 7.0
+    _cmp(m, x)
+
+
+def test_zero_dim_semantics():
+    s = np.array(3.5)
+    assert s.shape == () and s.ndim == 0
+    assert abs(float(s) - 3.5) < 1e-6
+    assert abs(s.item() - 3.5) < 1e-6
+    with pytest.raises(TypeError):
+        iter(s)
+    r = np.array([1.0, 2.0]).sum()
+    assert r.shape == ()
+    # zero-dim participates in arithmetic
+    _cmp(s + np.array([1.0]), onp.float32(3.5) + onp.array([1.0]))
+
+
+def test_operator_protocols_match_numpy():
+    a = onp.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    b = onp.array([[5.0, 6.0], [7.0, 8.0]], dtype="float32")
+    ma, mb = np.array(a), np.array(b)
+    _cmp(ma @ mb, a @ b)
+    _cmp(ma // mb, a // b)
+    _cmp(ma % mb, a % b)
+    q, r = divmod(ma, mb)
+    q2, r2 = divmod(a, b)
+    _cmp(q, q2); _cmp(r, r2)
+    ia = onp.array([6, 12], dtype="int32")
+    ib = onp.array([3, 10], dtype="int32")
+    mi, mj = np.array(ia), np.array(ib)
+    _cmp(mi & mj, ia & ib)
+    _cmp(mi | mj, ia | ib)
+    _cmp(mi ^ mj, ia ^ ib)
+    _cmp(~mi, ~ia)
+    _cmp(mi << 1, ia << 1)
+    _cmp(mi >> 1, ia >> 1)
+    # comparisons produce bool arrays usable as masks
+    assert (ma > 2).dtype == onp.bool_
+    # in-place
+    c = np.array(a.copy()); c += 1; _cmp(c, a + 1)
+    c = np.array(a.copy()); c //= 2; _cmp(c, a // 2)
+    c = np.array(a.copy()); c **= 2; _cmp(c, a ** 2)
+    # containment
+    assert 3.0 in ma
+    assert 99.0 not in ma
+
+
+def test_numpy_deviation_defaults():
+    """Documented mx.np deviations: python lists default to float32."""
+    assert np.array([1, 2]).dtype == onp.float32
+    # dtype-carrying sources keep their dtype up to TPU-native width:
+    # 64-bit ints/floats narrow to 32 (XLA default; x64 opt-in), the
+    # TPU-first analog of the reference's os-dependent int64 default
+    assert np.array(onp.array([1, 2], dtype="int64")).dtype == onp.int32
+    assert np.array(onp.ones(3, dtype="float64")).dtype == onp.float32
+    assert np.array(onp.array([1, 2], dtype="int16")).dtype == onp.int16
+    assert np.array(onp.ones(3, dtype="float16")).dtype == onp.float16
+
+
+def test_method_surface_matches_numpy():
+    x = onp.random.RandomState(3).rand(4, 5).astype("float32")
+    m = np.array(x)
+    _cmp(m.std(), x.std(), rtol=1e-5)
+    _cmp(m.var(axis=1), x.var(axis=1), rtol=1e-4)
+    _cmp(m.cumsum(axis=0), x.cumsum(axis=0), rtol=1e-5)
+    _cmp(m.argsort(axis=1), x.argsort(axis=1))
+    _cmp(m.diagonal(), x.diagonal())
+    _cmp(m.trace(), x.trace(), rtol=1e-5)
+    _cmp(m.dot(m.T), x.dot(x.T), rtol=1e-4)
+    _cmp(m.ptp(), onp.ptp(x))   # numpy 2.0 removed ndarray.ptp; mx keeps it
+    _cmp(m.round(2), x.round(2), atol=1e-6)
+    _cmp(m.take(np.array([0, 2], dtype="int32"), axis=1),
+         x.take([0, 2], axis=1))
+    nz = m.nonzero(); nz2 = x.nonzero()
+    _cmp(list(nz), list(nz2))
+    assert m.flatten().shape == (20,)       # numpy semantics: full 1-D
+    assert m.ravel().shape == (20,)
+    assert m.itemsize == 4 and m.nbytes == 80
+    assert m.tobytes() == x.tobytes()
+    y = np.array([3.0, 1.0, 2.0])
+    y.sort()                                 # in place, like numpy
+    _cmp(y, onp.array([1.0, 2.0, 3.0]))
+    z = np.zeros((2, 2))
+    z.fill(7.0)
+    _cmp(z, onp.full((2, 2), 7.0))
+    # flat iterates in row-major order
+    assert [round(v.item(), 3) for v in np.array([[1., 2.], [3., 4.]]).flat] \
+        == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_mutating_functions_match_numpy():
+    x = onp.eye(3, dtype="float32")
+    m = np.array(x)
+    np.fill_diagonal(m, 5.0); onp.fill_diagonal(x, 5.0)
+    _cmp(m, x)
+    np.place(m, m == 0.0, 9.0); onp.place(x, x == 0.0, 9.0)
+    _cmp(m, x)
+    np.put(m, onp.array([0]), -1.0); onp.put(x, [0], -1.0)
+    _cmp(m, x)
+    dst = np.zeros((3, 3)); np.copyto(dst, m)
+    _cmp(dst, x)
+
+
+def test_window_and_creation_functions():
+    for name in ["bartlett", "blackman", "hamming", "hanning"]:
+        _cmp(getattr(np, name)(8), getattr(onp, name)(8), rtol=1e-5,
+             atol=1e-6)
+    _cmp(np.kaiser(8, 3.0), onp.kaiser(8, 3.0), rtol=1e-5, atol=1e-5)
+    _cmp(np.geomspace(1.0, 64.0, 7), onp.geomspace(1.0, 64.0, 7),
+         rtol=1e-5)
+    _cmp(np.tri(3), onp.tri(3))
+    _cmp(np.indices((2, 3)), onp.indices((2, 3)))
+    _cmp(np.tril_indices(3), onp.tril_indices(3))
+    _cmp(np.frombuffer(b"\x00\x00\x80?", dtype="float32"),
+         onp.frombuffer(b"\x00\x00\x80?", dtype="float32"))
+    _cmp(np.fromiter(range(4), "float32"), onp.fromiter(range(4),
+                                                        "float32"))
+    _cmp(np.block([[np.ones((2, 2)), np.zeros((2, 2))]]),
+         onp.block([[onp.ones((2, 2)), onp.zeros((2, 2))]]))
+    _cmp(np.c_[np.array([1., 2.]), np.array([3., 4.])],
+         onp.c_[onp.array([1., 2.]), onp.array([3., 4.])])
+    _cmp(np.r_[np.array([1., 2.]), np.array([3., 4.])],
+         onp.r_[onp.array([1., 2.]), onp.array([3., 4.])])
+
+
+def test_logic_and_type_inspection():
+    a = np.array([1.0, 2.0])
+    assert bool(np.allclose(a, a))
+    assert bool(np.array_equal(a, a))
+    assert not bool(np.array_equal(a, a + 1))
+    assert np.isscalar(3.0) and not np.isscalar(a)
+    assert np.result_type(a, onp.float64) == onp.float64
+    assert np.iscomplexobj(np.array(onp.array([1 + 1j]))) is True
+    assert np.isrealobj(a) is True
+    assert np.shape(a) == (2,) and np.ndim(a) == 1 and np.size(a) == 2
+    assert np.shares_memory(a, a[0:1])
+    assert not np.shares_memory(a, a.copy())
+
+
+def test_save_load_roundtrip(tmp_path):
+    x = np.array(onp.random.rand(3, 2).astype("float32"))
+    p = str(tmp_path / "arr.npy")
+    np.save(p, x)
+    y = np.load(p)
+    _cmp(y, x.asnumpy())
+
+
+def test_random_distributions_statistics():
+    np.random.seed(11)
+    n = 20000
+    assert abs(np.random.lognormal(0.0, 0.25, size=(n,)).asnumpy().mean()
+               - onp.exp(0.25 ** 2 / 2)) < 0.05
+    assert abs(np.random.laplace(1.0, 2.0, size=(n,)).asnumpy().mean()
+               - 1.0) < 0.1
+    assert abs(np.random.rayleigh(2.0, size=(n,)).asnumpy().mean()
+               - 2.0 * onp.sqrt(onp.pi / 2)) < 0.1
+    assert abs(np.random.chisquare(4.0, size=(n,)).asnumpy().mean()
+               - 4.0) < 0.15
+    w = np.random.weibull(2.0, size=(n,)).asnumpy()
+    assert abs(w.mean() - 0.8862) < 0.05
+    g = np.random.gumbel(0.0, 1.0, size=(n,)).asnumpy()
+    assert abs(g.mean() - 0.5772) < 0.1
+    b = np.random.bernoulli(0.3, size=(n,)).asnumpy()
+    assert abs(b.mean() - 0.3) < 0.05
+    bi = np.random.binomial(10, 0.5, size=(n // 10,)).asnumpy()
+    assert abs(bi.mean() - 5.0) < 0.3
+    mvn = np.random.multivariate_normal(
+        np.array([1.0, -1.0]),
+        np.array([[1.0, 0.3], [0.3, 1.0]]), size=n // 4)
+    mu = mvn.asnumpy().mean(axis=0)
+    assert abs(mu[0] - 1.0) < 0.1 and abs(mu[1] + 1.0) < 0.1
+    pm = np.random.permutation(10).asnumpy()
+    assert sorted(pm.tolist()) == list(range(10))
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+def test_asarray_does_not_mutate_legacy_array():
+    import mxnet_tpu as mx
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    d = {a: "cached"}                       # legacy arrays are id-hashable
+    view = np.asarray(a)
+    assert type(a) is mx.nd.NDArray         # caller's object untouched
+    assert d[a] == "cached"
+    assert type(view) is np.ndarray
+    # aliasing goes both ways through the view
+    a[0, 0] = 9.0
+    assert abs(view[0, 0].item() - 9.0) < 1e-6
+    view[1, 1] = -1.0
+    assert abs(a[1, 1].asnumpy() - (-1.0)) < 1e-6
+    # legacy flatten semantics survive (batch-preserving 2-D)
+    assert a.flatten().shape == (2, 2)
+    assert view.flatten().shape == (4,)
+
+
+def test_concatenate_positional_axis():
+    a = np.ones((2, 3))
+    _cmp(np.concatenate((a, a), 1), onp.concatenate(
+        (onp.ones((2, 3)),) * 2, 1))
+    _cmp(np.stack((a, a), 1), onp.stack((onp.ones((2, 3)),) * 2, 1))
+
+
+def test_r_c_slice_keys():
+    _cmp(np.r_[0:5], onp.r_[0:5])
+    _cmp(np.r_[0:1:5j], onp.r_[0:1:5j], rtol=1e-6)
+    _cmp(np.r_[np.array([9.0]), 0:3], onp.r_[onp.array([9.0]), 0:3])
+
+
+def test_npx_softmax_respects_length():
+    import mxnet_tpu as mx
+    x = np.array([[1.0, 2.0, 3.0, 4.0]])
+    s = mx.npx.softmax(x, axis=-1, length=np.array([2], dtype="int32"))
+    out = s.asnumpy()[0]
+    assert out[2] == 0.0 and out[3] == 0.0        # masked tail
+    onp.testing.assert_allclose(out[:2].sum(), 1.0, rtol=1e-5)
+
+
+def test_shuffle_choice_reproducible_under_seed():
+    import mxnet_tpu as mx
+    mx.random.seed(42)
+    p1 = np.random.permutation(16).asnumpy()
+    c1 = np.random.choice(10, size=(6,), replace=False).asnumpy()
+    w1 = np.random.choice(5, size=(8,),
+                          p=[0.1, 0.2, 0.3, 0.2, 0.2]).asnumpy()
+    m1 = np.random.multinomial(20, [0.25, 0.25, 0.5]).asnumpy()
+    mx.random.seed(42)
+    onp.testing.assert_array_equal(np.random.permutation(16).asnumpy(), p1)
+    onp.testing.assert_array_equal(
+        np.random.choice(10, size=(6,), replace=False).asnumpy(), c1)
+    onp.testing.assert_array_equal(
+        np.random.choice(5, size=(8,),
+                         p=[0.1, 0.2, 0.3, 0.2, 0.2]).asnumpy(), w1)
+    onp.testing.assert_array_equal(
+        np.random.multinomial(20, [0.25, 0.25, 0.5]).asnumpy(), m1)
+    # statistics: weighted choice follows p; permutation is a permutation
+    draws = np.random.choice(3, size=(6000,), p=[0.6, 0.3, 0.1]).asnumpy()
+    freq = onp.bincount(draws.astype("int64"), minlength=3) / 6000.0
+    onp.testing.assert_allclose(freq, [0.6, 0.3, 0.1], atol=0.04)
+    assert sorted(p1.tolist()) == list(range(16))
+    assert len(set(c1.tolist())) == 6
+    m = np.random.multinomial(50, [0.5, 0.5], size=2).asnumpy()
+    assert m.shape == (2, 2) and (m.sum(axis=1) == 50).all()
