@@ -69,6 +69,13 @@ CONFIGS = {
                              n_heads=32, n_kv_heads=8, hidden_dim=14336,
                              rope_theta=500000.0, max_seq_len=8192,
                              embed_onehot=True),
+    # 8B layer shapes at reduced depth/vocab/context — validates the
+    # SCALE.md v5e-64 program on a host-CPU virtual mesh (every layer
+    # dimension identical to llama3_8b; only depth-like axes shrink).
+    "llama3_8b_dry": LlamaConfig(vocab_size=8192, dim=4096, n_layers=2,
+                                 n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                                 rope_theta=500000.0, max_seq_len=512,
+                                 remat=True, embed_onehot=True),
     # ~110M single-chip benchmark model.
     "llama_110m": LlamaConfig(vocab_size=32000, dim=768, n_layers=12,
                               n_heads=12, n_kv_heads=12, hidden_dim=2048,

@@ -200,7 +200,7 @@ class ndarray(NDArray):
         return self._np1("_np_repeat", repeats=repeats, axis=axis)
 
     def take(self, indices, axis=None, mode="clip"):
-        return self._np1("_np_take", indices, axis=axis)
+        return self._np1("_np_take", indices, axis=axis, mode=mode)
 
     def clip(self, a_min=None, a_max=None):
         return self._np1("_np_clip", a_min, a_max)
@@ -209,13 +209,16 @@ class ndarray(NDArray):
         return self._np1("_np_round", decimals=decimals)
 
     def mean(self, axis=None, dtype=None, keepdims=False):
-        return self._np1("_np_mean", axis=axis, keepdims=keepdims)
+        kw = {} if dtype is None else {"dtype": dtype}
+        return self._np1("_np_mean", axis=axis, keepdims=keepdims, **kw)
 
     def sum(self, axis=None, dtype=None, keepdims=False):
-        return self._np1("_np_sum", axis=axis, keepdims=keepdims)
+        kw = {} if dtype is None else {"dtype": dtype}
+        return self._np1("_np_sum", axis=axis, keepdims=keepdims, **kw)
 
     def prod(self, axis=None, dtype=None, keepdims=False):
-        return self._np1("_np_prod", axis=axis, keepdims=keepdims)
+        kw = {} if dtype is None else {"dtype": dtype}
+        return self._np1("_np_prod", axis=axis, keepdims=keepdims, **kw)
 
     def max(self, axis=None, keepdims=False):
         return self._np1("_np_max", axis=axis, keepdims=keepdims)

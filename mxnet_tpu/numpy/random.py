@@ -110,8 +110,11 @@ def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None):
 
 
 def laplace(loc=0.0, scale=1.0, size=None, ctx=None):
-    from . import sign, log1p, abs as _abs
-    u = uniform(-0.5, 0.5, size=size, ctx=ctx)
+    from . import sign, log1p, abs as _abs, clip
+    # keep |u| strictly below 0.5: a draw of exactly -0.5 would hit
+    # log1p(-1) = -inf
+    u = clip(uniform(-0.5, 0.5, size=size, ctx=ctx), -0.5 + 1e-7,
+             0.5 - 1e-7)
     return loc - scale * sign(u) * log1p(-2.0 * _abs(u))
 
 
@@ -168,17 +171,15 @@ def bernoulli(prob=0.5, size=None, ctx=None):
 
 
 def binomial(n, p, size=None, ctx=None):
-    """Sum of n bernoulli draws (exact; n is expected small in user code —
-    the reference's BinomialSampler also loops the bernoulli kernel)."""
-    count = size if size is not None else ()
-    total = None
-    for _ in range(int(n)):
-        draw = bernoulli(p, size=count, ctx=ctx)
-        total = draw if total is None else total + draw
-    if total is None:
-        from . import zeros
-        return zeros(count, ctx=ctx)
-    return total
+    """Sum of n bernoulli draws — one (…, n) uniform draw and one
+    reduction, not n sequential dispatches."""
+    from . import zeros
+    shape = tuple(size) if size is not None and not _onp.isscalar(size) \
+        else ((int(size),) if size is not None else ())
+    if int(n) == 0:
+        return zeros(shape, ctx=ctx)
+    u = uniform(0.0, 1.0, size=shape + (int(n),), ctx=ctx)
+    return (u < p).astype("float32").sum(axis=-1)
 
 
 def _clip_open(u, eps=1e-7):
@@ -237,25 +238,24 @@ def choice(a, size=None, replace=True, p=None, ctx=None):
 
 
 def multinomial(n, pvals, size=None):
-    """Counts of n inverse-CDF draws per experiment — framework RNG, so
-    seeded runs reproduce (reference: _sample_multinomial)."""
-    from . import array as _np_array, cumsum, searchsorted, bincount, stack
+    """Counts of n inverse-CDF draws per experiment — one vectorized
+    (experiments, n) draw, framework RNG so seeded runs reproduce
+    (reference: _sample_multinomial)."""
+    from . import (array as _np_array, cumsum, searchsorted, arange,
+                   expand_dims)
     pv = _np_array(_onp.asarray(pvals, dtype=_onp.float32))
     k = pv.shape[0]
     cdf = cumsum(pv)
     experiments = int(_onp.prod(size)) if size else 1
-    rows = []
-    for _ in range(experiments):
-        u = uniform(0.0, 1.0, size=(int(n),)) * cdf[-1]
-        idx = searchsorted(cdf, u, side="right")
-        rows.append(bincount(idx.astype("int32"),
-                             minlength=k).astype("float32"))
+    u = uniform(0.0, 1.0, size=(experiments, int(n))) * cdf[-1]
+    idx = searchsorted(cdf, u, side="right")          # (experiments, n)
+    counts = (expand_dims(idx, -1) ==
+              arange(k, dtype="int32")).astype("float32").sum(axis=1)
     if size is None:
-        return _as_np(rows[0])
-    out = stack(rows)
+        return _as_np(counts[0])
     if not _onp.isscalar(size):
-        out = out.reshape(tuple(size) + (k,))
-    return _as_np(out)
+        counts = counts.reshape(tuple(size) + (k,))
+    return _as_np(counts)
 
 
 def shuffle(x):

@@ -63,8 +63,14 @@ def log_softmax(data, axis=-1):
 
 
 def masked_softmax(data, mask, axis=-1):
-    neg = (1.0 - mask.astype(data.dtype)) * -1e18
-    return invoke("softmax", data + neg, axis=axis) * mask.astype(data.dtype)
+    import numpy as _onp
+    m = mask.astype(data.dtype)
+    # finite dtype-aware floor: -1e18 overflows float16 to -inf, and an
+    # all--inf row softmaxes to NaN; half the dtype minimum keeps
+    # fully-masked rows at a uniform finite softmax that the final
+    # mask-multiply zeroes (reference masked_softmax returns 0 there)
+    big = float(_onp.finfo(_onp.dtype(str(data.dtype))).min) / 2
+    return invoke("softmax", data * m + (1.0 - m) * big, axis=axis) * m
 
 
 def relu(data):

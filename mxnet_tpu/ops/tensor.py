@@ -206,8 +206,10 @@ register("mean")(_reduce(jnp.mean))
 register("prod")(_reduce(jnp.prod))
 register("max")(_reduce(jnp.max))
 register("min")(_reduce(jnp.min))
-alias("sum", "sum_axis", "_np_sum")
-alias("mean", "_np_mean")
+alias("sum", "sum_axis")
+# _np_sum/_np_mean are NOT aliased to the legacy reduce ops: the numpy
+# namespace registers them over jnp directly (dtype=, tuple-axis, numpy
+# promotion), see mxnet_tpu/numpy/__init__.py
 alias("max", "max_axis")
 alias("min", "min_axis")
 

@@ -389,14 +389,16 @@ def _pallas_backward_inner(q, k, v, lse, delta, do, causal, sm_scale,
 
 
 def _use_pallas(q, k):
+    # lane-friendly head dim; seq lengths are masked in-kernel so any
+    # Sq/Sk works. GQA requires an integer group (a non-divisible head
+    # count would make the kv BlockSpec silently clamp to a wrong head).
+    D = q.shape[3]
+    shapes_ok = D % 8 == 0 and q.shape[1] % k.shape[1] == 0
     if _interpret():
-        return True
+        return shapes_ok
     if jax.default_backend() not in ("tpu", "axon"):
         return False
-    D = q.shape[3]
-    # lane-friendly head dim; seq lengths are masked in-kernel so any
-    # Sq/Sk works. GQA requires an integer group.
-    return D % 8 == 0 and q.shape[1] % k.shape[1] == 0
+    return shapes_ok
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -440,3 +440,28 @@ def test_shuffle_choice_reproducible_under_seed():
     assert len(set(c1.tolist())) == 6
     m = np.random.multinomial(50, [0.5, 0.5], size=2).asnumpy()
     assert m.shape == (2, 2) and (m.sum(axis=1) == 50).all()
+
+
+def test_review_round2_regressions():
+    import mxnet_tpu as mx
+    # masked_softmax: fully-masked fp16 row -> 0, not NaN
+    d = np.array(onp.random.rand(2, 4).astype("float16"))
+    m = np.array(onp.array([[1, 1, 0, 0], [0, 0, 0, 0]], dtype="float16"))
+    out = mx.npx.masked_softmax(d, m).asnumpy()
+    assert onp.isfinite(out).all()
+    onp.testing.assert_allclose(out[1], 0.0)
+    onp.testing.assert_allclose(out[0, :2].sum(), 1.0, rtol=1e-3)
+    # take(mode="clip") clamps out-of-bounds indices
+    a = np.array([1.0, 2.0, 3.0])
+    got = a.take(np.array([5], dtype="int32")).asnumpy()
+    onp.testing.assert_allclose(got, [3.0])
+    # sum/mean dtype is honored (fp16 overflow avoided)
+    big = np.full((70000,), 1.0, dtype="float16")
+    assert onp.isinf(big.sum().asnumpy())            # fp16 accum overflows
+    assert float(big.sum(dtype="float32").asnumpy()) == 70000.0
+    # vectorized binomial/multinomial still correct + seeded
+    mx.random.seed(9)
+    b = np.random.binomial(100, 0.5, size=(500,)).asnumpy()
+    assert abs(b.mean() - 50.0) < 1.5
+    m2 = np.random.multinomial(30, [0.2, 0.8], size=3).asnumpy()
+    assert m2.shape == (3, 2) and (m2.sum(axis=1) == 30).all()
