@@ -1,8 +1,8 @@
 """contrib namespace. reference: python/mxnet/contrib/ — AMP,
-INT8 quantization, text (vocab/embeddings); onnx remains documented
-out-of-scope (SURVEY.md §2.1)."""
+INT8 quantization, text (vocab/embeddings), ONNX export/import."""
 from . import amp
 from . import quantization
 from . import text
+from . import onnx
 
-__all__ = ["amp", "quantization", "text"]
+__all__ = ["amp", "quantization", "text", "onnx"]

@@ -1,0 +1,186 @@
+"""mx.contrib.onnx export/import roundtrip.
+
+reference: python/mxnet/contrib/onnx/ + tests/python-pytest/onnx/ — a
+model exported to ONNX and re-imported must produce identical outputs.
+The serializer is this build's own wire-format codec (no `onnx` pip
+package in the image), so the roundtrip exercises encoder AND decoder.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.onnx import export_model, import_model
+
+
+def _random_params(sym, input_shapes, seed=0):
+    shapes, _, _ = sym.infer_shape(**input_shapes)
+    rng = onp.random.RandomState(seed)
+    args = {}
+    for name, shp in zip(sym.list_arguments(), shapes):
+        if name in input_shapes:
+            continue
+        args[name] = nd.array((rng.randn(*shp) * 0.1).astype("float32"))
+    return args
+
+
+def _forward(sym, args, aux, data):
+    ex = sym.bind(mx.cpu(), dict(args, data=data), aux_states=aux or None)
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def _roundtrip(sym, input_shape, tmp_path, aux=None, args=None, atol=1e-5):
+    args = args or _random_params(sym, {"data": input_shape})
+    aux = aux or {}
+    path = str(tmp_path / "model.onnx")
+    export_model(sym, dict(args, **aux), {"data": input_shape},
+                 onnx_file_path=path)
+    sym2, args2, aux2 = import_model(path)
+    data = nd.array(onp.random.RandomState(1)
+                    .randn(*input_shape).astype("float32"))
+    out1 = _forward(sym, args, aux, data)
+    out2 = _forward(sym2, args2, aux2, data)
+    onp.testing.assert_allclose(out1, out2, atol=atol, rtol=1e-4)
+    return path
+
+
+def test_cnn_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            name="conv1")
+    b1 = mx.sym.BatchNorm(c1, name="bn1")
+    a1 = mx.sym.Activation(b1, act_type="relu", name="act1")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="pool1")
+    f1 = mx.sym.Flatten(p1, name="flat")
+    fc = mx.sym.FullyConnected(f1, num_hidden=10, name="fc1")
+    out = mx.sym.softmax(fc, name="sm")
+
+    args = _random_params(out, {"data": (2, 3, 8, 8)})
+    aux = {"bn1_moving_mean": nd.zeros((8,)),
+           "bn1_moving_var": nd.ones((8,))}
+    _roundtrip(out, (2, 3, 8, 8), tmp_path, aux=aux, args=args)
+
+
+def test_mlp_elemwise_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    t = mx.sym.Activation(fc1, act_type="tanh", name="t1")
+    s = mx.sym.sigmoid(fc1, name="s1")
+    mixed = mx.sym.broadcast_add(t, s, name="mix")
+    fc2 = mx.sym.FullyConnected(mixed, num_hidden=4, no_bias=True,
+                                name="fc2")
+    out = mx.sym.log_softmax(fc2, name="out")
+    _roundtrip(out, (3, 6), tmp_path)
+
+
+def test_structural_ops_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    r = mx.sym.reshape(data, shape=(2, 12), name="rsh")
+    tr = mx.sym.transpose(r, axes=(1, 0), name="tr")
+    e = mx.sym.expand_dims(tr, axis=0, name="ex")
+    sq = mx.sym.squeeze(e, axis=0, name="sq")
+    cat = mx.sym.concat(sq, sq, dim=1, name="cat")
+    _roundtrip(cat, (4, 6), tmp_path, args={})
+
+
+def test_global_pool_and_leaky(tmp_path):
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(1, 1), num_filter=4, name="c")
+    l = mx.sym.LeakyReLU(c, act_type="leaky", slope=0.1, name="lk")
+    g = mx.sym.Pooling(l, kernel=(1, 1), pool_type="avg", global_pool=True,
+                       name="gp")
+    _roundtrip(g, (2, 3, 5, 5), tmp_path)
+
+
+def test_proto_encode_decode_fidelity():
+    """The wire codec roundtrips every field kind it claims to support."""
+    from mxnet_tpu.contrib.onnx import proto as P
+    t = P.TensorProto(name="w", dims=[2, 3], data_type=P.DT.FLOAT,
+                      raw_data=onp.arange(6, dtype="float32").tobytes())
+    att = P.AttributeProto(name="ints", type=P.AT.INTS, ints=[1, -2, 300])
+    node = P.NodeProto(op_type="Conv", name="n", input=["a", "b"],
+                       output=["y"], attribute=[att])
+    g = P.GraphProto(name="g", node=[node], initializer=[t])
+    m = P.ModelProto(ir_version=8, producer_name="mxnet-tpu", graph=g,
+                     opset_import=[P.OperatorSetIdProto(domain="",
+                                                        version=13)])
+    m2 = P.ModelProto.decode(m.encode())
+    assert m2.ir_version == 8 and m2.producer_name == "mxnet-tpu"
+    assert m2.opset_import[0].version == 13
+    n2 = m2.graph.node[0]
+    assert n2.op_type == "Conv" and n2.input == ["a", "b"]
+    assert n2.attribute[0].ints == [1, -2, 300]
+    t2 = m2.graph.initializer[0]
+    assert t2.dims == [2, 3]
+    onp.testing.assert_array_equal(
+        onp.frombuffer(t2.raw_data, dtype="float32"),
+        onp.arange(6, dtype="float32"))
+
+
+def test_unsupported_op_raises(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.Correlation(data, data, name="corr") if hasattr(
+        mx.sym, "Correlation") else None
+    if out is None:
+        pytest.skip("no unsupported op available")
+    with pytest.raises(NotImplementedError):
+        export_model(out, {}, {"data": (1, 2, 4, 4)},
+                     onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_export_input_shape_forms(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    args = _random_params(out, {"data": (2, 5)})
+    # reference API form: list of shape tuples, zipped with data inputs
+    p = export_model(out, args, [(2, 5)],
+                     onnx_file_path=str(tmp_path / "a.onnx"))
+    sym2, a2, x2 = import_model(p)
+    d = nd.array(onp.random.RandomState(2).randn(2, 5).astype("float32"))
+    onp.testing.assert_allclose(_forward(out, args, {}, d),
+                                _forward(sym2, a2, x2, d), atol=1e-5)
+
+
+def test_gemm_general_form_imports(tmp_path):
+    """A stock-exporter-style Gemm (transB=0, alpha/beta != 1) must
+    compute alpha*A@B + beta*C, not the FullyConnected layout."""
+    from mxnet_tpu.contrib.onnx import proto as P
+    rng = onp.random.RandomState(3)
+    A = rng.randn(2, 4).astype("float32")
+    B = rng.randn(4, 3).astype("float32")
+    C = rng.randn(3).astype("float32")
+    g = P.GraphProto(
+        name="g",
+        node=[P.NodeProto(op_type="Gemm", name="gm", input=["A", "B", "C"],
+                          output=["Y"],
+                          attribute=[
+                              P.AttributeProto(name="alpha", type=P.AT.FLOAT,
+                                               f=2.0),
+                              P.AttributeProto(name="beta", type=P.AT.FLOAT,
+                                               f=0.5),
+                              P.AttributeProto(name="transB", type=P.AT.INT,
+                                               i=0)])],
+        initializer=[],
+        input=[P.ValueInfoProto(name=n) for n in ("A", "B", "C")],
+        output=[P.ValueInfoProto(name="Y")])
+    m = P.ModelProto(ir_version=8, graph=g,
+                     opset_import=[P.OperatorSetIdProto(domain="",
+                                                        version=13)])
+    path = str(tmp_path / "gemm.onnx")
+    open(path, "wb").write(m.encode())
+    sym, _, _ = import_model(path)
+    ex = sym.bind(mx.cpu(), {"A": nd.array(A), "B": nd.array(B),
+                             "C": nd.array(C)})
+    got = ex.forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, 2.0 * (A @ B) + 0.5 * C, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_transpose_dot_export_refused(tmp_path):
+    a = mx.sym.Variable("a")
+    bsym = mx.sym.Variable("b")
+    out = mx.sym.dot(a, bsym, transpose_b=True, name="d")
+    with pytest.raises(NotImplementedError):
+        export_model(out, {}, {"a": (2, 3), "b": (4, 3)},
+                     onnx_file_path=str(tmp_path / "x.onnx"))
