@@ -96,10 +96,12 @@ class _Builder:
 
 
 def _tuple2(v, default):
+    """Normalize an mx stride/pad/dilate attr to len(default) entries
+    (scalar attrs broadcast to the kernel rank, not to 2)."""
     if v is None:
         return default
     if isinstance(v, int):
-        return (v, v)
+        return (v,) * len(default)
     return tuple(v)
 
 
@@ -121,11 +123,18 @@ def _conv(b, name, ins, a):
 @mx_op("Deconvolution")
 def _deconv(b, name, ins, a):
     kernel = tuple(a["kernel"])
+    if a.get("target_shape"):
+        raise NotImplementedError(
+            "ONNX export: Deconvolution target_shape is not supported")
     stride = _tuple2(a.get("stride"), (1,) * len(kernel))
     pad = _tuple2(a.get("pad"), (0,) * len(kernel))
+    dilate = _tuple2(a.get("dilate"), (1,) * len(kernel))
+    adj = _tuple2(a.get("adj"), (0,) * len(kernel))
     attrs = [_attr_ints("kernel_shape", kernel),
              _attr_ints("strides", stride),
              _attr_ints("pads", list(pad) * 2),
+             _attr_ints("dilations", dilate),
+             _attr_ints("output_padding", adj),
              _attr_i("group", a.get("num_group", 1))]
     return b.add("ConvTranspose", ins, name, attrs=attrs)
 
@@ -184,7 +193,23 @@ _ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
 
 @mx_op("Activation")
 def _act(b, name, ins, a):
-    return b.add(_ACT[a.get("act_type", "relu")], ins, name)
+    t = a.get("act_type", "relu")
+    if t == "gelu":
+        # exact-erf gelu decomposition: x * 0.5 * (1 + erf(x/sqrt(2)))
+        scaled = b.add("Mul", [ins[0], b.const(b.tmp(name + "_c"),
+                                               _onp.float32(0.7071067811865476))],
+                       b.tmp(name + "_sc"))
+        erf = b.add("Erf", [scaled], b.tmp(name + "_erf"))
+        one = b.const(b.tmp(name + "_one"), _onp.float32(1.0))
+        half = b.const(b.tmp(name + "_half"), _onp.float32(0.5))
+        g = b.add("Add", [erf, one], b.tmp(name + "_p1"))
+        g = b.add("Mul", [g, half], b.tmp(name + "_h"))
+        return b.add("Mul", [ins[0], g], name)
+    if t not in _ACT:
+        raise NotImplementedError(
+            "ONNX export: Activation act_type %r (supported: %s, gelu)"
+            % (t, ", ".join(sorted(_ACT))))
+    return b.add(_ACT[t], ins, name)
 
 
 @mx_op("relu")
@@ -221,9 +246,15 @@ def _sqrt(b, name, ins, a):
 def _leaky(b, name, ins, a):
     t = a.get("act_type", "leaky")
     if t == "elu":
-        return b.add("Elu", ins, name,
+        return b.add("Elu", ins[:1], name,
                      attrs=[_attr_f("alpha", a.get("slope", 0.25))])
-    return b.add("LeakyRelu", ins, name,
+    if t == "prelu":
+        return b.add("PRelu", ins[:2], name)
+    if t != "leaky":
+        raise NotImplementedError(
+            "ONNX export: LeakyReLU act_type %r (supported: leaky, elu, "
+            "prelu)" % t)
+    return b.add("LeakyRelu", ins[:1], name,
                  attrs=[_attr_f("alpha", a.get("slope", 0.25))])
 
 

@@ -14,11 +14,14 @@ from . import proto as P
 
 __all__ = ["import_model"]
 
+import ml_dtypes as _ml_dtypes
+
 _NP_DTYPE = {
     P.DT.FLOAT: _onp.float32, P.DT.DOUBLE: _onp.float64,
     P.DT.FLOAT16: _onp.float16, P.DT.INT32: _onp.int32,
     P.DT.INT64: _onp.int64, P.DT.INT8: _onp.int8, P.DT.UINT8: _onp.uint8,
     P.DT.BOOL: _onp.bool_,
+    P.DT.BFLOAT16: _ml_dtypes.bfloat16,   # the flagship TPU dtype
 }
 
 
@@ -83,6 +86,14 @@ def import_model(onnx_file):
     for vi in g.input:
         if vi.name not in consts:
             as_sym(vi.name)
+
+    # consumers per value name: int Casts may only collapse to identity
+    # when they feed Gather exclusively (mx.take accepts float indices);
+    # a general int cast carries truncation semantics
+    consumer_ops = {}
+    for node_ in g.node:
+        for x in node_.input:
+            consumer_ops.setdefault(x, []).append(node_.op_type)
 
     def sym_pads(a, k):
         """ONNX pads = [begin..., end...]; the symmetric form maps to the
@@ -188,6 +199,11 @@ def import_model(onnx_file):
         elif op == "Elu":
             out = mx.sym.LeakyReLU(as_sym(ins[0]), act_type="elu",
                                    slope=a.get("alpha", 1.0), name=name)
+        elif op == "Erf":
+            out = mx.sym.erf(as_sym(ins[0]), name=name)
+        elif op == "PRelu":
+            out = mx.sym.LeakyReLU(as_sym(ins[0]), as_sym(ins[1]),
+                                   act_type="prelu", name=name)
         elif op == "Exp":
             out = mx.sym.exp(as_sym(ins[0]), name=name)
         elif op == "Log":
@@ -217,7 +233,25 @@ def import_model(onnx_file):
             axes = (tuple(a["axes"]) if "axes" in a
                     else tuple(int(x) for x in consts[ins[1]]))
             out = as_sym(ins[0])
-            for k, ax in enumerate(sorted(int(x) for x in axes)):
+            # ONNX axes are relative to the OUTPUT rank (negatives legal);
+            # resolving them needs the input rank
+            out_rank = None
+            try:
+                shp, _, _ = out.infer_shape()
+                out_rank = len(shp[0]) + len(axes) if shp else None
+            except Exception:
+                pass
+            norm = []
+            for ax in axes:
+                ax = int(ax)
+                if ax < 0:
+                    if out_rank is None:
+                        raise NotImplementedError(
+                            "ONNX import: negative Unsqueeze axes need "
+                            "inferable input shape")
+                    ax += out_rank
+                norm.append(ax)
+            for k, ax in enumerate(sorted(norm)):
                 out = mx.sym.expand_dims(out, axis=ax,
                                          name="%s_%d" % (name, k))
         elif op == "Squeeze":
@@ -242,10 +276,18 @@ def import_model(onnx_file):
                               axis=a.get("axis", 0), name=name)
         elif op == "Cast":
             to = a.get("to", P.DT.FLOAT)
-            if to in (P.DT.INT64, P.DT.INT32):
-                # index casts (the Gather pattern): mx.take accepts float
-                # indices, so the cast collapses
+            feeds = [c for o in node.output
+                     for c in consumer_ops.get(o, [])]
+            if to in (P.DT.INT64, P.DT.INT32) and feeds and \
+                    all(c == "Gather" for c in feeds):
+                # pure index cast (the Gather pattern): mx.take accepts
+                # float indices, so the cast collapses
                 out = as_sym(ins[0])
+            elif to in (P.DT.INT64, P.DT.INT32):
+                out = mx.sym.Cast(as_sym(ins[0]),
+                                  dtype={P.DT.INT64: "int64",
+                                         P.DT.INT32: "int32"}[to],
+                                  name=name)
             else:
                 dt = {P.DT.FLOAT: "float32", P.DT.FLOAT16: "float16",
                       P.DT.DOUBLE: "float64", P.DT.BFLOAT16: "bfloat16",

@@ -184,3 +184,38 @@ def test_transpose_dot_export_refused(tmp_path):
     with pytest.raises(NotImplementedError):
         export_model(out, {}, {"a": (2, 3), "b": (4, 3)},
                      onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_bf16_initializer_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, no_bias=True,
+                                name="fcb")
+    w = nd.array(onp.random.RandomState(5).randn(3, 4).astype("float32"))
+    wb = w.astype("bfloat16")
+    p = export_model(out, {"fcb_weight": wb}, {"data": (2, 4)},
+                     onnx_file_path=str(tmp_path / "b.onnx"))
+    sym2, a2, _ = import_model(p)
+    assert str(a2["fcb_weight"].dtype) == "bfloat16"
+    onp.testing.assert_allclose(
+        a2["fcb_weight"].astype("float32").asnumpy(),
+        wb.astype("float32").asnumpy())
+
+
+def test_unsupported_activation_refused(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.LeakyReLU(data, act_type="selu", name="s")
+    with pytest.raises(NotImplementedError):
+        export_model(out, {}, {"data": (2, 4)},
+                     onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_gelu_exports_as_erf_decomposition(tmp_path):
+    data = mx.sym.Variable("data")
+    out = mx.sym.Activation(data, act_type="gelu", name="g")
+    p = export_model(out, {}, {"data": (2, 4)},
+                     onnx_file_path=str(tmp_path / "g.onnx"))
+    sym2, a2, x2 = import_model(p)
+    d = nd.array(onp.random.RandomState(6).randn(2, 4).astype("float32"))
+    o1 = out.bind(mx.cpu(), {"data": d}).forward()[0].asnumpy()
+    o2 = sym2.bind(mx.cpu(), dict(a2, data=d)).forward()[0].asnumpy()
+    onp.testing.assert_allclose(o1, o2, atol=1e-5, rtol=1e-4)

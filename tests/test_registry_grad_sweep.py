@@ -1,0 +1,608 @@
+"""Registry-wide numeric-gradient sweep (round-4 VERDICT task #5).
+
+reference: tests/python/unittest/test_operator.py sweeps every operator
+with check_numeric_gradient. Here the sweep is AUTOMATED over the op
+registry: every differentiable, non-creation, non-random op object is
+checked — autograd's directional derivative against a central finite
+difference through the imperative `invoke` path (so the tape, not just
+the jax fn, is exercised). Ops whose inputs can't be auto-generated get
+a spec; ops that are legitimately unswee pable get a skip-list entry
+with a reason. A final accounting test enforces >=80% checked coverage
+so the sweep can't silently rot.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.ndarray.ndarray import invoke
+from mxnet_tpu.ops import registry
+
+RNG = onp.random.RandomState(11)
+SHAPE = (3, 4)
+
+
+def _pos(shape=SHAPE, lo=0.6, hi=1.4):
+    return RNG.uniform(lo, hi, size=shape).astype("float32")
+
+
+def _sym_pd(shape=(4, 4)):
+    a = RNG.randn(*shape).astype("float32")
+    return (a @ a.T + shape[0] * onp.eye(shape[0])).astype("float32")
+
+
+def _tri(shape=(4, 4)):
+    return (onp.tril(RNG.rand(*shape)) + 2 * onp.eye(shape[0])).astype(
+        "float32")
+
+
+# ---------------------------------------------------------------------------
+# specs: name -> dict(inputs=[np arrays], kwargs={}, tol=, eps=)
+# only for ops the auto-generator can't feed (required kwargs, structured
+# inputs, integer operands)
+# ---------------------------------------------------------------------------
+SPECS = {
+    "Activation": dict(inputs=[_pos()], kwargs={"act_type": "tanh"}),
+    "BatchNorm": dict(
+        inputs=[_pos((2, 3, 4, 4)), _pos((3,)), _pos((3,)),
+                onp.zeros(3, "float32"), onp.ones(3, "float32")],
+        kwargs={}, n_diff=3),
+    "Cast": dict(inputs=[_pos()], kwargs={"dtype": "float32"}),
+    "Concat": dict(inputs=[_pos(), _pos()], kwargs={"dim": 1}),
+    "Convolution": dict(
+        inputs=[_pos((1, 2, 5, 5)), _pos((3, 2, 3, 3)), _pos((3,))],
+        kwargs={"kernel": (3, 3), "num_filter": 3}),
+    "Deconvolution": dict(
+        inputs=[_pos((1, 3, 4, 4)), _pos((3, 2, 3, 3)), _pos((2,))],
+        kwargs={"kernel": (3, 3), "num_filter": 2}),
+    "Correlation": dict(
+        inputs=[_pos((1, 2, 6, 6)), _pos((1, 2, 6, 6))],
+        kwargs={"kernel_size": 1, "max_displacement": 2, "stride1": 1,
+                "stride2": 1}),
+    "Crop": dict(inputs=[_pos((1, 2, 6, 6))],
+                 kwargs={"h_w": (4, 4), "center_crop": True},
+                 skip_fd_kwargs=True),
+    "Embedding": dict(
+        inputs=[onp.array([[0., 2.], [1., 3.]], "float32"),
+                _pos((4, 5))],
+        kwargs={"input_dim": 4, "output_dim": 5}, n_diff=(1,)),
+    "FullyConnected": dict(
+        inputs=[_pos((2, 5)), _pos((3, 5)), _pos((3,))],
+        kwargs={"num_hidden": 3}),
+    "GridGenerator": dict(
+        inputs=[_pos((1, 6))], kwargs={"transform_type": "affine",
+                                       "target_shape": (4, 4)}),
+    "BilinearSampler": dict(
+        inputs=[_pos((1, 2, 5, 5)),
+                RNG.uniform(-0.7, 0.7, (1, 2, 4, 4)).astype("float32")],
+        kwargs={}),
+    "SpatialTransformer": dict(
+        inputs=[_pos((1, 2, 5, 5)), _pos((1, 6), lo=-0.2, hi=0.2)],
+        kwargs={"transform_type": "affine", "sampler_type": "bilinear",
+                "target_shape": (4, 4)}),
+    "GroupNorm": dict(inputs=[_pos((2, 4, 3, 3)), _pos((4,)), _pos((4,))],
+                      kwargs={"num_groups": 2}),
+    "InstanceNorm": dict(inputs=[_pos((2, 3, 4)), _pos((3,)), _pos((3,))],
+                         kwargs={}),
+    "LayerNorm": dict(inputs=[_pos((3, 6)), _pos((6,)), _pos((6,))],
+                      kwargs={}),
+    "RMSNorm": dict(inputs=[_pos((3, 6)), _pos((6,))], kwargs={}),
+    "L2Normalization": dict(inputs=[_pos()], kwargs={}),
+    "LRN": dict(inputs=[_pos((1, 4, 3, 3))], kwargs={"nsize": 3}),
+    "LeakyReLU": dict(inputs=[_pos()], kwargs={"act_type": "leaky"}),
+    "LinearRegressionOutput": dict(inputs=[_pos(), _pos()], kwargs={},
+                                   n_diff=(0,)),
+    "LogisticRegressionOutput": dict(inputs=[_pos(), _pos()], kwargs={},
+                                     n_diff=(0,)),
+    "MAERegressionOutput": dict(
+        inputs=[_pos(lo=1.5, hi=2.5), _pos(lo=0.2, hi=0.9)], kwargs={},
+        n_diff=(0,)),
+
+    "Pad": dict(inputs=[_pos((1, 2, 3, 3))],
+                kwargs={"mode": "constant",
+                        "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+    "Pooling": dict(inputs=[_pos((1, 2, 4, 4))],
+                    kwargs={"kernel": (2, 2), "pool_type": "avg",
+                            "stride": (2, 2)}),
+    "ROIPooling": dict(
+        inputs=[_pos((1, 2, 8, 8)),
+                onp.array([[0, 0, 0, 6, 6]], "float32")],
+        kwargs={"pooled_size": (2, 2), "spatial_scale": 1.0}, n_diff=(0,)),
+    "_contrib_ROIAlign": dict(
+        inputs=[_pos((1, 2, 8, 8)),
+                onp.array([[0, 0.5, 0.5, 6.0, 6.0]], "float32")],
+        kwargs={"pooled_size": (2, 2), "spatial_scale": 1.0}, n_diff=(0,)),
+    "_contrib_PSROIPooling": dict(
+        inputs=[_pos((1, 8, 6, 6)),
+                onp.array([[0, 0, 0, 5, 5]], "float32")],
+        kwargs={"output_dim": 2, "pooled_size": 2, "spatial_scale": 1.0},
+        n_diff=(0,)),
+    "_contrib_DeformableConvolution": dict(
+        inputs=[_pos((1, 2, 5, 5)), _pos((1, 18, 5, 5), lo=0.25, hi=0.4),
+                _pos((3, 2, 3, 3)), _pos((3,))],
+        kwargs={"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)},
+        tol=0.08),
+    "_contrib_AdaptiveAvgPooling2D": dict(
+        inputs=[_pos((1, 2, 6, 6))], kwargs={"output_size": 3}),
+    "_contrib_BilinearResize2D": dict(
+        inputs=[_pos((1, 2, 4, 4))], kwargs={"height": 6, "width": 6}),
+    "SequenceLast": dict(inputs=[_pos((4, 2, 3))], kwargs={}),
+    "SequenceMask": dict(inputs=[_pos((4, 2, 3))], kwargs={}),
+    "SequenceReverse": dict(inputs=[_pos((4, 2, 3))], kwargs={}),
+    "SliceChannel": dict(inputs=[_pos((2, 4))],
+                         kwargs={"num_outputs": 2, "axis": 1}),
+    "_split_v2": dict(inputs=[_pos((2, 4))],
+                      kwargs={"indices_or_sections": 2, "axis": 1}),
+
+    "SoftmaxActivation": dict(inputs=[_pos()], kwargs={}),
+    "softmax_cross_entropy": dict(
+        inputs=[_pos((3, 5)), onp.array([0., 2., 4.], "float32")],
+        kwargs={}, n_diff=(0,)),
+    "SwapAxis": dict(inputs=[_pos()], kwargs={"dim1": 0, "dim2": 1}),
+    "UpSampling": dict(inputs=[_pos((1, 2, 3, 3))],
+                       kwargs={"scale": 2, "sample_type": "nearest"}),
+    "Reshape": dict(inputs=[_pos()], kwargs={"shape": (4, 3)}),
+    "broadcast_axes": dict(inputs=[_pos((1, 4))],
+                           kwargs={"axis": 0, "size": 3}),
+    "broadcast_to": dict(inputs=[_pos((1, 4))], kwargs={"shape": (3, 4)}),
+    "broadcast_like": dict(inputs=[_pos((1, 4)), _pos((3, 4))],
+                           kwargs={}, n_diff=(0,)),
+    "batch_take": dict(inputs=[_pos((3, 4)),
+                               onp.array([0., 2., 1.], "float32")],
+                       kwargs={}, n_diff=(0,)),
+    "take": dict(inputs=[_pos((4, 3)), onp.array([0., 2.], "float32")],
+                 kwargs={}, n_diff=(0,)),
+    "take_along_axis": dict(
+        inputs=[_pos((3, 4)), onp.zeros((3, 1), "float32")],
+        kwargs={"axis": 1}, n_diff=(0,)),
+    "pick": dict(inputs=[_pos((3, 4)), onp.array([0., 1., 3.], "float32")],
+                 kwargs={}, n_diff=(0,)),
+    "gather_nd": dict(
+        inputs=[_pos((4, 3)), onp.array([[0, 2], [1, 0]], "float32").T],
+        kwargs={}, n_diff=(0,)),
+    "scatter_nd": dict(
+        inputs=[_pos((2,)), onp.array([[0, 2]], "float32")],
+        kwargs={"shape": (4,)}, n_diff=(0,)),
+    "one_hot": dict(inputs=[onp.array([0., 2.], "float32")],
+                    kwargs={"depth": 4}, n_diff=()),
+    "where": dict(
+        inputs=[onp.array([[1., 0., 1., 0.]] * 3, "float32"),
+                _pos(), _pos()],
+        kwargs={}, n_diff=(1, 2)),
+    "slice": dict(inputs=[_pos()], kwargs={"begin": (0, 1), "end": (2, 3)}),
+    "slice_axis": dict(inputs=[_pos()],
+                       kwargs={"axis": 1, "begin": 0, "end": 2}),
+    "slice_like": dict(inputs=[_pos((3, 4)), _pos((2, 2))], kwargs={},
+                       n_diff=(0,)),
+    "diag": dict(inputs=[_pos((4, 4))], kwargs={}),
+    "repeat": dict(inputs=[_pos()], kwargs={"repeats": 2}),
+    "tile": dict(inputs=[_pos()], kwargs={"reps": (2, 1)}),
+    "flip": dict(inputs=[_pos()], kwargs={"axis": 1}),
+    "expand_dims": dict(inputs=[_pos()], kwargs={"axis": 0}),
+    "stack": dict(inputs=[_pos(), _pos()], kwargs={"axis": 0}),
+    "clip": dict(inputs=[_pos()], kwargs={"a_min": 0.0, "a_max": 10.0}),
+    "moveaxis": dict(inputs=[_pos()], kwargs={"source": 0,
+                                              "destination": 1}),
+    "depth_to_space": dict(inputs=[_pos((1, 4, 2, 2))],
+                           kwargs={"block_size": 2}),
+    "space_to_depth": dict(inputs=[_pos((1, 1, 4, 4))],
+                           kwargs={"block_size": 2}),
+    "reshape_like": dict(inputs=[_pos((3, 4)), _pos((4, 3))], kwargs={},
+                         n_diff=(0,)),
+    "smooth_l1": dict(inputs=[_pos()], kwargs={"scalar": 1.0}),
+    "sort": dict(inputs=[_pos()], kwargs={}),
+    "max": dict(inputs=[_pos()], kwargs={"axis": 1}),
+    "min": dict(inputs=[_pos()], kwargs={"axis": 1}),
+    "norm": dict(inputs=[_pos()], kwargs={"ord": 2}),
+    "logsumexp": dict(inputs=[_pos()], kwargs={}),
+    "moments": dict(inputs=[_pos()], kwargs={"axes": (0,)}),
+    "khatri_rao": dict(inputs=[_pos((2, 3)), _pos((4, 3))], kwargs={}),
+    "dot_scaled": dict(inputs=[_pos((3, 4)), _pos((4, 2))],
+                       kwargs={"scale": 0.5}),
+    "_contrib_div_sqrt_dim": dict(inputs=[_pos()], kwargs={}),
+    "_contrib_interleaved_matmul_selfatt_qk": dict(
+        inputs=[_pos((4, 2, 3 * 8))], kwargs={"heads": 2}),
+    "_contrib_interleaved_matmul_selfatt_valatt": dict(
+        inputs=[_pos((4, 2, 3 * 8)), _pos((4, 4, 4))],
+        kwargs={"heads": 2}),
+    "linalg_potrf": dict(inputs=[_sym_pd()], kwargs={}),
+    "linalg_potri": dict(inputs=[_tri()], kwargs={}),
+    "linalg_trmm": dict(inputs=[_tri(), _pos((4, 4))], kwargs={}),
+    "linalg_trsm": dict(inputs=[_tri(), _pos((4, 4))], kwargs={},
+                        tol=0.08),
+    "linalg_gemm": dict(
+        inputs=[_pos((3, 4)), _pos((4, 2)), _pos((3, 2))], kwargs={}),
+    "linalg_gemm2": dict(inputs=[_pos((3, 4)), _pos((4, 2))], kwargs={}),
+    "linalg_det": dict(inputs=[_sym_pd()], kwargs={}),
+    "linalg_slogdet": dict(inputs=[_sym_pd()], kwargs={}),
+    "linalg_sumlogdiag": dict(inputs=[_sym_pd()], kwargs={}),
+    "linalg_extractdiag": dict(inputs=[_pos((4, 4))], kwargs={}),
+    "linalg_extracttrian": dict(inputs=[_pos((4, 4))], kwargs={}),
+    "linalg_makediag": dict(inputs=[_pos((4,))], kwargs={}),
+    "linalg_maketrian": dict(inputs=[_pos((10,))], kwargs={}),
+    "linalg_inverse": dict(inputs=[_sym_pd()], kwargs={}),
+    "linalg_syrk": dict(inputs=[_pos((3, 4))], kwargs={}),
+    "linalg_gelqf": dict(inputs=[_pos((2, 4))], kwargs={}, tol=0.1),
+    "_sparse_dot_csr_dense": None,   # handled by test_sparse.py (stype)
+    "IdentityAttachKLSparseReg": dict(inputs=[_pos(lo=0.1, hi=0.9)],
+                                      kwargs={}),
+    "MakeLoss": dict(inputs=[_pos()], kwargs={}),
+    "make_loss": dict(inputs=[_pos()], kwargs={}),
+    "Flatten": dict(inputs=[_pos((2, 3, 2))], kwargs={}),
+    "ElementWiseSum": dict(inputs=[_pos(), _pos(), _pos()], kwargs={}),
+    "dot": dict(inputs=[_pos((3, 4)), _pos((4, 2))], kwargs={}),
+    "batch_dot": dict(inputs=[_pos((2, 3, 4)), _pos((2, 4, 2))],
+                      kwargs={}),
+    "cumsum": dict(inputs=[_pos()], kwargs={"axis": 1}),
+    "_power": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "arccosh": dict(inputs=[_pos(lo=1.5, hi=3.0)], kwargs={}),
+    "arctanh": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "arccos": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "arcsin": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "erfinv": dict(inputs=[_pos(lo=0.1, hi=0.7)], kwargs={}),
+    "gamma": dict(inputs=[_pos(lo=1.5, hi=3.0)], kwargs={}),
+    "gammaln": dict(inputs=[_pos(lo=1.5, hi=3.0)], kwargs={}),
+    "rcbrt": dict(inputs=[_pos(lo=0.5, hi=2.0)], kwargs={}),
+    "BlockGrad": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "BlockGrad_inner": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "zeros_like": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "ones_like": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "logical_not": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "sign": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+}
+
+# ops that cannot be swept here, with the reason (reference: the skip
+# decorators scattered through test_operator.py)
+SKIP = {
+    "_sparse_dot_csr_dense": "needs CSR-stype inputs; covered by "
+                             "tests/test_sparse.py end-to-end",
+    "SVMOutput": "identity forward, hinge-loss TRAINING backward "
+                 "(reference: svm_output.cc) — numeric FD of the forward "
+                 "is identity by design; covered by tests/test_models.py",
+    "Softmax": "SoftmaxOutput semantics: backward is the TRAINING "
+               "gradient (p - onehot), deliberately not the forward vjp "
+               "(reference: softmax_output.cc); covered by "
+               "tests/test_symbol_module.py",
+    "_np_linalg_qr": "jax QR derivative unimplemented for wide "
+                     "matrices; square case covered in "
+                     "tests/test_numpy_ns.py::test_np_linalg_multioutput",
+}
+
+# np-namespace ops that need structured inputs or are only piecewise
+# differentiable at auto-generated points
+_WELL_SEP = onp.arange(1.0, 13.0, dtype="float32").reshape(3, 4)
+
+NP_SPECS = {
+    "_np_arccos": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "_np_arccosh": dict(inputs=[_pos(lo=1.5, hi=3.0)], kwargs={}),
+    "_np_arcsin": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "_np_arctanh": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "_np_acos": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "_np_acosh": dict(inputs=[_pos(lo=1.5, hi=3.0)], kwargs={}),
+    "_np_asin": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "_np_atanh": dict(inputs=[_pos(lo=-0.8, hi=-0.2)], kwargs={}),
+    "_np_broadcast_to": dict(inputs=[_pos((1, 4))],
+                             kwargs={"shape": (3, 4)}),
+    "_np_clip": dict(inputs=[_pos(), 0.0, 10.0], kwargs={},
+                     n_diff=(0,)),
+    "_np_compress": dict(
+        inputs=[onp.array([True, False, True]), _pos((3, 4))],
+        kwargs={"axis": 0}, n_diff=(1,)),
+    "_np_choose": dict(
+        inputs=[onp.array([0, 1], "int32"), _pos((2, 2))], kwargs={},
+        n_diff=(1,)),
+    "_np_cross": dict(inputs=[_pos((3,)), _pos((3,))], kwargs={}),
+    "_np_diag": dict(inputs=[_pos((4,))], kwargs={}),
+    "_np_diagflat": dict(inputs=[_pos((4,))], kwargs={}),
+    "_np_diagonal": dict(inputs=[_pos((4, 4))], kwargs={}),
+    "_np_dot": dict(inputs=[_pos((3, 4)), _pos((4, 2))], kwargs={}),
+    "_np_matmul": dict(inputs=[_pos((3, 4)), _pos((4, 2))], kwargs={}),
+    "_np_vdot": dict(inputs=[_pos((4,)), _pos((4,))], kwargs={}),
+    "_np_vecdot": dict(inputs=[_pos((4,)), _pos((4,))], kwargs={}),
+    "_np_inner": dict(inputs=[_pos((4,)), _pos((4,))], kwargs={}),
+    "_np_outer": dict(inputs=[_pos((3,)), _pos((4,))], kwargs={}),
+    "_np_kron": dict(inputs=[_pos((2, 2)), _pos((2, 2))], kwargs={}),
+    "_np_trace": dict(inputs=[_pos((4, 4))], kwargs={}),
+    "_np_tensordot": dict(inputs=[_pos((3, 4)), _pos((4, 2))],
+                          kwargs={"axes": 1}),
+    "_np_einsum": dict(inputs=["ij,jk->ik", _pos((3, 4)), _pos((4, 2))],
+                       kwargs={}, n_diff=(1, 2)),
+    "_np_expand_dims": dict(inputs=[_pos()], kwargs={"axis": 0}),
+    "_np_flip": dict(inputs=[_pos()], kwargs={"axis": 1}),
+    "_np_take": dict(inputs=[_pos((4, 3)), onp.array([0, 2], "int32")],
+                     kwargs={"axis": 0}, n_diff=(0,)),
+    "_np_take_along_axis": dict(
+        inputs=[_pos((3, 4)), onp.zeros((3, 1), "int64")],
+        kwargs={"axis": 1}, n_diff=(0,)),
+    "_np_where": dict(
+        inputs=[onp.array([[True, False, True, False]] * 3), _pos(),
+                _pos()], kwargs={}, n_diff=(1, 2)),
+    "_np_interp": dict(
+        inputs=[onp.array([1.3, 2.1], "float32"),
+                onp.array([1., 2., 3.], "float32"),
+                onp.array([2., 4., 8.], "float32")],
+        kwargs={}, n_diff=(2,)),
+    "_np_pad": dict(inputs=[_pos()], kwargs={"pad_width": 1}),
+    "_np_repeat": dict(inputs=[_pos()], kwargs={"repeats": 2}),
+    "_np_reshape": dict(inputs=[_pos(), (4, 3)], kwargs={},
+                        n_diff=(0,)),
+    "_np_resize": dict(inputs=[_pos(), (4, 3)], kwargs={}, n_diff=(0,)),
+    "_np_roll": dict(inputs=[_pos()], kwargs={"shift": 1}),
+    "_np_rot90": dict(inputs=[_pos()], kwargs={}),
+    "_np_squeeze": dict(inputs=[_pos((1, 3, 4))], kwargs={}),
+    "_np_swapaxes": dict(inputs=[_pos(), 0, 1], kwargs={}, n_diff=(0,)),
+    "_np_moveaxis": dict(inputs=[_pos(), 0, 1], kwargs={}, n_diff=(0,)),
+    "_np_rollaxis": dict(inputs=[_pos(), 1], kwargs={}, n_diff=(0,)),
+    "_np_permute_dims": dict(inputs=[_pos(), (1, 0)], kwargs={},
+                             n_diff=(0,)),
+    "_np_matrix_transpose": dict(inputs=[_pos()], kwargs={}),
+    "_np_split": dict(inputs=[_pos((4, 4)), 2], kwargs={}, n_diff=(0,)),
+    "_np_array_split": dict(inputs=[_pos((4, 4)), 2], kwargs={},
+                            n_diff=(0,)),
+    "_np_vsplit": dict(inputs=[_pos((4, 4)), 2], kwargs={}, n_diff=(0,)),
+    "_np_hsplit": dict(inputs=[_pos((4, 4)), 2], kwargs={}, n_diff=(0,)),
+    "_np_dsplit": dict(inputs=[_pos((2, 2, 4)), 2], kwargs={},
+                       n_diff=(0,)),
+    "_np_tile": dict(inputs=[_pos(), (2, 1)], kwargs={}, n_diff=(0,)),
+    "_np_tril": dict(inputs=[_pos((4, 4))], kwargs={}),
+    "_np_triu": dict(inputs=[_pos((4, 4))], kwargs={}),
+    "_np_vander": dict(inputs=[_pos((4,))], kwargs={}),
+    "_np_trim_zeros": dict(inputs=[_pos((4,))], kwargs={}),
+    "_np_unwrap": dict(inputs=[_pos((4,))], kwargs={}),
+    "_np_delete": dict(inputs=[_pos((4,)), 1], kwargs={}, n_diff=(0,)),
+    "_np_insert": dict(inputs=[_pos((4,)), 1, 5.0], kwargs={},
+                       n_diff=(0,)),
+    "_np_append": dict(inputs=[_pos((4,)), _pos((4,))], kwargs={}),
+    "_np_atleast_1d": dict(inputs=[_pos()], kwargs={}),
+    "_np_atleast_2d": dict(inputs=[_pos()], kwargs={}),
+    "_np_atleast_3d": dict(inputs=[_pos()], kwargs={}),
+    "_np_astype": dict(inputs=[_pos(), "float32"], kwargs={},
+                       n_diff=(0,)),
+    "_np_average": dict(inputs=[_pos()], kwargs={}),
+    "_np_convolve": dict(inputs=[_pos((4,)), _pos((3,))], kwargs={}),
+    "_np_correlate": dict(inputs=[_pos((4,)), _pos((3,))], kwargs={}),
+    "_np_gradient": dict(inputs=[_pos((5,))], kwargs={}),
+    "_np_heaviside": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_polyval": dict(inputs=[_pos((3,)), _pos((4,))], kwargs={}),
+    "_np_polyadd": dict(inputs=[_pos((3,)), _pos((3,))], kwargs={}),
+    "_np_polysub": dict(inputs=[_pos((3,)), _pos((3,))], kwargs={}),
+    "_np_polymul": dict(inputs=[_pos((3,)), _pos((3,))], kwargs={}),
+    "_np_polyder": dict(inputs=[_pos((4,))], kwargs={}),
+    "_np_polyint": dict(inputs=[_pos((4,))], kwargs={}),
+    "_np_polydiv": dict(inputs=[_pos((4,)), _pos((2,), lo=1.0, hi=2.0)],
+                        kwargs={}),
+    "_np_polyfit": dict(
+        inputs=[onp.arange(5.0, dtype="float32"), _pos((5,)), 2],
+        kwargs={}, n_diff=(1,)),
+    "_np_ptp": dict(inputs=[_pos()], kwargs={}),
+    "_np_quantile": dict(inputs=[_WELL_SEP, 0.4], kwargs={},
+                         n_diff=(0,), eps=2e-4),
+    "_np_percentile": dict(inputs=[_WELL_SEP, 40.0], kwargs={},
+                           n_diff=(0,), eps=2e-4),
+    "_np_nanquantile": dict(inputs=[_WELL_SEP, 0.4], kwargs={},
+                            n_diff=(0,), eps=2e-4),
+    "_np_nanpercentile": dict(inputs=[_WELL_SEP, 40.0], kwargs={},
+                              n_diff=(0,), eps=2e-4),
+    "_np_median": dict(inputs=[_WELL_SEP], kwargs={}, eps=2e-4),
+    "_np_nanmedian": dict(inputs=[_WELL_SEP], kwargs={}, eps=2e-4),
+    "_np_partition": dict(inputs=[_pos((6,)), 3], kwargs={}, n_diff=(0,)),
+    "_np_sort": dict(inputs=[_pos((6,))], kwargs={}),
+    "_np_sinc": dict(inputs=[_pos(lo=0.2, hi=0.8)], kwargs={}),
+    "_np_copysign": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_ldexp": dict(
+        inputs=[_pos(), onp.ones(SHAPE, "int32")], kwargs={},
+        n_diff=(0,)),
+    "_np_float_power": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_power": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_pow": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_fmod": dict(inputs=[_pos(lo=2.0, hi=3.0), _pos(lo=0.6, hi=0.9)],
+                     kwargs={}),
+    "_np_mod": dict(inputs=[_pos(lo=2.0, hi=3.0), _pos(lo=0.6, hi=0.9)],
+                    kwargs={}),
+    "_np_remainder": dict(inputs=[_pos(lo=2.0, hi=3.0),
+                                  _pos(lo=0.6, hi=0.9)], kwargs={}),
+    "_np_divmod": dict(inputs=[_pos(lo=2.0, hi=3.0),
+                               _pos(lo=0.6, hi=0.9)], kwargs={}),
+    "_np_modf": dict(inputs=[_pos(lo=0.1, hi=0.9)], kwargs={}),
+    "_np_select": dict(
+        inputs=[[onp.array([True, False]), onp.array([False, True])],
+                [_pos((2,)), _pos((2,))]], kwargs={}, n_diff=()),
+    "_np_piecewise": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_apply_along_axis": None,
+    "_np_apply_over_axes": None,
+    "_np_meshgrid": dict(inputs=[_pos((3,)), _pos((4,))], kwargs={}),
+    "_np_broadcast_arrays": dict(inputs=[_pos((1, 4)), _pos((3, 1))],
+                                 kwargs={}),
+    "_np_ix_": dict(inputs=[_pos((3,))], kwargs={}, n_diff=()),
+    "_np_trapezoid": dict(inputs=[_pos((5,))], kwargs={}),
+    "_np_corrcoef": dict(inputs=[_pos((3, 5))], kwargs={}),
+    "_np_cov": dict(inputs=[_pos((3, 5))], kwargs={}),
+    "_np_i0": dict(inputs=[_pos()], kwargs={}),
+    "_np_angle": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_real": dict(inputs=[_pos()], kwargs={}),
+    "_np_imag": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_conj": dict(inputs=[_pos()], kwargs={}),
+    "_np_conjugate": dict(inputs=[_pos()], kwargs={}),
+    "_np_nan_to_num": dict(inputs=[_pos()], kwargs={}),
+    "_np_concatenate": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_concat": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_stack": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_vstack": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_hstack": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_dstack": dict(inputs=[_pos(), _pos()], kwargs={}),
+    "_np_column_stack": dict(inputs=[_pos((3,)), _pos((3,))], kwargs={}),
+    "_np_cumsum": dict(inputs=[_pos()], kwargs={}),
+    "_np_cumprod": dict(inputs=[_pos()], kwargs={}),
+    "_np_nancumsum": dict(inputs=[_pos()], kwargs={}),
+    "_np_nancumprod": dict(inputs=[_pos()], kwargs={}),
+    "_np_diff": dict(inputs=[_pos()], kwargs={}),
+    "_np_ediff1d": dict(inputs=[_pos()], kwargs={}),
+    "_np_fix": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_rint": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_round": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_around": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_floor": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_ceil": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_trunc": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_sign": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+    "_np_copy": dict(inputs=[_pos()], kwargs={}),
+    "_np_ravel": dict(inputs=[_pos()], kwargs={}),
+    "_np_transpose": dict(inputs=[_pos()], kwargs={}),
+    "_np_max": dict(inputs=[_pos()], kwargs={}),
+    "_np_min": dict(inputs=[_pos()], kwargs={}),
+    "_np_amax": dict(inputs=[_pos()], kwargs={}),
+    "_np_amin": dict(inputs=[_pos()], kwargs={}),
+    "_np_fmax": dict(inputs=[_pos(), _pos(lo=2.0, hi=3.0)], kwargs={}),
+    "_np_fmin": dict(inputs=[_pos(), _pos(lo=2.0, hi=3.0)], kwargs={}),
+    "_np_maximum": dict(inputs=[_pos(), _pos(lo=2.0, hi=3.0)], kwargs={}),
+    "_np_minimum": dict(inputs=[_pos(), _pos(lo=2.0, hi=3.0)], kwargs={}),
+    "_np_nanmax": dict(inputs=[_pos()], kwargs={}),
+    "_np_nanmin": dict(inputs=[_pos()], kwargs={}),
+    "_np_frexp": dict(inputs=[_pos()], kwargs={}, n_diff=()),
+}
+NP_SPECS["_np_linalg_pinv"] = dict(inputs=[_sym_pd()], kwargs={},
+                                   tol=0.1)
+SPECS["broadcast_mod"] = dict(
+    inputs=[_pos(), _pos(lo=3.0, hi=4.0)], kwargs={})
+SPECS.update(NP_SPECS)
+
+NP_SKIP = {
+    "_np_apply_along_axis": "callable first argument, not a tensor op",
+    "_np_apply_over_axes": "callable first argument, not a tensor op",
+}
+SKIP.update(NP_SKIP)
+
+
+def _unique_diff_ops():
+    uniq = {}
+    for n in sorted(registry.list_ops()):
+        op = registry.get(n)
+        if op.differentiable and not op.creation and not op.random:
+            uniq.setdefault(id(op), n)
+    return sorted(uniq.values())
+
+
+ALL_OPS = _unique_diff_ops()
+
+
+def _auto_inputs(name):
+    """Default inputs: 1-3 positive (3,4) float arrays, first that runs."""
+    for k in (1, 2, 3):
+        ins = [_pos() for _ in range(k)]
+        try:
+            out = invoke(name, *[nd.array(a) for a in ins])
+            first = out[0] if isinstance(out, (list, tuple)) else out
+            arr = first.asnumpy()
+            if not onp.issubdtype(arr.dtype, onp.floating):
+                return None, None
+            if not onp.isfinite(arr).all():
+                continue
+            return ins, {}
+        except Exception:
+            continue
+    return None, None
+
+
+_RESULTS = {"checked": set(), "skipped": set(), "no_auto": set()}
+
+
+def _run_check(name, inputs, kwargs, n_diff=None, tol=0.06, eps=3e-3):
+    xs = []
+    for a in inputs:
+        if isinstance(a, onp.ndarray):
+            xs.append(nd.array(a, dtype=str(a.dtype)))
+        else:
+            xs.append(a)                      # non-tensor positional arg
+    tensor_idx = [i for i, a in enumerate(inputs)
+                  if isinstance(a, onp.ndarray)
+                  and onp.issubdtype(onp.asarray(a).dtype, onp.floating)]
+    if n_diff is None:
+        n_diff = tuple(tensor_idx)
+    elif isinstance(n_diff, int):
+        n_diff = tuple(range(n_diff))
+
+    def fwd(arrs):
+        out = invoke(name, *arrs, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        total = 0.0
+        for k, o in enumerate(outs):
+            w = _W(k, tuple(o.shape))
+            total = total + float((o * nd.array(w)).sum().asnumpy())
+        return total
+
+    _w_cache = {}
+
+    def _W(k, shape):
+        key = (k, shape)
+        if key not in _w_cache:
+            _w_cache[key] = onp.random.RandomState(100 + k).uniform(
+                0.5, 1.5, size=shape).astype("float32")
+        return _w_cache[key]
+
+    for x in xs:
+        if isinstance(x, nd.NDArray):
+            x.attach_grad()
+    with autograd.record():
+        out = invoke(name, *xs, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = None
+        for k, o in enumerate(outs):
+            term = (o * nd.array(_W(k, tuple(o.shape)))).sum()
+            loss = term if loss is None else loss + term
+    loss.backward()
+
+    for i in n_diff:
+        x = xs[i]
+        g = x.grad
+        assert g is not None, "%s: no grad for input %d" % (name, i)
+        host = inputs[i].astype("float64")
+        v = onp.random.RandomState(50 + i).randn(*host.shape)
+        v /= max(1e-12, onp.abs(v).max())
+        plus = [a for a in inputs]
+        minus = [a for a in inputs]
+        plus[i] = (host + eps * v).astype("float32")
+        minus[i] = (host - eps * v).astype("float32")
+
+        def realize(lst):
+            return [nd.array(a, dtype=str(a.dtype))
+                    if isinstance(a, onp.ndarray) else a for a in lst]
+
+        num = (fwd(realize(plus)) - fwd(realize(minus))) / (2 * eps)
+        ana = float((g.asnumpy().astype("float64") *
+                     v.astype(g.dtype and "float64")).sum())
+        denom = max(abs(num), abs(ana), 1.0)
+        assert abs(num - ana) / denom < tol, (
+            "%s input %d: analytic %.6f vs numeric %.6f" % (name, i, ana,
+                                                            num))
+
+
+@pytest.mark.parametrize("name", ALL_OPS)
+def test_registry_gradient(name):
+    if name in SKIP:
+        _RESULTS["skipped"].add(name)
+        pytest.skip(SKIP[name])
+    spec = SPECS.get(name)
+    if spec is None and name in SPECS:
+        _RESULTS["skipped"].add(name)
+        pytest.skip("spec marked as covered elsewhere")
+    if spec is None:
+        inputs, kwargs = _auto_inputs(name)
+        if inputs is None:
+            _RESULTS["no_auto"].add(name)
+            pytest.skip("no auto-generated inputs run this op")
+        spec = dict(inputs=inputs, kwargs=kwargs)
+    _run_check(name, spec["inputs"], spec.get("kwargs", {}),
+               n_diff=spec.get("n_diff"), tol=spec.get("tol", 0.06),
+               eps=spec.get("eps", 3e-3))
+    _RESULTS["checked"].add(name)
+
+
+def test_zz_sweep_coverage():
+    """Accounting: >=80% of unique differentiable ops actually checked.
+    (zz prefix: runs after the parametrized sweep.)"""
+    total = len(ALL_OPS)
+    checked = len(_RESULTS["checked"])
+    unreached = _RESULTS["no_auto"]
+    assert checked / total >= 0.8, (
+        "gradient sweep coverage %d/%d = %.0f%%; unreachable ops: %s"
+        % (checked, total, 100.0 * checked / total, sorted(unreached)))
