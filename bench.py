@@ -17,6 +17,7 @@ Prints ONE JSON line:
 """
 import json
 import os
+import sys
 import time
 
 import jax
@@ -247,10 +248,68 @@ def bench_bert_gluon(on_accel):
     return batch * seq * steps / dt, "bert_gluon"
 
 
+def bench_fused_stage(on_accel):
+    """ROOFLINE.md fusion project microbench: one ResNet stage-3-shaped
+    conv3x3+BN+ReLU block, XLA composed vs Pallas fused
+    (MXNET_TPU_USE_PALLAS). Reports the fused/composed speedup and logs
+    both programs' HBM bytes from cost_analysis."""
+    import numpy as onp
+    from mxnet_tpu.ops import fused_conv as fc
+
+    N, H, W, C = (64, 14, 14, 256) if on_accel else (4, 14, 14, 32)
+    rng = onp.random.RandomState(0)
+    dt = jnp.bfloat16 if on_accel else jnp.float32
+    x = jnp.asarray(rng.randn(N, H, W, C), dtype=dt)
+    w = jnp.asarray(rng.randn(3, 3, C, C) * 0.05, dtype=dt)
+    scale = jnp.asarray(rng.rand(C) + 0.5, dtype=jnp.float32)
+    shift = jnp.asarray(rng.randn(C) * 0.1, dtype=jnp.float32)
+
+    composed = jax.jit(lambda a: fc._xla_conv_bn_relu(a, w, scale, shift))
+    fused = jax.jit(lambda a: fc._pallas_conv_bn_relu(a, w, scale, shift))
+
+    for fn, tag in ((composed, "xla"), (fused, "pallas")):
+        lowered = fn.lower(x)
+        try:
+            cost = lowered.compile().cost_analysis()
+            cost = cost[0] if isinstance(cost, list) else cost
+            print("# %s bytes accessed: %.3e" % (
+                tag, cost.get("bytes accessed", float("nan"))),
+                file=sys.stderr)
+        except Exception as e:       # cost analysis is best-effort
+            print("# %s cost_analysis unavailable: %s" % (tag, e),
+                  file=sys.stderr)
+
+    def time_it(fn):
+        fn(x).block_until_ready()
+        n = 50 if on_accel else 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(x)
+        out.block_until_ready()
+        return n * N / (time.perf_counter() - t0)
+
+    base = time_it(composed)
+    fast = time_it(fused)
+    return fast, base
+
+
 def main():
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
     which = os.environ.get("BENCH", "gluon")
+    if which == "fused":
+        os.environ.setdefault("MXNET_TPU_USE_PALLAS", "1")
+        if not on_accel:
+            os.environ.setdefault("MXNET_FLASH_INTERPRET", "1")
+        fast, base = bench_fused_stage(on_accel)
+        print(json.dumps({
+            "metric": ("fused_conv_bn_relu_img_per_sec" if on_accel
+                       else "fused_conv_bn_relu_cpu_img_per_sec"),
+            "value": round(fast, 2),
+            "unit": "img/s",
+            "vs_baseline": round(fast / base, 4),   # vs XLA composed
+        }))
+        return
     if which in ("bert", "bert_gluon"):
         tok_s, _ = (bench_bert if which == "bert"
                     else bench_bert_gluon)(on_accel)

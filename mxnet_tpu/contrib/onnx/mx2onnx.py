@@ -77,6 +77,7 @@ class _Builder:
         self.nodes = []
         self.initializers = []
         self.params = params or {}    # host numpy params, for shape lookups
+        self.np_dtype = _onp.float32  # model dtype, set by export_model
         self._uid = 0
 
     def add(self, op_type, inputs, name, outputs=None, attrs=()):
@@ -195,13 +196,16 @@ _ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
 def _act(b, name, ins, a):
     t = a.get("act_type", "relu")
     if t == "gelu":
-        # exact-erf gelu decomposition: x * 0.5 * (1 + erf(x/sqrt(2)))
+        # exact-erf gelu decomposition: x * 0.5 * (1 + erf(x/sqrt(2)));
+        # constants carry the model dtype — mixed-type Mul/Add is invalid
+        # ONNX for fp16/bf16 models
+        dt = b.np_dtype
         scaled = b.add("Mul", [ins[0], b.const(b.tmp(name + "_c"),
-                                               _onp.float32(0.7071067811865476))],
+                                               dt(0.7071067811865476))],
                        b.tmp(name + "_sc"))
         erf = b.add("Erf", [scaled], b.tmp(name + "_erf"))
-        one = b.const(b.tmp(name + "_one"), _onp.float32(1.0))
-        half = b.const(b.tmp(name + "_half"), _onp.float32(0.5))
+        one = b.const(b.tmp(name + "_one"), dt(1.0))
+        half = b.const(b.tmp(name + "_half"), dt(0.5))
         g = b.add("Add", [erf, one], b.tmp(name + "_p1"))
         g = b.add("Mul", [g, half], b.tmp(name + "_h"))
         return b.add("Mul", [ins[0], g], name)
@@ -392,6 +396,8 @@ def export_model(sym, params, input_shapes, input_dtype="float32",
         shape_of = {data_names[0]: tuple(input_shapes or ())}
 
     b = _Builder(host_params)
+    b.np_dtype = _onp.dtype(input_dtype).type \
+        if input_dtype != "bfloat16" else _onp.float32
     out_name = {}              # node idx -> onnx value name
     graph_inputs = []
 

@@ -87,13 +87,14 @@ def import_model(onnx_file):
         if vi.name not in consts:
             as_sym(vi.name)
 
-    # consumers per value name: int Casts may only collapse to identity
-    # when they feed Gather exclusively (mx.take accepts float indices);
-    # a general int cast carries truncation semantics
+    # consumers per value name as (op_type, input_slot): int Casts may
+    # only collapse to identity when they feed Gather's INDICES slot
+    # exclusively (mx.take accepts float indices); a cast feeding data
+    # carries truncation semantics
     consumer_ops = {}
     for node_ in g.node:
-        for x in node_.input:
-            consumer_ops.setdefault(x, []).append(node_.op_type)
+        for slot, x in enumerate(node_.input):
+            consumer_ops.setdefault(x, []).append((node_.op_type, slot))
 
     def sym_pads(a, k):
         """ONNX pads = [begin..., end...]; the symmetric form maps to the
@@ -279,7 +280,7 @@ def import_model(onnx_file):
             feeds = [c for o in node.output
                      for c in consumer_ops.get(o, [])]
             if to in (P.DT.INT64, P.DT.INT32) and feeds and \
-                    all(c == "Gather" for c in feeds):
+                    all(c == ("Gather", 1) for c in feeds):
                 # pure index cast (the Gather pattern): mx.take accepts
                 # float indices, so the cast collapses
                 out = as_sym(ins[0])

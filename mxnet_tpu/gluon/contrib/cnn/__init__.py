@@ -1,4 +1,5 @@
 """`gluon.contrib.cnn` (reference: python/mxnet/gluon/contrib/cnn/)."""
-from .conv_layers import DeformableConvolution  # noqa: F401
+from .conv_layers import (DeformableConvolution,  # noqa: F401
+                          FusedConvBNReLU)
 
-__all__ = ["DeformableConvolution"]
+__all__ = ["DeformableConvolution", "FusedConvBNReLU"]

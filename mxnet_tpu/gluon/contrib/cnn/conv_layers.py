@@ -81,3 +81,71 @@ class DeformableConvolution(HybridBlock):
         if self.act is not None:
             out = self.act(out)
         return out
+
+
+class FusedConvBNReLU(HybridBlock):
+    """Inference-path fused conv3x3 + folded-BN + ReLU (+ residual).
+
+    Wraps `_contrib_conv_bn_relu` (ops/fused_conv.py — Pallas implicit-GEMM
+    on TPU under MXNET_TPU_USE_PALLAS): the BN affine and the activation run
+    on the conv accumulator in VMEM instead of round-tripping HBM. Build it
+    from a trained (Conv2D, BatchNorm) pair with `from_layers`; training
+    keeps the composed layers (batch statistics need the conv output).
+
+    Layout NHWC, stride 1, SAME pad — the shape of every interior ResNet
+    block conv (ROOFLINE.md fusion project).
+    """
+
+    def __init__(self, weight, scale, shift, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=weight.shape,
+                                          grad_req="null")
+            self.scale = self.params.get("scale", shape=scale.shape,
+                                         grad_req="null")
+            self.shift = self.params.get("shift", shape=shift.shape,
+                                         grad_req="null")
+        for p, v in ((self.weight, weight), (self.scale, scale),
+                     (self.shift, shift)):
+            p.initialize(ctx=v.context)
+            p.set_data(v)
+
+    @classmethod
+    def from_layers(cls, conv, bn, eps=None, **kwargs):
+        """Fold a Conv2D (layout NHWC, 3x3, stride 1, pad 1, no bias) and
+        a trained BatchNorm into one fused block. The preconditions are
+        enforced — a silent fold of an unsupported conv would produce
+        wrong numerics, not an error."""
+        from ....ops.fused_conv import fold_bn_params
+        kw = conv._kwargs
+        if kw.get("layout") != "NHWC":
+            raise ValueError("FusedConvBNReLU.from_layers: layout must be "
+                             "NHWC, got %r" % kw.get("layout"))
+        if tuple(kw.get("kernel", ())) != (3, 3) or \
+                tuple(kw.get("stride", (1, 1))) != (1, 1) or \
+                tuple(kw.get("pad", (0, 0))) != (1, 1):
+            raise ValueError(
+                "FusedConvBNReLU.from_layers needs a 3x3/stride-1/pad-1 "
+                "conv, got kernel=%s stride=%s pad=%s"
+                % (kw.get("kernel"), kw.get("stride"), kw.get("pad")))
+        if not kw.get("no_bias", False):
+            raise ValueError("FusedConvBNReLU.from_layers: conv bias is "
+                             "not folded; build the conv with "
+                             "use_bias=False")
+        w = conv.weight.data()
+        # Conv2D NHWC keeps weights (Cout, kh, kw, Cin) — to HWIO
+        w_hwio = w.data_jax.transpose(1, 2, 3, 0)
+        scale, shift = fold_bn_params(
+            bn.gamma.data().data_jax, bn.beta.data().data_jax,
+            bn.running_mean.data().data_jax, bn.running_var.data().data_jax,
+            eps=eps if eps is not None else bn._kwargs.get("eps", 1e-3))
+        from ....ndarray.ndarray import from_jax
+        return cls(from_jax(w_hwio), from_jax(scale), from_jax(shift),
+                   **kwargs)
+
+    def hybrid_forward(self, F, x, residual=None, weight=None, scale=None,
+                       shift=None):
+        args = [x, weight, scale, shift]
+        if residual is not None:
+            args.append(residual)
+        return F.contrib.conv_bn_relu(*args)

@@ -1,0 +1,156 @@
+"""Fused conv3x3 + folded-BN + ReLU (+ residual) — the ROOFLINE.md fusion
+project.
+
+reference contrast: the reference gets this fusion from cuDNN's fused
+conv-bias-activation path and its RTC pointwise fuser (SURVEY §2.1); on
+TPU the XLA path already fuses the BN affine + ReLU into the conv's
+epilogue, but each op boundary still round-trips activations through HBM
+in the NCHW layout benchmark. This op is the explicit fused form: one
+`_contrib_conv_bn_relu` node whose TPU implementation is a Pallas
+implicit-GEMM kernel — the 3x3 conv becomes 9 shifted (H·W, Cin) x
+(Cin, Cout-block) MXU dots accumulated in VMEM, and the scale/shift/ReLU
+/residual epilogue runs on the accumulator before it ever leaves VMEM.
+
+Layout NHWC (the TPU-native channels-last layout), stride 1, SAME pad —
+the shape of every interior ResNet block conv. BN is the FOLDED
+(inference) form: scale = gamma/sqrt(var+eps), shift = beta - mean*scale;
+`fold_bn_params` computes them from a Gluon BatchNorm's tensors. Training
+keeps the composed conv/BatchNorm ops (batch statistics need the conv
+output before normalization can start).
+
+Enable the Pallas path with MXNET_TPU_USE_PALLAS=1 (registry tpu_impl
+gate); MXNET_FLASH_INTERPRET=1 runs it through the interpreter on CPU for
+the test suite.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .registry import register, get
+
+__all__ = ["fold_bn_params"]
+
+
+def _interpret():
+    return os.environ.get("MXNET_FLASH_INTERPRET", "0") == "1"
+
+
+def fold_bn_params(gamma, beta, moving_mean, moving_var, eps=1e-3):
+    """BN(inference) == y*scale + shift with these folded tensors."""
+    scale = gamma / jnp.sqrt(moving_var + eps)
+    return scale, beta - moving_mean * scale
+
+
+def _xla_conv_bn_relu(x, w, scale, shift, residual=None):
+    """Reference XLA path: lax conv in NHWC + affine + relu."""
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    out = out * scale.astype(jnp.float32) + shift.astype(jnp.float32)
+    if residual is not None:
+        out = out + residual.astype(jnp.float32)
+    return jnp.maximum(out, 0.0).astype(x.dtype)
+
+
+def _kernel(x_ref, w_ref, s_ref, b_ref, *rest, block_co, H, W, C,
+            has_residual):
+    if has_residual:
+        r_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
+    x = x_ref[0].astype(jnp.float32)            # (H, W, C)
+    acc = jnp.zeros((H * W, block_co), jnp.float32)
+    # implicit GEMM: 9 shifted full-image dots, accumulator stays in VMEM
+    for dh in (-1, 0, 1):
+        for dw in (-1, 0, 1):
+            shifted = jnp.roll(x, (-dh, -dw), axis=(0, 1))
+            rows = lax.broadcasted_iota(jnp.int32, (H, W), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (H, W), 1)
+            valid = ((rows + dh >= 0) & (rows + dh < H) &
+                     (cols + dw >= 0) & (cols + dw < W))
+            shifted = jnp.where(valid[..., None], shifted, 0.0)
+            wk = w_ref[dh + 1, dw + 1].astype(jnp.float32)   # (C, bco)
+            acc += jax.lax.dot_general(
+                shifted.reshape(H * W, C), wk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    out = acc * s_ref[...].astype(jnp.float32) + b_ref[...].astype(
+        jnp.float32)
+    if has_residual:
+        out = out + r_ref[0].astype(jnp.float32).reshape(H * W, block_co)
+    out = jnp.maximum(out, 0.0)
+    o_ref[0] = out.reshape(H, W, block_co).astype(o_ref.dtype)
+
+
+def _pallas_conv_bn_relu(x, w, scale, shift, residual=None, block_co=128):
+    N, H, W, C = x.shape
+    Cout = w.shape[-1]
+    block_co = min(block_co, Cout)
+    n_co = pl.cdiv(Cout, block_co)
+    has_res = residual is not None
+
+    try:
+        cparams = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"))
+    except TypeError:
+        cparams = None
+
+    in_specs = [
+        pl.BlockSpec((1, H, W, C), lambda n, c: (n, 0, 0, 0)),
+        pl.BlockSpec((3, 3, C, block_co), lambda n, c: (0, 0, 0, c)),
+        pl.BlockSpec((block_co,), lambda n, c: (c,)),
+        pl.BlockSpec((block_co,), lambda n, c: (c,)),
+    ]
+    args = [x, w, scale, shift]
+    if has_res:
+        in_specs.append(pl.BlockSpec((1, H, W, block_co),
+                                     lambda n, c: (n, 0, 0, c)))
+        args.append(residual)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_co=block_co, H=H, W=W, C=C,
+                          has_residual=has_res),
+        grid=(N, n_co),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, W, block_co),
+                               lambda n, c: (n, 0, 0, c)),
+        out_shape=jax.ShapeDtypeStruct((N, H, W, Cout), x.dtype),
+        interpret=_interpret(),
+        **({"compiler_params": cparams} if cparams else {}),
+    )(*args)
+    return out
+
+
+def _shapes_ok(x, w):
+    C, Cout = x.shape[-1], w.shape[-1]
+    return (w.shape[0] == 3 and w.shape[1] == 3 and
+            C % 8 == 0 and Cout % 8 == 0)
+
+
+# inference-path op: differentiable=False — the Pallas kernel has no AD
+# rule, and training keeps the composed Conv/BatchNorm ops anyway (batch
+# statistics need the conv output before normalization)
+@register("_contrib_conv_bn_relu", arity=None, differentiable=False)
+def _conv_bn_relu(x, w, scale, shift, *residual):
+    """x (N,H,W,C) NHWC; w (3,3,Cin,Cout) HWIO; scale/shift (Cout,);
+    optional residual (N,H,W,Cout). Stride 1, SAME pad, folded-BN + ReLU
+    epilogue."""
+    res = residual[0] if residual else None
+    return _xla_conv_bn_relu(x, w, scale, shift, res)
+
+
+# the Pallas kernel registers through tpu_impl so the registry's
+# MXNET_TPU_USE_PALLAS kill switch (registry.best_fn) really gates it
+@get("_contrib_conv_bn_relu").tpu_impl
+def _conv_bn_relu_tpu(x, w, scale, shift, *residual):
+    res = residual[0] if residual else None
+    if not _shapes_ok(x, w):
+        return _xla_conv_bn_relu(x, w, scale, shift, res)
+    return _pallas_conv_bn_relu(x, w, scale, shift, res)

@@ -1,0 +1,120 @@
+"""Pallas fused conv3x3+BN+ReLU kernel (ROOFLINE.md fusion project).
+
+The interpreter-mode run exercises the real kernel on the CPU suite; the
+on-chip run (MXNET_TEST_DEVICE=tpu + MXNET_TPU_USE_PALLAS=1) compiles it
+for the MXU."""
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu  # noqa: F401
+from mxnet_tpu.ops import fused_conv as fc
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("MXNET_FLASH_INTERPRET", "1")
+    yield
+
+
+def _mk(N=2, H=8, W=8, C=16, Cout=16, seed=0, dtype="float32"):
+    rng = onp.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(N, H, W, C).astype(dtype) * 0.5)
+    w = jnp.asarray(rng.randn(3, 3, C, Cout).astype(dtype) * 0.1)
+    gamma = jnp.asarray(rng.rand(Cout).astype(dtype) + 0.5)
+    beta = jnp.asarray(rng.randn(Cout).astype(dtype) * 0.1)
+    mean = jnp.asarray(rng.randn(Cout).astype(dtype) * 0.1)
+    var = jnp.asarray(rng.rand(Cout).astype(dtype) + 0.5)
+    return x, w, gamma, beta, mean, var
+
+
+@pytest.mark.parametrize("shape", [(2, 8, 8, 16, 16), (1, 14, 14, 32, 64),
+                                   (1, 7, 7, 64, 32)])
+def test_fused_matches_xla_reference(shape):
+    N, H, W, C, Cout = shape
+    x, w, g, b, m, v = _mk(N, H, W, C, Cout)
+    scale, shift = fc.fold_bn_params(g, b, m, v)
+    got = fc._pallas_conv_bn_relu(x, w, scale, shift)
+    want = fc._xla_conv_bn_relu(x, w, scale, shift)
+    onp.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
+
+
+def test_fused_with_residual():
+    x, w, g, b, m, v = _mk(2, 8, 8, 16, 16, seed=3)
+    res = jnp.asarray(onp.random.RandomState(4)
+                      .randn(2, 8, 8, 16).astype("float32"))
+    scale, shift = fc.fold_bn_params(g, b, m, v)
+    got = fc._pallas_conv_bn_relu(x, w, scale, shift, residual=res)
+    want = fc._xla_conv_bn_relu(x, w, scale, shift, residual=res)
+    onp.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
+    # relu actually clamps and residual actually contributes
+    assert float(jnp.min(got)) == 0.0
+    assert not onp.allclose(got, fc._pallas_conv_bn_relu(x, w, scale,
+                                                         shift))
+
+
+def test_fused_op_dispatch_and_bf16():
+    from mxnet_tpu import nd
+    x, w, g, b, m, v = _mk(1, 8, 8, 16, 16, seed=5, dtype="float32")
+    scale, shift = fc.fold_bn_params(g, b, m, v)
+    out = nd.contrib.conv_bn_relu(
+        nd.array(onp.asarray(x)), nd.array(onp.asarray(w)),
+        nd.array(onp.asarray(scale)), nd.array(onp.asarray(shift)))
+    want = fc._xla_conv_bn_relu(x, w, scale, shift)
+    onp.testing.assert_allclose(out.asnumpy(), want, atol=2e-4, rtol=1e-4)
+    # bf16 stream stays bf16
+    xb = x.astype(jnp.bfloat16)
+    got16 = fc._pallas_conv_bn_relu(xb, w.astype(jnp.bfloat16),
+                                    scale, shift)
+    assert got16.dtype == jnp.bfloat16
+    onp.testing.assert_allclose(got16.astype(jnp.float32), want, atol=0.15,
+                                rtol=0.05)
+
+
+def test_folded_bn_equals_batchnorm_inference():
+    """fold_bn_params must reproduce BatchNorm's inference affine."""
+    from mxnet_tpu.ops.registry import get
+    x, w, g, b, m, v = _mk(1, 8, 8, 16, 16, seed=7)
+    conv = fc._xla_conv_bn_relu(x, w, jnp.ones_like(g), jnp.zeros_like(b))
+    # undo relu for comparison: use raw conv via lax
+    from jax import lax
+    raw = lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    bn = get("BatchNorm").fn(raw, g, b, m, v, eps=1e-3, axis=-1,
+                             use_global_stats=True, fix_gamma=False)
+    if isinstance(bn, tuple):
+        bn = bn[0]
+    scale, shift = fc.fold_bn_params(g, b, m, v, eps=1e-3)
+    onp.testing.assert_allclose(
+        onp.maximum(onp.asarray(bn), 0.0),
+        fc._xla_conv_bn_relu(x, w, scale, shift), atol=2e-4, rtol=1e-3)
+
+
+def test_gluon_fused_block_matches_composed():
+    """FusedConvBNReLU.from_layers == Conv2D -> BatchNorm(inference) ->
+    relu on the same trained parameters."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.cnn import FusedConvBNReLU
+
+    mx.random.seed(0)
+    conv = nn.Conv2D(16, kernel_size=3, padding=1, use_bias=False,
+                     layout="NHWC", in_channels=8)
+    bn = nn.BatchNorm(axis=-1, in_channels=16)
+    conv.initialize(mx.init.Xavier())
+    bn.initialize()
+    # make BN stats non-trivial
+    rng = onp.random.RandomState(1)
+    bn.running_mean.set_data(nd.array(rng.randn(16).astype("float32") * 0.1))
+    bn.running_var.set_data(nd.array(rng.rand(16).astype("float32") + 0.5))
+    bn.gamma.set_data(nd.array(rng.rand(16).astype("float32") + 0.5))
+    bn.beta.set_data(nd.array(rng.randn(16).astype("float32") * 0.1))
+
+    x = nd.array(rng.randn(2, 8, 8, 8).astype("float32"))
+    composed = nd.relu(bn(conv(x)))          # inference mode: global stats
+    fused = FusedConvBNReLU.from_layers(conv, bn)
+    got = fused(x)
+    onp.testing.assert_allclose(got.asnumpy(), composed.asnumpy(),
+                                atol=2e-4, rtol=1e-3)

@@ -602,6 +602,9 @@ def test_zz_sweep_coverage():
     (zz prefix: runs after the parametrized sweep.)"""
     total = len(ALL_OPS)
     checked = len(_RESULTS["checked"])
+    if not checked and not _RESULTS["skipped"]:
+        pytest.skip("sweep tests did not run in this session "
+                    "(selected standalone)")
     unreached = _RESULTS["no_auto"]
     assert checked / total >= 0.8, (
         "gradient sweep coverage %d/%d = %.0f%%; unreachable ops: %s"
