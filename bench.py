@@ -264,8 +264,11 @@ def bench_fused_stage(on_accel):
     scale = jnp.asarray(rng.rand(C) + 0.5, dtype=jnp.float32)
     shift = jnp.asarray(rng.randn(C) * 0.1, dtype=jnp.float32)
 
-    composed = jax.jit(lambda a: fc._xla_conv_bn_relu(a, w, scale, shift))
-    fused = jax.jit(lambda a: fc._pallas_conv_bn_relu(a, w, scale, shift))
+    res = jnp.asarray(rng.randn(N, H, W, C) * 0.1, dtype=dt)
+    composed = jax.jit(
+        lambda a: fc._xla_conv_bn_relu(a, w, scale, shift, residual=res))
+    fused = jax.jit(
+        lambda a: fc._pallas_conv_bn_relu(a, w, scale, shift, residual=res))
 
     for fn, tag in ((composed, "xla"), (fused, "pallas")):
         lowered = fn.lower(x)

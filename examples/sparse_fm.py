@@ -1,0 +1,118 @@
+"""Factorization machine on libsvm data with rowsparse updates.
+
+reference: example/sparse/factorization_machine/ — CSR batches through
+LibSVMIter, autograd through the differentiable sparse dot, rowsparse
+gradients pushed to a kvstore with a server-side optimizer (only the rows
+each batch touched travel), lazy adagrad updates.
+
+  python examples/sparse_fm.py --epochs 10 --dim 100
+Uses a synthetic libsvm file unless --data points at a real one.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu.runtime import honor_jax_platforms_env
+honor_jax_platforms_env()
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def synth_libsvm(path, dim, n_samples, rng):
+    w_true = rng.randn(dim).astype(np.float32)
+    lines = []
+    for _ in range(n_samples):
+        nnz = rng.randint(3, max(4, dim // 10))
+        idx = sorted(rng.choice(dim, size=nnz, replace=False))
+        vals = rng.rand(nnz).astype(np.float32)
+        y = 1 if sum(w_true[i] * v for i, v in zip(idx, vals)) > 0 else 0
+        lines.append(str(y) + " " + " ".join(
+            "%d:%.4f" % (i, v) for i, v in zip(idx, vals)))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None, help="libsvm file")
+    p.add_argument("--dim", type=int, default=100)
+    p.add_argument("--factor-size", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--samples", type=int, default=2000)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    path = args.data
+    if path is None:
+        path = os.path.join(tempfile.mkdtemp(), "fm.libsvm")
+        synth_libsvm(path, args.dim, args.samples, rng)
+        print("synthetic libsvm:", path)
+
+    dim, k, bs = args.dim, args.factor_size, args.batch_size
+    w = nd.array(np.zeros((dim, 1), np.float32))
+    v = nd.array((rng.randn(dim, k) * 0.05).astype(np.float32))
+    b = nd.array(np.zeros((1,), np.float32))
+    for t in (w, v, b):
+        t.attach_grad()
+
+    kv = mx.kv.create("local")
+    kv.init(0, w)
+    kv.init(1, v)
+    kv.set_optimizer(mx.optimizer.create(
+        "adagrad", learning_rate=args.lr, rescale_grad=1.0 / bs))
+
+    def forward(csr, csr_sq):
+        lin = sp.dot(csr, w)
+        xv = sp.dot(csr, v)
+        x2v2 = sp.dot(csr_sq, nd.square(v))
+        pair = 0.5 * nd.sum(nd.square(xv) - x2v2, axis=1, keepdims=True)
+        return lin + pair + b
+
+    for epoch in range(args.epochs):
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=dim,
+                              batch_size=bs)
+        total, count, correct = 0.0, 0, 0
+        for batch in it:
+            csr = batch.data[0]
+            sq = sp.CSRNDArray(csr._sp_data * csr._sp_data,
+                               csr._sp_indices, csr._indptr, csr.shape)
+            y = batch.label[0].reshape((-1, 1))
+            with autograd.record():
+                out = forward(csr, sq)
+                loss = nd.mean(nd.log(1 + nd.exp(-(2 * y - 1) * out)))
+            loss.backward()
+            b -= args.lr * b.grad
+            touched = np.unique(np.asarray(csr._sp_indices))
+            rows = sp.jnp.asarray(touched.astype(np.int32))
+            kv.push(0, sp.RowSparseNDArray(w.grad._read()[rows] * bs,
+                                           rows, w.shape))
+            kv.push(1, sp.RowSparseNDArray(v.grad._read()[rows] * bs,
+                                           rows, v.shape))
+            # pull only touched rows back into the local dense replicas
+            # (reference: Parameter.row_sparse_data path)
+            for key, param in ((0, w), (1, v)):
+                tmp = sp.zeros("row_sparse", param.shape)
+                kv.row_sparse_pull(key, out=tmp, row_ids=nd.array(touched))
+                param._write(param._read().at[tmp._indices].set(
+                    tmp._values))
+            for t in (w, v, b):
+                t.grad[:] = 0
+            total += float(loss.asnumpy()) * y.shape[0]
+            count += y.shape[0]
+            correct += int(((out.asnumpy() > 0) ==
+                            (y.asnumpy() > 0.5)).sum())
+        print("epoch %2d  logloss %.4f  acc %.3f"
+              % (epoch, total / count, correct / count))
+
+
+if __name__ == "__main__":
+    main()

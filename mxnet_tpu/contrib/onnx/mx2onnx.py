@@ -396,8 +396,11 @@ def export_model(sym, params, input_shapes, input_dtype="float32",
         shape_of = {data_names[0]: tuple(input_shapes or ())}
 
     b = _Builder(host_params)
-    b.np_dtype = _onp.dtype(input_dtype).type \
-        if input_dtype != "bfloat16" else _onp.float32
+    if input_dtype == "bfloat16":
+        import ml_dtypes as _ml_dtypes
+        b.np_dtype = _ml_dtypes.bfloat16
+    else:
+        b.np_dtype = _onp.dtype(input_dtype).type
     out_name = {}              # node idx -> onnx value name
     graph_inputs = []
 

@@ -128,6 +128,12 @@ class FusedConvBNReLU(HybridBlock):
                 "FusedConvBNReLU.from_layers needs a 3x3/stride-1/pad-1 "
                 "conv, got kernel=%s stride=%s pad=%s"
                 % (kw.get("kernel"), kw.get("stride"), kw.get("pad")))
+        if tuple(kw.get("dilate", (1, 1))) != (1, 1) or \
+                kw.get("num_group", 1) != 1:
+            raise ValueError(
+                "FusedConvBNReLU.from_layers: dilated/grouped convs are "
+                "not folded (dilate=%s num_group=%s)"
+                % (kw.get("dilate"), kw.get("num_group")))
         if not kw.get("no_bias", False):
             raise ValueError("FusedConvBNReLU.from_layers: conv bias is "
                              "not folded; build the conv with "

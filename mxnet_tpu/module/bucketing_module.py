@@ -18,6 +18,16 @@ from .module import Module
 __all__ = ["BucketingModule"]
 
 
+def _inherit_optimizer(module, source):
+    """Share one optimizer/kvstore/updater across bucket modules (one
+    parameter set, many executors)."""
+    module.optimizer_initialized = True
+    module._optimizer = source._optimizer
+    module._kvstore = source._kvstore
+    module._update_on_kvstore = source._update_on_kvstore
+    module._updater = source._updater
+
+
 class BucketingModule(BaseModule):
     """reference: module/bucketing_module.py (BucketingModule)."""
 
@@ -156,6 +166,12 @@ class BucketingModule(BaseModule):
                         force_rebind=False,
                         shared_module=self._buckets[self._default_bucket_key],
                         grad_req=self._grad_req)
+            # a bucket created AFTER init_optimizer must inherit the shared
+            # optimizer/updater, or its update() would assert (reference:
+            # switch_bucket borrows the default bucket's optimizer state)
+            if self.optimizer_initialized:
+                _inherit_optimizer(module,
+                                   self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
@@ -171,11 +187,7 @@ class BucketingModule(BaseModule):
                                          force_init=force_init)
         for mod in self._buckets.values():
             if mod is not self._curr_module:
-                mod.optimizer_initialized = True
-                mod._optimizer = self._curr_module._optimizer
-                mod._kvstore = self._curr_module._kvstore
-                mod._update_on_kvstore = self._curr_module._update_on_kvstore
-                mod._updater = self._curr_module._updater
+                _inherit_optimizer(mod, self._curr_module)
         self.optimizer_initialized = True
 
     def prepare(self, data_batch, sparse_row_id_fn=None):

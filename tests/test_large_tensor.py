@@ -1,0 +1,67 @@
+"""Large-tensor (>2^31 elements) and int64-index coverage.
+
+reference: tests/nightly/test_large_array.py — the guarantee that ops
+survive tensors whose element count (or flat index) exceeds int32. The
+reference needs a 64-bit build flag (MXNET_INT64_TENSOR_SIZE); here XLA
+indexes with 64-bit arithmetic internally, and these tests pin that the
+framework surface (creation, reduction, slicing, gather with int64
+indices, argmax) stays correct past the 2^31 boundary. int8 payloads keep
+the footprint at ~2.2 GB so the CPU suite can afford one such tensor;
+marked slow.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+INT32_MAX = 2 ** 31
+
+
+@pytest.mark.slow
+def test_over_int32_elements_reduce_slice_index():
+    n = INT32_MAX + 128               # 2,147,483,776 elements, int8
+    x = nd.zeros((n,), dtype="int8")
+    assert x.size == n and x.size > INT32_MAX
+    # writes above the int32 boundary land where they should
+    x[n - 1] = 7
+    x[INT32_MAX + 5] = 3
+    total = int(x.sum(axis=0).asnumpy())     # int8 accum would overflow; op
+    assert total == 10                       # promotes internally
+    # slice across the boundary
+    tail = x[INT32_MAX:INT32_MAX + 8]
+    assert tail.shape == (8,)
+    assert int(tail.asnumpy()[5]) == 3
+    # argmax must report a position > int32
+    am = int(x.argmax(axis=0).asnumpy())
+    assert am == INT32_MAX + 5 or am == n - 1
+    del x
+
+
+@pytest.mark.slow
+def test_int64_index_gather_roundtrip():
+    n = INT32_MAX + 64
+    x = nd.zeros((n,), dtype="int8")
+    x[n - 2] = 9
+    idx = nd.array(onp.array([0, INT32_MAX + 1, n - 2], dtype="int64"),
+                   dtype="int64")
+    got = nd.take(x, idx).asnumpy()
+    onp.testing.assert_array_equal(got, [0, 0, 9])
+    del x
+
+
+def test_int64_indices_small_scale():
+    """int64 index dtype flows through take/gather_nd/one_hot at any
+    scale (the nightly's cheap invariant)."""
+    x = nd.array(onp.arange(12.0, dtype="float32").reshape(3, 4))
+    idx = nd.array(onp.array([2, 0], dtype="int64"), dtype="int64")
+    onp.testing.assert_array_equal(nd.take(x, idx, axis=0).asnumpy(),
+                                   x.asnumpy()[[2, 0]])
+    gidx = nd.array(onp.array([[0, 2], [1, 3]], dtype="int64").T,
+                    dtype="int64")
+    got = nd.gather_nd(x, gidx).asnumpy()
+    onp.testing.assert_array_equal(got, [x.asnumpy()[0, 1],
+                                         x.asnumpy()[2, 3]])
+    oh = mx.npx.one_hot(nd.array(onp.array([1, 3], "int64"),
+                                 dtype="int64"), 4)
+    assert oh.shape == (2, 4)

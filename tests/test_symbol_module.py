@@ -189,3 +189,45 @@ def test_sym_contrib_namespace():
     assert out.shape == (1, 2 * 2 * 2, 4)
     assert hasattr(sym.contrib, "interleaved_matmul_selfatt_qk")
     assert hasattr(sym.contrib, "box_nms")
+
+
+def test_bucketing_update_on_new_bucket_after_init_optimizer():
+    """A bucket created AFTER init_optimizer must inherit the shared
+    optimizer (regression: its update() asserted optimizer_initialized)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.io.io import DataBatch, DataDesc
+
+    def sym_gen(T):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        # bucket-independent parameter shapes (params are shared): pool
+        # over the time axis before the shared classifier
+        pooled = mx.sym.mean(data, axis=1, name="pool")
+        fc = mx.sym.FullyConnected(pooled, num_hidden=3, name="fcw")
+        return mx.sym.SoftmaxOutput(fc, label, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=6,
+                                 context=mx.cpu())
+    y = mx.nd.zeros((4,))
+
+    def batch(T):
+        b = DataBatch([mx.nd.ones((4, T, 4))], [y],
+                      provide_data=[DataDesc("data", (4, T, 4))],
+                      provide_label=[DataDesc("softmax_label", (4,))])
+        b.bucket_key = T
+        return b
+
+    mod.bind(data_shapes=batch(6).provide_data,
+             label_shapes=batch(6).provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    mod.forward(batch(6), is_train=True)
+    mod.backward()
+    mod.update()
+    # NEW bucket after init_optimizer: forward/backward/update must work
+    mod.forward(batch(3), is_train=True)
+    mod.backward()
+    mod.update()
+    assert mod.get_outputs()[0].shape == (4, 3)
