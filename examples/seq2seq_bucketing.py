@@ -95,9 +95,12 @@ def main():
     mod = mx.mod.BucketingModule(sym_gen,
                                  default_bucket_key=max(BUCKETS),
                                  context=ctx)
-    first = next(b for b in batches if b.bucket_key == max(BUCKETS))
-    mod.bind(data_shapes=first.provide_data,
-             label_shapes=first.provide_label)
+    # bind shapes come from the bucket geometry, not from whichever
+    # buckets the random batch draw happened to produce
+    T = max(BUCKETS)
+    mod.bind(
+        data_shapes=[DataDesc("data", (args.batch_size, T))],
+        label_shapes=[DataDesc("softmax_label", (args.batch_size, T))])
     mod.init_params(mx.init.Xavier())
     mod.init_optimizer(optimizer="adam",
                        optimizer_params={"learning_rate": 3e-3})

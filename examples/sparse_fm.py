@@ -77,9 +77,10 @@ def main():
         pair = 0.5 * nd.sum(nd.square(xv) - x2v2, axis=1, keepdims=True)
         return lin + pair + b
 
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=dim,
+                          batch_size=bs)
     for epoch in range(args.epochs):
-        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=dim,
-                              batch_size=bs)
+        it.reset()
         total, count, correct = 0.0, 0, 0
         for batch in it:
             csr = batch.data[0]

@@ -49,18 +49,32 @@ _CANONICAL_64 = {  # TPU-first: 32-bit canonical types (jax x64 disabled)
 }
 
 
+def x64_enabled():
+    """True inside mx.util.large_tensor_scope() (jax x64 on) — the single
+    gate every 64-bit-index decision keys off."""
+    try:
+        import jax
+        return bool(jax.config.jax_enable_x64)
+    except Exception:
+        return False
+
+
 def np_dtype(dtype):
     """Normalize any dtype-like (str, np.dtype, jax dtype) to np.dtype.
 
-    64-bit types canonicalize to their 32-bit counterparts (XLA x64 mode is
-    off by design: the MXU is a 32/16-bit engine; the reference's int64
-    `large_array` support is documented as out of TPU scope)."""
+    64-bit types canonicalize to their 32-bit counterparts (XLA x64 mode
+    is off by design: the MXU is a 32/16-bit engine) — EXCEPT inside
+    `mx.util.large_tensor_scope()`, where jax x64 is enabled and 64-bit
+    index types are the point (reference: the opt-in
+    MXNET_INT64_TENSOR_SIZE build)."""
     if dtype is None:
         return _np.dtype(_np.float32)
     if isinstance(dtype, str) and dtype == "bfloat16" and bfloat16 is not None:
         return bfloat16
     dt = _np.dtype(dtype)
-    return _CANONICAL_64.get(dt, dt)
+    if dt in _CANONICAL_64:
+        return dt if x64_enabled() else _CANONICAL_64[dt]
+    return dt
 
 
 # ---------------------------------------------------------------------------

@@ -69,8 +69,9 @@ def _argmax_channel(data):
 @register("batch_take", arity=2)
 def _batch_take(a, indices):
     """out[i] = a[i, indices[i]] (reference: matrix_op.cc batch_take)."""
+    from .tensor import _as_index
     return jnp.take_along_axis(
-        a, indices.astype(jnp.int32)[..., None], axis=1)[..., 0]
+        a, _as_index(indices)[..., None], axis=1)[..., 0]
 
 
 @register("fill_element_0index", arity=3, differentiable=False)

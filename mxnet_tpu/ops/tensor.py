@@ -236,15 +236,24 @@ def _norm(x, ord=2, axis=None, keepdims=False):
     return r.astype(x.dtype)
 
 
+def _index_float():
+    """Float dtype for mxnet's float-index convention. float32 is exact
+    only to 2^24; inside mx.util.large_tensor_scope() positions can
+    exceed 2^31, so the wide scope reports float64 (exact to 2^53)."""
+    from ..base import x64_enabled
+    return jnp.float64 if x64_enabled() else _f32
+
+
 @register("argmax", differentiable=False)
 def _argmax(x, axis=None, keepdims=False):
     r = jnp.argmax(x, axis=axis, keepdims=keepdims)
-    return r.astype(_f32)  # reference returns float indices
+    return r.astype(_index_float())  # reference returns float indices
 
 
 @register("argmin", differentiable=False)
 def _argmin(x, axis=None, keepdims=False):
-    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(_f32)
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(
+        _index_float())
 
 
 @register("argsort", differentiable=False)
@@ -561,16 +570,28 @@ def _khatri_rao(*mats):
 # ---------------------------------------------------------------------------
 # indexing (reference: src/operator/tensor/indexing_op.cc)
 # ---------------------------------------------------------------------------
+
+def _as_index(i):
+    """Index normalization: float indices (the mxnet convention) cast to
+    the platform index width — int64 inside mx.util.large_tensor_scope()
+    (x64 on), int32 otherwise. Integer inputs keep their width so int64
+    indices survive for >2^31-element gathers."""
+    from ..base import x64_enabled
+    i = jnp.asarray(i)
+    if jnp.issubdtype(i.dtype, jnp.integer):
+        return i
+    return i.astype(jnp.int64 if x64_enabled() else jnp.int32)
+
 @register("take")
 def _take(a, indices, axis=0, mode="clip"):
-    idx = indices.astype(jnp.int32)
+    idx = _as_index(indices)
     return jnp.take(a, idx, axis=axis,
                     mode="clip" if mode == "clip" else "wrap")
 
 
 @register("pick")
 def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    idx = jnp.clip(_as_index(index), 0, x.shape[axis] - 1)
     r = jnp.take_along_axis(x, jnp.expand_dims(idx, axis=axis), axis=axis)
     return r if keepdims else jnp.squeeze(r, axis=axis)
 
@@ -578,14 +599,14 @@ def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
 @register("gather_nd")
 def _gather_nd(data, indices):
     """reference: indexing_op.cc (gather_nd) — indices shape (M, ...)."""
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(_as_index(indices))
     return data[idx]
 
 
 @register("scatter_nd")
 def _scatter_nd(data, indices, shape=None):
     out = jnp.zeros(tuple(shape), dtype=data.dtype)
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(_as_index(indices))
     return out.at[idx].set(data)
 
 
@@ -605,7 +626,7 @@ def _embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
 
 @register("take_along_axis")
 def _take_along_axis(a, indices, axis=0):
-    return jnp.take_along_axis(a, indices.astype(jnp.int32), axis=axis)
+    return jnp.take_along_axis(a, _as_index(indices), axis=axis)
 
 
 @register("where_index", differentiable=False)

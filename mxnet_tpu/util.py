@@ -4,7 +4,8 @@ from __future__ import annotations
 import functools
 import os
 
-__all__ = ["makedirs", "getenv", "setenv", "set_np", "reset_np",
+__all__ = ["large_tensor_scope",
+           "makedirs", "getenv", "setenv", "set_np", "reset_np",
            "is_np_array", "is_np_shape", "use_np", "np_array", "np_shape",
            "default_array"]
 
@@ -93,3 +94,20 @@ def default_array(source, ctx=None, dtype=None):
         return np_ns.array(source, dtype=dtype, ctx=ctx)
     from .ndarray import array
     return array(source, ctx=ctx, dtype=dtype)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def large_tensor_scope():
+    """64-bit tensor indexing scope (reference: the
+    MXNET_INT64_TENSOR_SIZE build flag — large-tensor support is opt-in
+    upstream too). Inside the scope, index arithmetic is 64-bit, so
+    writes/gathers/argmax past the 2^31 element boundary are exact.
+    Kept scoped rather than global because x64 also flips jax's DEFAULT
+    dtypes (python floats become float64), which the TPU-native bf16/f32
+    path does not want."""
+    import jax
+    with jax.enable_x64(True):
+        yield
