@@ -59,6 +59,14 @@ def _block_attend(q, k, v, mask, sm_scale):
 # recompute probabilities from the GLOBAL logsumexp saved by the forward.
 # ---------------------------------------------------------------------------
 
+
+def _pvary(t, axis_name):
+    """Mark a constant as device-varying under shard_map. jax >= 0.9
+    renames lax.pvary to lax.pcast(..., to='varying')."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(t, (axis_name,), to="varying")
+    return lax.pvary(t, (axis_name,))
+
 def _merge_blocks(o_run, lse_run, o_blk, lse_blk):
     """Combine two normalized attention partials by their logsumexps."""
     m = jnp.maximum(lse_run, lse_blk)
@@ -105,7 +113,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale):
     o0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
     try:
-        o0, lse0 = (lax.pvary(t, (axis_name,)) for t in (o0, lse0))
+        o0, lse0 = (_pvary(t, axis_name) for t in (o0, lse0))
     except AttributeError:
         pass
     (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
@@ -168,8 +176,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, res, do):
     dk0 = jnp.zeros(k.shape, jnp.float32)
     dv0 = jnp.zeros(v.shape, jnp.float32)
     try:
-        dq0, dk0, dv0 = (lax.pvary(t, (axis_name,))
-                         for t in (dq0, dk0, dv0))
+        dq0, dk0, dv0 = (_pvary(t, axis_name) for t in (dq0, dk0, dv0))
     except AttributeError:
         pass
     (dq, _, _, dk, dv), _ = lax.scan(
@@ -230,7 +237,7 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
     # constants enter the scan carry device-varying (they become varying
     # through the masked block math) — mark them so under shard_map
     try:
-        acc0, m0, l0 = (lax.pvary(t, (axis_name,)) for t in (acc0, m0, l0))
+        acc0, m0, l0 = (_pvary(t, axis_name) for t in (acc0, m0, l0))
     except AttributeError:
         pass
     (acc, _, l, _, _), _ = lax.scan(

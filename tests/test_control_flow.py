@@ -120,9 +120,9 @@ def test_while_loop_single_var_and_zero_trip():
     net = Loop()
     net.initialize()
     x = nd.array(np.array([1.0], np.float32))
-    eager = float(net(x).asnumpy())
+    eager = float(net(x).asnumpy().item())
     net.hybridize()
-    hyb = float(net(x).asnumpy())
+    hyb = float(net(x).asnumpy().item())
     assert eager == hyb == 10.0    # 1 -> 4 -> 7 -> 10, stop
 
     # zero-trip
