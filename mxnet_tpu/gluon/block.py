@@ -155,6 +155,24 @@ def _regroup(args, fmt):
     return ret, args
 
 
+def _np_tag_outputs(out, args):
+    """np-mode output typing for Block.__call__: fresh results retag to
+    mx.np.ndarray; an output that IS one of the caller's inputs (identity
+    passthrough, e.g. Sequential plumbing) gets a non-mutating np view
+    instead — converting the caller's own legacy handle in place would
+    flip its semantics (hashability, bool comparisons, flatten)."""
+    from ..ndarray.ndarray import NDArray
+    if isinstance(out, (list, tuple)):
+        return type(out)(_np_tag_outputs(o, args) for o in out)
+    if isinstance(out, NDArray):
+        if any(out is a for a in args):
+            from ..numpy import _np_view
+            return _np_view(out)
+        from ..numpy.multiarray import as_np_ndarray
+        return as_np_ndarray(out)
+    return out
+
+
 class Block:
     """Base building block. reference: python/mxnet/gluon/block.py (Block)."""
 
@@ -437,10 +455,15 @@ class Block:
                 h.detach()
 
     def __call__(self, *args):
-        """Calls forward, running hooks. reference: Block.__call__."""
+        """Calls forward, running hooks. reference: Block.__call__.
+        Under npx.set_np() the outputs come back as mx.np.ndarray
+        (reference: Gluon speaks the numpy array type in np mode)."""
         for hook in self._forward_pre_hooks.values():
             hook(self, args)
         out = self.forward(*args)
+        from ..numpy_extension import is_np_array
+        if is_np_array():
+            out = _np_tag_outputs(out, args)
         for hook in self._forward_hooks.values():
             hook(self, args, out)
         return out

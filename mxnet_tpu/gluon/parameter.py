@@ -312,13 +312,27 @@ class Parameter:
         return self.list_data()
 
     def data(self, ctx=None):
-        """reference: Parameter.data."""
+        """reference: Parameter.data. Under npx.set_np() the handle comes
+        back np-typed (a zero-copy view: writes through it reach the
+        parameter payload, and the caller's legacy handle is untouched)."""
         if self._stype != "default":
             raise RuntimeError(
                 "Cannot return a copy of Parameter '%s' on ctx %s via data() "
                 "because its storage type is %s. Please use row_sparse_data() "
                 "instead." % (self.name, str(ctx), self._stype))
-        return self._check_and_get(self._data, ctx)
+        out = self._check_and_get(self._data, ctx)
+        from ..numpy_extension import is_np_array
+        if is_np_array():
+            from ..numpy import _np_view
+            view = _np_view(out)
+            # the tape routes gradients by leaf identity: the np view must
+            # carry the SAME grad marking and the SAME grad buffer object
+            # as the parameter payload, or np-mode backward() would
+            # silently drop parameter gradients
+            view._grad_req = out._grad_req
+            view._grad = out._grad
+            return view
+        return out
 
     def list_data(self):
         return self._check_and_get(self._data, list)

@@ -212,3 +212,89 @@ def test_np_linalg_multioutput_backward():
     z.backward()
     # d(sum eigvals)/dA = d(trace)/dA = I for symmetric A
     np.testing.assert_allclose(h.grad.asnumpy(), np.eye(3), atol=1e-4)
+
+
+def test_gluon_np_mode():
+    """npx.set_np(): Gluon blocks return mx.np.ndarray and Parameter.data
+    hands back an np-typed zero-copy view (reference: GluonNLP's np-mode
+    Gluon flow)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    x_np = mx.np.array(np.ones((2, 3), np.float32))
+    try:
+        mx.npx.set_np()
+        out = net(x_np)
+        assert type(out) is mx.np.ndarray
+        w = net.weight.data()
+        assert type(w) is mx.np.ndarray
+        # np view aliases the parameter payload (writes go through)
+        before = float(out.asnumpy().sum())
+        w[:] = w * 2.0
+        after = float(net(x_np).asnumpy().sum())
+        assert abs(after - 2.0 * before) < 1e-4
+        # hybridized path too
+        net2 = nn.Dense(4, in_units=3)
+        net2.initialize()
+        net2.hybridize()
+        assert type(net2(x_np)) is mx.np.ndarray
+    finally:
+        mx.npx.reset_np()
+    # legacy mode restored
+    out = net(mx.nd.ones((2, 3)))
+    assert type(out) is mx.nd.NDArray
+
+
+def test_gluon_np_mode_training_updates_params():
+    """np-mode gradients reach Parameter.grad and Trainer really moves
+    parameters (regression: the np view must share the grad buffer)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    try:
+        mx.npx.set_np()
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.5})
+        x = mx.np.array(np.ones((2, 4), np.float32))
+        w_before = net.weight.data().asnumpy().copy()
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        g = net.weight.grad()
+        assert float(np.abs(g.asnumpy()).sum()) > 0, \
+            "np-mode backward dropped parameter gradients"
+        tr.step(2)
+        w_after = net.weight.data().asnumpy()
+        assert not np.allclose(w_after, w_before), \
+            "np-mode Trainer.step did not move parameters"
+    finally:
+        mx.npx.reset_np()
+
+
+def test_gluon_np_mode_passthrough_does_not_mutate_caller():
+    """An identity-style forward must not retag the caller's legacy array
+    in place."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import Block
+
+    class Identity(Block):
+        def forward(self, x):
+            return x
+
+    try:
+        mx.npx.set_np()
+        x = mx.nd.ones((2, 2))
+        out = Identity()(x)
+        assert type(x) is mx.nd.NDArray        # caller untouched
+        assert type(out) is mx.np.ndarray      # output np-typed view
+        out[0, 0] = 5.0                        # aliasing goes through
+        assert float(x.asnumpy()[0, 0]) == 5.0
+    finally:
+        mx.npx.reset_np()
