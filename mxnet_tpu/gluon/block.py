@@ -157,20 +157,39 @@ def _regroup(args, fmt):
 
 def _np_tag_outputs(out, args):
     """np-mode output typing for Block.__call__: fresh results retag to
-    mx.np.ndarray; an output that IS one of the caller's inputs (identity
-    passthrough, e.g. Sequential plumbing) gets a non-mutating np view
-    instead — converting the caller's own legacy handle in place would
-    flip its semantics (hashability, bool comparisons, flatten)."""
+    mx.np.ndarray; an output that IS one of the caller's inputs —
+    directly or inside a nested container (identity passthrough, e.g.
+    Sequential plumbing) — gets a non-mutating np view instead, because
+    converting the caller's own legacy handle in place would flip its
+    semantics (hashability, bool comparisons, flatten). The view carries
+    the output's tape node so backprop through a passthrough survives."""
     from ..ndarray.ndarray import NDArray
-    if isinstance(out, (list, tuple)):
-        return type(out)(_np_tag_outputs(o, args) for o in out)
-    if isinstance(out, NDArray):
-        if any(out is a for a in args):
-            from ..numpy import _np_view
-            return _np_view(out)
-        from ..numpy.multiarray import as_np_ndarray
-        return as_np_ndarray(out)
-    return out
+
+    caller_owned = set()
+
+    def _collect(a):
+        if isinstance(a, NDArray):
+            caller_owned.add(id(a))
+        elif isinstance(a, (list, tuple)):
+            for x in a:
+                _collect(x)
+    _collect(args)
+
+    def _tag(o):
+        if isinstance(o, (list, tuple)):
+            return type(o)(_tag(x) for x in o)
+        if isinstance(o, NDArray):
+            if id(o) in caller_owned:
+                from ..numpy import _np_view
+                view = _np_view(o)
+                view._autograd_node = o._autograd_node
+                view._grad_req = o._grad_req
+                view._grad = o._grad
+                return view
+            from ..numpy.multiarray import as_np_ndarray
+            return as_np_ndarray(o)
+        return o
+    return _tag(out)
 
 
 class Block:

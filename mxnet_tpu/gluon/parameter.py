@@ -324,11 +324,17 @@ class Parameter:
         from ..numpy_extension import is_np_array
         if is_np_array():
             from ..numpy import _np_view
-            view = _np_view(out)
-            # the tape routes gradients by leaf identity: the np view must
-            # carry the SAME grad marking and the SAME grad buffer object
-            # as the parameter payload, or np-mode backward() would
-            # silently drop parameter gradients
+            # ONE view per payload object: the tape routes and ACCUMULATES
+            # gradients by leaf identity, so a parameter used at several
+            # sites in one recorded graph must present the same leaf every
+            # time data() is called (fresh views would each get a partial
+            # cotangent and overwrite the shared grad buffer)
+            cache = getattr(self, "_np_view_cache", None)
+            if cache is None or cache[0] is not out:
+                cache = (out, _np_view(out))
+                self._np_view_cache = cache
+            view = cache[1]
+            # grad marking can change after attach_grad/zero_grad swaps
             view._grad_req = out._grad_req
             view._grad = out._grad
             return view

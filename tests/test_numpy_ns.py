@@ -298,3 +298,90 @@ def test_gluon_np_mode_passthrough_does_not_mutate_caller():
         assert float(x.asnumpy()[0, 0]) == 5.0
     finally:
         mx.npx.reset_np()
+
+
+def test_dataloader_np_mode():
+    """np-mode DataLoader yields mx.np batches (reference: np-mode data
+    pipeline)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    ds = gluon.data.ArrayDataset(
+        np.arange(12, dtype=np.float32).reshape(6, 2),
+        np.arange(6, dtype=np.float32))
+    try:
+        mx.npx.set_np()
+        loader = gluon.data.DataLoader(ds, batch_size=3)
+        xb, yb = next(iter(loader))
+        assert type(xb) is mx.np.ndarray and type(yb) is mx.np.ndarray
+        assert xb.shape == (3, 2)
+    finally:
+        mx.npx.reset_np()
+    loader = gluon.data.DataLoader(ds, batch_size=3)
+    xb, _ = next(iter(loader))
+    assert type(xb) is mx.nd.NDArray
+
+
+def test_np_mode_shared_param_two_sites_accumulates():
+    """A parameter used at two sites in one recorded graph must see the
+    SUM of both cotangents in np mode (regression: per-call views made
+    two leaves whose writes overwrote each other)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    try:
+        mx.npx.set_np()
+        net = nn.Dense(1, in_units=2, use_bias=False)
+        net.initialize()
+        a = mx.np.array(np.array([[1.0, 0.0]], np.float32))
+        b = mx.np.array(np.array([[0.0, 1.0]], np.float32))
+        with autograd.record():
+            loss = (net(a) + net(b)).sum()
+        loss.backward()
+        g = net.weight.grad().asnumpy()
+        # d loss/dW = a + b = [1, 1] — both use sites must contribute
+        np.testing.assert_allclose(g, [[1.0, 1.0]], atol=1e-6)
+    finally:
+        mx.npx.reset_np()
+
+
+def test_np_mode_container_passthrough_not_mutated():
+    """Passthrough of an element of a container argument must not retag
+    the caller's array either."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import Block
+
+    class First(Block):
+        def forward(self, pair):
+            return pair[0]
+
+    try:
+        mx.npx.set_np()
+        a = mx.nd.ones((2,))
+        b = mx.nd.zeros((2,))
+        out = First()([a, b])
+        assert type(a) is mx.nd.NDArray
+        assert type(out) is mx.np.ndarray
+    finally:
+        mx.npx.reset_np()
+
+
+def test_dataloader_np_mode_multiworker():
+    """np typing holds on the worker path too (shm/pickle delivery)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    ds = gluon.data.ArrayDataset(
+        np.arange(12, dtype=np.float32).reshape(6, 2),
+        np.arange(6, dtype=np.float32))
+    try:
+        mx.npx.set_np()
+        loader = gluon.data.DataLoader(ds, batch_size=3, num_workers=1)
+        xb, yb = next(iter(loader))
+        assert type(xb) is mx.np.ndarray and type(yb) is mx.np.ndarray
+    finally:
+        mx.npx.reset_np()
