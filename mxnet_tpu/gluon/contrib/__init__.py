@@ -2,5 +2,6 @@
 from . import cnn
 from . import nn
 from . import rnn
+from . import estimator
 
-__all__ = ["cnn", "nn", "rnn"]
+__all__ = ["cnn", "nn", "rnn", "estimator"]
