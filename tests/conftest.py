@@ -10,7 +10,15 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = flags + " --xla_force_host_platform_device_count=8"
+if "collective_call_terminate_timeout" not in flags:
+    # one host core runs all 8 virtual devices serially: XLA:CPU's default
+    # 40 s collective-rendezvous watchdog CHECK-aborts whole test runs
+    # whenever per-shard compute skews arrivals (seen on the big-shape
+    # mesh tests under suite load)
+    flags = (flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+             " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+os.environ["XLA_FLAGS"] = flags.strip()
 
 # The axon sitecustomize re-registers its TPU backend and resets
 # jax_platforms AFTER env vars are read, so the env var alone is not enough —
