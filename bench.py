@@ -296,8 +296,62 @@ def bench_fused_stage(on_accel):
     return fast, base
 
 
+def _probe_backend(timeout=240):
+    """Initialize the default backend with a hang guard. The axon PjRt
+    tunnel blocks indefinitely in make_c_api_client when the relay is
+    down (observed for the whole 2026-07-30 session); a bench run must
+    then fall back to an HONESTLY-NAMED cpu smoke row instead of hanging
+    until the driver kills it (rc!=0, no data at all).
+
+    The probe runs in a SUBPROCESS: an in-process probe thread that
+    hangs in backend init would hold jax's global backend lock forever,
+    deadlocking the cpu fallback too. The probe child gets its own
+    process group (killpg on timeout — a tunnel helper grandchild
+    holding the stdout pipe would otherwise hang the guard itself), and
+    the parent's real init runs under a hard watchdog so a relay that
+    flaps between probe and init exits promptly with a diagnosis
+    instead of reproducing the indefinite hang."""
+    import os as _os
+    import signal
+    import subprocess
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices(); print('up')"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        up = proc.returncode == 0 and "up" in (out or "")
+        reason = "probe rc=%s" % proc.returncode
+    except subprocess.TimeoutExpired:
+        up = False
+        reason = "timeout after %ds" % timeout
+        try:
+            _os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.communicate()
+    if up:
+        # the backend was reachable moments ago; guard the real init
+        # against a flap in between (rc 3 beats an eternal hang)
+        watchdog = threading.Timer(120, lambda: (
+            print("# backend flapped between probe and init — aborting",
+                  file=sys.stderr), _os._exit(3)))
+        watchdog.daemon = True
+        watchdog.start()
+        try:
+            return jax.devices()[0]
+        finally:
+            watchdog.cancel()
+    print("# accelerator backend unreachable (%s) — falling back to cpu"
+          % reason, file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices()[0]
+
+
 def main():
-    dev = jax.devices()[0]
+    dev = _probe_backend()
     on_accel = dev.platform != "cpu"
     which = os.environ.get("BENCH", "gluon")
     if which == "fused":
