@@ -89,7 +89,7 @@ def _out_struct(shape, dtype, *args):
     (vma): required when the kernels run inside shard_map (the ring path)
     under jax>=0.9's check_vma."""
     try:
-        vma = frozenset().union(*[jax.core.get_aval(a).vma for a in args])
+        vma = frozenset().union(*[jax.typeof(a).vma for a in args])
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     except Exception:
         return jax.ShapeDtypeStruct(shape, dtype)
@@ -392,6 +392,8 @@ def _use_pallas(q, k):
     # lane-friendly head dim; seq lengths are masked in-kernel so any
     # Sq/Sk works. GQA requires an integer group (a non-divisible head
     # count would make the kv BlockSpec silently clamp to a wrong head).
+    if os.environ.get("MXNET_FLASH_DISABLE", "0") == "1":
+        return False            # force the plain-XLA path (A/B probes)
     D = q.shape[3]
     shapes_ok = D % 8 == 0 and q.shape[1] % k.shape[1] == 0
     if _interpret():

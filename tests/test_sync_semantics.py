@@ -29,16 +29,22 @@ def _slow_chain(x, iters=60):
 def test_wait_to_read_blocks_until_execution_done():
     rng = onp.random.RandomState(0)
     x = nd.array(rng.rand(400, 400).astype("float32") * 0.01)
-    # warm the compile cache so the timed run measures execution, not trace
-    _slow_chain(x).wait_to_read()
-
-    t0 = time.perf_counter()
-    y = _slow_chain(x)
-    t_dispatch = time.perf_counter() - t0
-
-    t1 = time.perf_counter()
-    y.wait_to_read()
-    t_wait = time.perf_counter() - t1
+    # Adapt the chain length until measured execution sits comfortably
+    # above timer noise — a machine fast enough to finish 60 iters in
+    # <200ms gets a longer chain instead of a flaky ratio assert.
+    iters = 60
+    for _ in range(5):
+        # warm the compile cache so the timed run measures execution
+        _slow_chain(x, iters).wait_to_read()
+        t0 = time.perf_counter()
+        y = _slow_chain(x, iters)
+        t_dispatch = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        y.wait_to_read()
+        t_wait = time.perf_counter() - t1
+        if t_dispatch + t_wait >= 0.2:
+            break
+        iters *= 2
 
     t2 = time.perf_counter()
     _ = y.asnumpy()
