@@ -7,7 +7,18 @@ context-parametric the same way via MXNET_TEST_DEVICE).
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+_accel_run = (os.environ.get("MXNET_TEST_DEVICE", "cpu").split("(")[0]
+              in ("tpu", "gpu"))
+if not _accel_run:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+else:
+    # On-chip suite run (MXNET_TEST_DEVICE=tpu): keep the real accelerator
+    # backend registered — the host cpu backend coexists for the
+    # cpu-vs-accel consistency sweep — and let the mesh helpers fall back
+    # to the 8 virtual host devices for multi-device tests the single
+    # chip can't satisfy (reference: gpu suite re-runs on gpu(0) while
+    # multi-GPU tests stay on their own rigs, SURVEY §4).
+    os.environ.setdefault("MXNET_MESH_HOST_FALLBACK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     flags = flags + " --xla_force_host_platform_device_count=8"
@@ -25,7 +36,8 @@ os.environ["XLA_FLAGS"] = flags.strip()
 # force the config back to cpu before any backend initializes.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _accel_run:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

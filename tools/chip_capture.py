@@ -1,0 +1,260 @@
+"""Window-resilient chip-evidence capture (round-5 VERDICT task 1).
+
+The axon tunnel has been up twice in four rounds; live windows are a
+scarce resource. This watcher probes the accelerator cheaply (subprocess
+with its own process group, hard-killed on timeout — the tunnel hangs
+`jax.devices()` in make_c_api_client when the relay is down, BASELINE.md
+round-3 notes) and, the moment a window opens, runs the BASELINE.md chip
+queue IN ORDER with per-item timeouts and incremental artifact writes, so
+a mid-queue drop still leaves everything captured up to that point.
+
+Queue (BASELINE.md "chip queue", round-4 ordering):
+  1. bench_gluon        python bench.py                (headline)
+  2. bench_gluon_nhwc   BENCH=gluon_nhwc python bench.py
+                        -> writes chip_artifacts/NHWC_PROMOTE if the NHWC
+                           row clears the 2,250 bar and beats NCHW
+  3. bench_bert         BENCH=bert python bench.py
+  4. bench_bert_gluon   BENCH=bert_gluon python bench.py
+  5. bench_functional   BENCH=functional python bench.py
+  6. bench_fused        BENCH=fused python bench.py    (cost bytes on stderr)
+  7. longcontext        python tools/longcontext_probe.py   (seq 4096 A/B)
+  8. tpu_suite          MXNET_TEST_DEVICE=tpu pytest tests/ -q
+                        -> summary recorded to TESTS_r05_tpu.json
+
+Artifacts: CHIP_CAPTURE_r05.json (incremental, one entry per item) plus
+full stdout/stderr per item under chip_artifacts/. Items that fail or
+time out are retried on the next live window; completed items are not
+re-run (delete CHIP_CAPTURE_r05.json to start over).
+
+Usage:
+  python tools/chip_capture.py [--hours 11] [--probe-interval 180]
+  python tools/chip_capture.py --once        # single probe+queue attempt
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART_DIR = os.path.join(REPO, "chip_artifacts")
+STATE = os.path.join(REPO, "CHIP_CAPTURE_r05.json")
+BAR_IMG_S = 2250.0
+
+QUEUE = [
+    # MXNET_HEADLINE_LAYOUT=NCHW: the baseline row must stay NCHW even
+    # after a prior window wrote the NHWC_PROMOTE marker, or the
+    # promotion comparison becomes NHWC-vs-NHWC and can never be
+    # re-falsified
+    ("bench_gluon", [sys.executable, "bench.py"],
+     {"MXNET_HEADLINE_LAYOUT": "NCHW"}, 2400),
+    ("bench_gluon_nhwc", [sys.executable, "bench.py"],
+     {"BENCH": "gluon_nhwc"}, 2400),
+    ("bench_bert", [sys.executable, "bench.py"], {"BENCH": "bert"}, 2400),
+    ("bench_bert_gluon", [sys.executable, "bench.py"],
+     {"BENCH": "bert_gluon"}, 2400),
+    ("bench_functional", [sys.executable, "bench.py"],
+     {"BENCH": "functional"}, 1800),
+    ("bench_fused", [sys.executable, "bench.py"], {"BENCH": "fused"}, 1800),
+    ("longcontext", [sys.executable, "tools/longcontext_probe.py"], {},
+     3900),
+    ("tpu_suite", [sys.executable, "-m", "pytest", "tests/", "-q"],
+     {"MXNET_TEST_DEVICE": "tpu"}, 9000),
+]
+
+
+def log(msg):
+    print("[chip_capture %s] %s"
+          % (time.strftime("%H:%M:%S"), msg), flush=True)
+
+
+def load_state():
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            return json.load(f)
+    return {"items": {}, "started": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime())}
+
+
+def save_state(state):
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1, sort_keys=True)
+    os.replace(tmp, STATE)
+
+
+def run_killable(cmd, env_extra, timeout, out_path, err_path):
+    """Run cmd in its own process group; SIGKILL the whole group on
+    timeout (a tunnel-helper grandchild holding the pipe would otherwise
+    hang the reader — bench.py f476311 lesson)."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    with open(out_path, "w") as out, open(err_path, "w") as err:
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=out,
+                                stderr=err, start_new_session=True)
+        try:
+            rc = proc.wait(timeout=timeout)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            rc, timed_out = None, True
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+    return rc, timed_out
+
+
+def probe(timeout=90):
+    """True if the accelerator backend answers within `timeout`."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices()[0]; print('LIVE', d.platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True, cwd=REPO)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.communicate()
+        return False
+    return proc.returncode == 0 and "LIVE" in (out or "") \
+        and "cpu" not in (out or "")
+
+
+def last_json_line(path):
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip().startswith("{")]
+        return json.loads(lines[-1]) if lines else None
+    except Exception:
+        return None
+
+
+def maybe_promote_nhwc(state):
+    """(Re-)evaluate the NHWC headline promotion whenever both layout
+    measurements exist — also demotes a stale marker if NCHW now wins."""
+    items = state["items"]
+    g = items.get("bench_gluon", {}).get("json") or {}
+    n = items.get("bench_gluon_nhwc", {}).get("json") or {}
+    if not (g.get("value") and n.get("value")):
+        return
+    marker = os.path.join(ART_DIR, "NHWC_PROMOTE")
+    if n["value"] >= BAR_IMG_S and n["value"] >= g["value"]:
+        with open(marker, "w") as f:
+            json.dump({"nhwc": n["value"], "nchw": g["value"],
+                       "bar": BAR_IMG_S}, f)
+        log("NHWC PROMOTED: %.1f img/s (NCHW %.1f, bar %.0f)"
+            % (n["value"], g["value"], BAR_IMG_S))
+    else:
+        if os.path.exists(marker):
+            os.remove(marker)
+            log("stale NHWC_PROMOTE removed")
+        log("NHWC not promoted: nhwc=%.1f nchw=%.1f bar=%.0f"
+            % (n["value"], g["value"], BAR_IMG_S))
+
+
+def write_suite_artifact(state):
+    item = state["items"].get("tpu_suite")
+    if not item or item.get("status") != "ok":
+        return
+    tail = ""
+    try:
+        with open(os.path.join(ART_DIR, "tpu_suite.out")) as f:
+            tail = "".join(f.readlines()[-30:])
+    except OSError:
+        pass
+    with open(os.path.join(REPO, "TESTS_r05_tpu.json"), "w") as f:
+        json.dump({"device": "tpu", "rc": item["rc"],
+                   "seconds": item["seconds"],
+                   "captured_at": item["captured_at"],
+                   "summary_tail": tail}, f, indent=1)
+
+
+def run_queue(state):
+    """Run every incomplete queue item; returns True when all are done."""
+    os.makedirs(ART_DIR, exist_ok=True)
+    for name, cmd, env_extra, timeout in QUEUE:
+        if state["items"].get(name, {}).get("status") == "ok":
+            continue
+        log("running %s (timeout %ds)" % (name, timeout))
+        out_path = os.path.join(ART_DIR, name + ".out")
+        err_path = os.path.join(ART_DIR, name + ".err")
+        t0 = time.time()
+        rc, timed_out = run_killable(cmd, env_extra, timeout, out_path,
+                                     err_path)
+        entry = {
+            "rc": rc,
+            "seconds": round(time.time() - t0, 1),
+            "status": "timeout" if timed_out else
+                      ("ok" if rc == 0 else "failed"),
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "json": last_json_line(out_path),
+        }
+        # a run that fell back to cpu is NOT chip evidence — mark it so
+        # it re-runs next window (bench metrics carry 'cpu' in the name;
+        # the longcontext summary carries a platform field)
+        j = entry["json"] or {}
+        if entry["status"] == "ok" and ("cpu" in str(j.get("metric", ""))
+                                        or j.get("platform") == "cpu"):
+            entry["status"] = "cpu_fallback"
+        state["items"][name] = entry
+        save_state(state)
+        log("%s -> %s (%.0fs) %s"
+            % (name, entry["status"], entry["seconds"],
+               json.dumps(j) if j else ""))
+        if name in ("bench_gluon", "bench_gluon_nhwc"):
+            maybe_promote_nhwc(state)
+        if name == "tpu_suite":
+            write_suite_artifact(state)
+        if entry["status"] in ("timeout", "cpu_fallback"):
+            # tunnel likely dropped mid-queue: verify before burning the
+            # next item's timeout on a dead backend
+            if not probe():
+                log("backend dropped mid-queue — back to watching")
+                return False
+    return all(state["items"].get(n, {}).get("status") == "ok"
+               for n, *_ in QUEUE)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=float, default=11.0)
+    ap.add_argument("--probe-interval", type=int, default=180)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.hours * 3600
+    state = load_state()
+    log("watching for a chip window (deadline in %.1fh; %d/%d items done)"
+        % (args.hours, sum(1 for n, *_ in QUEUE
+                           if state["items"].get(n, {}).get("status")
+                           == "ok"), len(QUEUE)))
+    while time.time() < deadline:
+        if probe():
+            log("chip window OPEN — running queue")
+            if run_queue(state):
+                log("queue COMPLETE — all items captured")
+                return 0
+        elif args.once:
+            log("probe: backend unreachable")
+            return 1
+        if args.once:
+            return 1
+        time.sleep(args.probe_interval)
+    log("deadline reached; %d/%d items captured"
+        % (sum(1 for n, *_ in QUEUE
+               if state["items"].get(n, {}).get("status") == "ok"),
+           len(QUEUE)))
+    return 0 if all(state["items"].get(n, {}).get("status") == "ok"
+                    for n, *_ in QUEUE) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
