@@ -394,9 +394,11 @@ def main():
         # overrides the marker (the capture's baseline row must stay NCHW).
         marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "chip_artifacts", "NHWC_PROMOTE")
+        # on_accel gate: the cpu smoke row must stay NCHW so its metric
+        # stays comparable with every historical cpu row
         layout = os.environ.get(
             "MXNET_HEADLINE_LAYOUT",
-            "NHWC" if os.path.exists(marker) else "NCHW")
+            "NHWC" if on_accel and os.path.exists(marker) else "NCHW")
         if layout == "NHWC":
             print("# headline layout: NHWC (promoted by chip capture)",
                   file=sys.stderr)

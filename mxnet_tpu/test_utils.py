@@ -30,6 +30,14 @@ _DEFAULT_ATOL = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-5,
                  np.dtype(np.int32): 0, np.dtype(np.uint8): 0}
 
 
+def is_accel_test_device():
+    """True when the suite is an on-chip run (MXNET_TEST_DEVICE=tpu|gpu).
+    Single source of truth — tests/conftest.py re-derives it inline only
+    because it must run before any mxnet_tpu/jax import."""
+    return (os.environ.get("MXNET_TEST_DEVICE", "cpu").split("(")[0]
+            in ("tpu", "gpu"))
+
+
 def default_context():
     """reference: test_utils.py (default_context) — env-switchable so one
     suite runs on every device type (MXNET_TEST_DEVICE=cpu|tpu)."""

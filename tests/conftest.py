@@ -38,6 +38,16 @@ import jax
 
 if not _accel_run:
     jax.config.update("jax_platforms", "cpu")
+else:
+    # Fail FAST and LOUD if the accelerator silently fell back to the
+    # host: a green "on-chip" suite on 8 virtual CPUs would be fake
+    # evidence. chip_capture.write_suite_artifact greps this line.
+    _backend = jax.default_backend()
+    print("on-chip suite backend:", _backend, flush=True)
+    assert _backend != "cpu", (
+        "MXNET_TEST_DEVICE=%s but jax initialized the cpu backend — "
+        "refusing to record a host run as on-chip evidence"
+        % os.environ["MXNET_TEST_DEVICE"])
 
 import numpy as np
 import pytest

@@ -17,9 +17,12 @@ from mxnet_tpu.ops import fused_conv as fc
 @pytest.fixture(autouse=True)
 def _interpret_mode(monkeypatch):
     # host runs interpret the kernel; the on-chip run compiles it natively
-    # for the MXU (round-4 VERDICT weak #2)
-    if os.environ.get("MXNET_TEST_DEVICE", "cpu").split("(")[0] not in (
-            "tpu", "gpu"):
+    # for the MXU (round-4 VERDICT weak #2) — and must clear an inherited
+    # interpret flag so the native path can't be silently skipped
+    from mxnet_tpu.test_utils import is_accel_test_device
+    if is_accel_test_device():
+        monkeypatch.delenv("MXNET_FLASH_INTERPRET", raising=False)
+    else:
         monkeypatch.setenv("MXNET_FLASH_INTERPRET", "1")
     yield
 

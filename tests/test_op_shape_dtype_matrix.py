@@ -1,0 +1,365 @@
+"""Shape/dtype matrix over the op library (round-4 VERDICT task #3).
+
+reference: tests/python/unittest/test_operator.py is 8K+ lines largely
+because shape/dtype edges are where op bugs live (the round-4 int64
+truncation find proves the point here too). The registry sweep
+(test_registry_grad_sweep.py) pins one (3,4) fp32 spec per op; this file
+adds the edge matrix for the ~100 most-used ops:
+
+  shapes: {0-size, 1-element, odd-rank, high-rank-with-degenerate-dim}
+  dtypes: {float32 (+gradient FD), bfloat16, float16} for elementwise,
+          {int32, int64} forwards for index ops, ints vs numpy for the
+          np bit ops.
+
+Checks per cell: forward runs, output shape/dtype is right, values match
+the fp32 reference (low-precision) or real numpy (int/bit ops), and for
+fp32 cells the tape gradient passes the same directional finite-difference
+check the sweep uses — including through 0-size tensors and
+broadcast-degenerate operands (the classic sum-reduction backward bug).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.ndarray import invoke
+
+from test_registry_grad_sweep import _run_check
+
+RNG = onp.random.RandomState(7)
+
+# shape cases (VERDICT: 0-size, 1-element, odd/high-rank,
+# broadcast-degenerate)
+SHAPES = {
+    "zero_size": (0, 4),
+    "one_elem": (1,),
+    "odd_rank": (7, 5, 3),
+    "high_rank_degenerate": (2, 3, 1, 4, 5),
+}
+
+# unary elementwise ops: name -> sampling domain (lo, hi); None = (0.6, 1.4)
+UNARY = {
+    "abs": None, "arccos": (-0.8, -0.2), "arccosh": (1.5, 3.0),
+    "arcsin": (-0.8, -0.2), "arcsinh": None, "arctan": None,
+    "arctanh": (-0.8, -0.2), "cbrt": None, "cos": None, "cosh": None,
+    "degrees": None, "erf": None, "erfinv": (0.1, 0.7), "exp": None,
+    "expm1": None, "gamma": (1.5, 3.0), "gammaln": (1.5, 3.0),
+    "hard_sigmoid": None, "identity": None, "log": (0.5, 2.0),
+    "log10": (0.5, 2.0), "log1p": None, "log2": (0.5, 2.0),
+    "negative": None, "radians": None, "rcbrt": (0.5, 2.0),
+    "reciprocal": (0.5, 2.0), "relu": None, "rsqrt": (0.5, 2.0),
+    "sigmoid": None, "sin": None, "sinh": None, "softsign": None,
+    "sqrt": (0.5, 2.0), "square": None, "tan": (0.1, 0.9), "tanh": None,
+}
+# step/round-like: forward-only (derivative zero a.e., FD meaningless)
+UNARY_FWD_ONLY = {
+    "ceil": None, "fix": None, "floor": None, "isfinite": None,
+    "isinf": None, "isnan": None, "logical_not": None, "rint": None,
+    "round": None, "sign": None, "trunc": None,
+}
+
+# binary ops that broadcast: checked with degenerate operand pairs
+BINARY = {
+    "broadcast_add": None, "broadcast_sub": None, "broadcast_mul": None,
+    "broadcast_div": (0.5, 1.5), "broadcast_maximum": None,
+    "broadcast_minimum": None, "broadcast_power": (0.6, 1.4),
+    "broadcast_hypot": None, "arctan2": None,
+}
+BINARY_FWD_ONLY = {
+    "broadcast_equal": None, "broadcast_not_equal": None,
+    "broadcast_greater": None, "broadcast_greater_equal": None,
+    "broadcast_lesser": None, "broadcast_lesser_equal": None,
+    "broadcast_logical_and": None, "broadcast_logical_or": None,
+    "broadcast_logical_xor": None,
+}
+# broadcast-degenerate operand shape pairs and the broadcast result
+BINARY_SHAPES = {
+    "deg_2d": ((3, 1), (1, 4), (3, 4)),
+    "deg_rank_mix": ((2, 1, 4), (5, 1), (2, 5, 4)),
+    "zero_size": ((0, 1), (1, 4), (0, 4)),
+    "one_elem": ((1,), (1,), (1,)),
+}
+
+# reductions: axis-kwarg'd; zero-size only where the identity exists
+REDUCE = {
+    "sum": {},
+    "mean": {},
+    "nansum": {},
+    "prod": {},
+    "nanprod": {},
+    "max": {"axis": 0},
+    "min": {"axis": 0},
+    "norm": {},
+    "logsumexp": {},
+}
+REDUCE_ZERO_OK = {"sum", "nansum", "prod", "nanprod"}
+
+LOW_PRECISION = ["bfloat16", "float16"]
+# |x|<=3 domains above => absolute error of bf16 elementwise ~2^-8*|f|;
+# fp16 ~2^-11*|f|. gamma at 3.0 reaches ~2.0; tol is on the output.
+LP_TOL = {"bfloat16": dict(rtol=3e-2, atol=3e-2),
+          "float16": dict(rtol=5e-3, atol=5e-3)}
+
+
+def _arr(shape, domain, dtype="float32", seed=None):
+    rng = RNG if seed is None else onp.random.RandomState(seed)
+    lo, hi = domain or (0.6, 1.4)
+    return rng.uniform(lo, hi, size=shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# unary: shape matrix (fp32, with gradient where differentiable)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("case", sorted(SHAPES))
+@pytest.mark.parametrize("name", sorted(UNARY) + sorted(UNARY_FWD_ONLY))
+def test_unary_shape_matrix(name, case):
+    domain = UNARY.get(name, UNARY_FWD_ONLY.get(name))
+    shape = SHAPES[case]
+    x = _arr(shape, domain, seed=3)
+    out = invoke(name, nd.array(x))
+    assert tuple(out.shape) == shape, (
+        "%s(%s): shape %s" % (name, shape, out.shape))
+    got = out.asnumpy()
+    if got.dtype.kind == "f":
+        assert onp.isfinite(got).all(), "%s(%s): non-finite" % (name, shape)
+    if name in UNARY:
+        # full tape + directional-FD gradient at this shape (0-size
+        # included: backward must run and produce a 0-size grad)
+        _run_check(name, [x], {})
+
+
+# ---------------------------------------------------------------------------
+# unary: low-precision forward vs the fp32 reference
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", LOW_PRECISION)
+@pytest.mark.parametrize("name", sorted(UNARY))
+def test_unary_low_precision(name, dtype):
+    x32 = _arr((7, 5, 3), UNARY[name], seed=5)
+    xlp = nd.array(x32).astype(dtype)
+    out = invoke(name, xlp)
+    ref = invoke(name, nd.array(x32)).asnumpy()
+    got = out.asnumpy().astype("float32")
+    if str(out.dtype) not in ("bool",):
+        assert str(out.dtype) == dtype, (
+            "%s: %s input produced %s output" % (name, dtype, out.dtype))
+    onp.testing.assert_allclose(got, ref, err_msg="%s/%s" % (name, dtype),
+                                **LP_TOL[dtype])
+
+
+# ---------------------------------------------------------------------------
+# binary broadcast: degenerate operands, gradient through the reduction
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("case", sorted(BINARY_SHAPES))
+@pytest.mark.parametrize("name", sorted(BINARY) + sorted(BINARY_FWD_ONLY))
+def test_binary_broadcast_matrix(name, case):
+    domain = BINARY.get(name, BINARY_FWD_ONLY.get(name))
+    sa, sb, sout = BINARY_SHAPES[case]
+    a = _arr(sa, domain, seed=11)
+    b = _arr(sb, domain, seed=13)
+    out = invoke(name, nd.array(a), nd.array(b))
+    assert tuple(out.shape) == sout, (
+        "%s(%s,%s): shape %s != %s" % (name, sa, sb, out.shape, sout))
+    if name in BINARY:
+        # FD through BOTH inputs: the backward must sum-reduce the
+        # cotangent back to each degenerate operand shape
+        _run_check(name, [a, b], {})
+
+
+@pytest.mark.parametrize("dtype", LOW_PRECISION)
+@pytest.mark.parametrize("name", sorted(BINARY))
+def test_binary_low_precision(name, dtype):
+    domain = BINARY[name]
+    a32 = _arr((3, 1), domain, seed=17)
+    b32 = _arr((1, 4), domain, seed=19)
+    out = invoke(name, nd.array(a32).astype(dtype),
+                 nd.array(b32).astype(dtype))
+    ref = invoke(name, nd.array(a32), nd.array(b32)).asnumpy()
+    assert str(out.dtype) == dtype
+    onp.testing.assert_allclose(out.asnumpy().astype("float32"), ref,
+                                err_msg="%s/%s" % (name, dtype),
+                                **LP_TOL[dtype])
+
+
+# ---------------------------------------------------------------------------
+# reductions: shape matrix + keepdims + zero-size identities
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("case", ["one_elem", "odd_rank",
+                                  "high_rank_degenerate", "zero_size"])
+@pytest.mark.parametrize("name", sorted(REDUCE))
+def test_reduce_shape_matrix(name, case):
+    if case == "zero_size" and name not in REDUCE_ZERO_OK:
+        pytest.skip("%s has no identity over an empty axis" % name)
+    shape = SHAPES[case]
+    x = _arr(shape, (0.6, 1.4), seed=23)
+    kwargs = dict(REDUCE[name])
+    out = invoke(name, nd.array(x), **kwargs)
+    ref_fn = {"sum": onp.sum, "mean": onp.mean, "nansum": onp.nansum,
+              "prod": onp.prod, "nanprod": onp.nanprod, "max": onp.max,
+              "min": onp.min, "logsumexp": None, "norm": None}[name]
+    if ref_fn is not None:
+        axis = kwargs.get("axis")
+        want = ref_fn(x.astype("float64"), axis=axis)
+        onp.testing.assert_allclose(
+            onp.asarray(out.asnumpy(), "float64"), want,
+            rtol=1e-5, atol=1e-6, err_msg="%s(%s)" % (name, shape))
+    if case != "zero_size" or name in ("sum", "nansum"):
+        _run_check(name, [x], kwargs)
+
+
+@pytest.mark.parametrize("name", ["sum", "mean", "max"])
+def test_reduce_keepdims_axis(name):
+    x = _arr((2, 3, 4), None, seed=29)
+    out = invoke(name, nd.array(x), axis=1, keepdims=True)
+    assert tuple(out.shape) == (2, 1, 4)
+    out2 = invoke(name, nd.array(x), axis=(0, 2))
+    assert tuple(out2.shape) == (3,)
+
+
+# ---------------------------------------------------------------------------
+# matmul family: degenerate dims and bf16
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("sa,sb,sout", [
+    ((0, 4), (4, 2), (0, 2)),        # zero-row lhs
+    ((3, 0), (0, 2), (3, 2)),        # empty contraction (result = zeros)
+    ((1, 1), (1, 1), (1, 1)),
+])
+def test_dot_degenerate(sa, sb, sout):
+    a = _arr(sa, None, seed=31)
+    b = _arr(sb, None, seed=37)
+    out = invoke("dot", nd.array(a), nd.array(b))
+    assert tuple(out.shape) == sout
+    onp.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-6)
+    _run_check("dot", [a, b], {})
+
+
+def test_dot_bf16_accumulates_reasonably():
+    a32 = _arr((16, 32), (-1.0, 1.0), seed=41)
+    b32 = _arr((32, 8), (-1.0, 1.0), seed=43)
+    out = invoke("dot", nd.array(a32).astype("bfloat16"),
+                 nd.array(b32).astype("bfloat16"))
+    assert str(out.dtype) == "bfloat16"
+    onp.testing.assert_allclose(out.asnumpy().astype("float32"),
+                                a32 @ b32, rtol=6e-2, atol=6e-2)
+
+
+def test_batch_dot_degenerate_batch():
+    a = _arr((0, 3, 4), None, seed=47)
+    b = _arr((0, 4, 2), None, seed=53)
+    out = invoke("batch_dot", nd.array(a), nd.array(b))
+    assert tuple(out.shape) == (0, 3, 2)
+
+
+# ---------------------------------------------------------------------------
+# shape ops at the edges
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("case", sorted(SHAPES))
+def test_transpose_flip_expand(case):
+    shape = SHAPES[case]
+    x = _arr(shape, None, seed=59)
+    t = invoke("transpose", nd.array(x))
+    assert tuple(t.shape) == tuple(reversed(shape))
+    onp.testing.assert_allclose(t.asnumpy(), x.T, rtol=0, atol=0)
+    f = invoke("flip", nd.array(x), axis=0)
+    onp.testing.assert_allclose(f.asnumpy(), onp.flip(x, 0), rtol=0, atol=0)
+    e = invoke("expand_dims", nd.array(x), axis=0)
+    assert tuple(e.shape) == (1,) + shape
+    _run_check("transpose", [x], {})
+
+
+def test_concat_zero_size_piece():
+    a = _arr((0, 4), None, seed=61)
+    b = _arr((3, 4), None, seed=67)
+    out = invoke("Concat", nd.array(a), nd.array(b), dim=0)
+    assert tuple(out.shape) == (3, 4)
+    onp.testing.assert_allclose(out.asnumpy(), b, rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# index ops: integer dtypes (int32 AND int64 indices)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("idtype", ["int32", "int64"])
+def test_take_int_indices(idtype):
+    data = _arr((5, 3), None, seed=71)
+    idx = onp.array([0, 4, 2], idtype)
+    out = invoke("take", nd.array(data), nd.array(idx, dtype=idtype))
+    onp.testing.assert_allclose(out.asnumpy(), onp.take(data, idx, axis=0),
+                                rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("idtype", ["int32", "int64"])
+def test_gather_scatter_int_indices(idtype):
+    data = _arr((4, 3), None, seed=73)
+    idx = onp.array([[0, 2], [1, 0]], idtype).T
+    out = invoke("gather_nd", nd.array(data), nd.array(idx.T, dtype=idtype))
+    want = data[onp.array([0, 2]), onp.array([1, 0])]
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("idtype", ["int32", "int64"])
+def test_one_hot_int(idtype):
+    idx = onp.array([0, 3, 1], idtype)
+    out = invoke("one_hot", nd.array(idx, dtype=idtype), depth=4)
+    assert tuple(out.shape) == (3, 4)
+    onp.testing.assert_allclose(out.asnumpy(), onp.eye(4)[idx], rtol=0,
+                                atol=0)
+
+
+def test_argmax_argsort_topk_int_outputs():
+    x = _arr((4, 5), None, seed=79)
+    am = invoke("argmax", nd.array(x), axis=1)
+    onp.testing.assert_allclose(am.asnumpy(), onp.argmax(x, 1), rtol=0,
+                                atol=0)
+    asrt = invoke("argsort", nd.array(x), axis=1)
+    onp.testing.assert_allclose(asrt.asnumpy(), onp.argsort(x, 1,
+                                                            kind="stable"),
+                                rtol=0, atol=0)
+    tk = invoke("topk", nd.array(x), k=2, axis=1)
+    want = onp.argsort(-x, 1, kind="stable")[:, :2]
+    onp.testing.assert_allclose(tk.asnumpy(), want, rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# np bit ops vs real numpy (VERDICT: integer forward checks for bit ops)
+# ---------------------------------------------------------------------------
+_BITS = {
+    "_np_bitwise_and": onp.bitwise_and,
+    "_np_bitwise_or": onp.bitwise_or,
+    "_np_bitwise_xor": onp.bitwise_xor,
+    "_np_left_shift": onp.left_shift,
+    "_np_right_shift": onp.right_shift,
+    "_np_gcd": onp.gcd,
+    "_np_lcm": onp.lcm,
+    "_np_floor_divide": onp.floor_divide,
+}
+
+
+@pytest.mark.parametrize("idtype", ["int32", "int64"])
+@pytest.mark.parametrize("name", sorted(_BITS))
+def test_np_int_ops_vs_numpy(name, idtype):
+    import contextlib
+    rng = onp.random.RandomState(83)
+    a = rng.randint(1, 17, (3, 4)).astype(idtype)
+    b = rng.randint(1, 5, (3, 4)).astype(idtype)
+    # true int64 storage is opt-in (mx.util.large_tensor_scope — the
+    # analog of upstream's MXNET_INT64_TENSOR_SIZE build flag); default
+    # mode stores int32
+    scope = (mx.util.large_tensor_scope() if idtype == "int64"
+             else contextlib.nullcontext())
+    with scope:
+        out = invoke(name, nd.array(a, dtype=idtype),
+                     nd.array(b, dtype=idtype))
+        want = _BITS[name](a, b)
+        got = out.asnumpy()
+        assert got.dtype == want.dtype, (
+            "%s/%s: dtype %s != numpy %s" % (name, idtype, got.dtype,
+                                             want.dtype))
+        onp.testing.assert_allclose(got, want, rtol=0, atol=0,
+                                    err_msg="%s/%s" % (name, idtype))
+
+
+@pytest.mark.parametrize("name,npf", [("_np_bitwise_not", onp.bitwise_not),
+                                      ("_np_invert", onp.invert)])
+def test_np_bitwise_unary_vs_numpy(name, npf):
+    a = onp.random.RandomState(89).randint(0, 64, (3, 4)).astype("int32")
+    out = invoke(name, nd.array(a, dtype="int32"))
+    onp.testing.assert_allclose(out.asnumpy(), npf(a), rtol=0, atol=0)

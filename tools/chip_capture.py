@@ -140,8 +140,13 @@ def maybe_promote_nhwc(state):
     """(Re-)evaluate the NHWC headline promotion whenever both layout
     measurements exist — also demotes a stale marker if NCHW now wins."""
     items = state["items"]
-    g = items.get("bench_gluon", {}).get("json") or {}
-    n = items.get("bench_gluon_nhwc", {}).get("json") or {}
+    gi = items.get("bench_gluon", {})
+    ni = items.get("bench_gluon_nhwc", {})
+    # both rows must be REAL chip captures — a cpu-fallback NCHW baseline
+    # vs an on-chip NHWC row would promote on a bogus comparison
+    if not (gi.get("status") == "ok" and ni.get("status") == "ok"):
+        return
+    g, n = gi.get("json") or {}, ni.get("json") or {}
     if not (g.get("value") and n.get("value")):
         return
     marker = os.path.join(ART_DIR, "NHWC_PROMOTE")
@@ -159,18 +164,29 @@ def maybe_promote_nhwc(state):
             % (n["value"], g["value"], BAR_IMG_S))
 
 
+DONE = ("ok", "completed_with_failures")
+
+
 def write_suite_artifact(state):
     item = state["items"].get("tpu_suite")
-    if not item or item.get("status") != "ok":
+    if not item or item.get("status") not in DONE:
         return
-    tail = ""
+    tail, backend = "", None
     try:
         with open(os.path.join(ART_DIR, "tpu_suite.out")) as f:
-            tail = "".join(f.readlines()[-30:])
+            lines = f.readlines()
+        tail = "".join(lines[-30:])
+        for ln in lines:
+            # conftest prints this at session start on accel runs and
+            # hard-fails if the backend silently fell back to cpu
+            if "on-chip suite backend:" in ln:
+                backend = ln.split("on-chip suite backend:")[1].strip()
+                break
     except OSError:
         pass
     with open(os.path.join(REPO, "TESTS_r05_tpu.json"), "w") as f:
-        json.dump({"device": "tpu", "rc": item["rc"],
+        json.dump({"device": os.environ.get("MXNET_TEST_DEVICE", "tpu"),
+                   "backend": backend, "rc": item["rc"],
                    "seconds": item["seconds"],
                    "captured_at": item["captured_at"],
                    "summary_tail": tail}, f, indent=1)
@@ -180,7 +196,7 @@ def run_queue(state):
     """Run every incomplete queue item; returns True when all are done."""
     os.makedirs(ART_DIR, exist_ok=True)
     for name, cmd, env_extra, timeout in QUEUE:
-        if state["items"].get(name, {}).get("status") == "ok":
+        if state["items"].get(name, {}).get("status") in DONE:
             continue
         log("running %s (timeout %ds)" % (name, timeout))
         out_path = os.path.join(ART_DIR, name + ".out")
@@ -188,11 +204,21 @@ def run_queue(state):
         t0 = time.time()
         rc, timed_out = run_killable(cmd, env_extra, timeout, out_path,
                                      err_path)
+        if timed_out:
+            status = "timeout"
+        elif rc == 0:
+            status = "ok"
+        elif name == "tpu_suite" and rc == 1:
+            # pytest rc 1 = suite ran to completion with some failures —
+            # that IS capture-worthy on-chip evidence; re-running it every
+            # window would burn 2.5h on a deterministic failure
+            status = "completed_with_failures"
+        else:
+            status = "failed"
         entry = {
             "rc": rc,
             "seconds": round(time.time() - t0, 1),
-            "status": "timeout" if timed_out else
-                      ("ok" if rc == 0 else "failed"),
+            "status": status,
             "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime()),
             "json": last_json_line(out_path),
@@ -219,7 +245,7 @@ def run_queue(state):
             if not probe():
                 log("backend dropped mid-queue — back to watching")
                 return False
-    return all(state["items"].get(n, {}).get("status") == "ok"
+    return all(state["items"].get(n, {}).get("status") in DONE
                for n, *_ in QUEUE)
 
 
@@ -235,7 +261,7 @@ def main():
     log("watching for a chip window (deadline in %.1fh; %d/%d items done)"
         % (args.hours, sum(1 for n, *_ in QUEUE
                            if state["items"].get(n, {}).get("status")
-                           == "ok"), len(QUEUE)))
+                           in DONE), len(QUEUE)))
     while time.time() < deadline:
         if probe():
             log("chip window OPEN — running queue")
@@ -250,9 +276,9 @@ def main():
         time.sleep(args.probe_interval)
     log("deadline reached; %d/%d items captured"
         % (sum(1 for n, *_ in QUEUE
-               if state["items"].get(n, {}).get("status") == "ok"),
+               if state["items"].get(n, {}).get("status") in DONE),
            len(QUEUE)))
-    return 0 if all(state["items"].get(n, {}).get("status") == "ok"
+    return 0 if all(state["items"].get(n, {}).get("status") in DONE
                     for n, *_ in QUEUE) else 1
 
 

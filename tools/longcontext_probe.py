@@ -27,7 +27,6 @@ src/operator/contrib/transformer.cc keeps the full S^2 prob matrix in HBM.
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -128,29 +127,24 @@ def main():
         env["MXNET_FLASH_DISABLE"] = "1" if arm == "fallback" else "0"
         # own process group + killpg: a hung arm (tunnel drop mid-run, or
         # a tunnel-helper grandchild holding the pipe) must not take the
-        # other arm or the summary down — SIGKILL the whole group and
-        # record the error instead (bench.py f476311 lesson).
-        import signal
+        # other arm or the summary down. The kill recipe lives in
+        # chip_capture.run_killable — reuse, don't fork a third copy.
         import tempfile
-        with tempfile.TemporaryFile("w+") as out, \
-                tempfile.TemporaryFile("w+") as err:
-            proc = subprocess.Popen(
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from chip_capture import run_killable
+        with tempfile.NamedTemporaryFile("w+", suffix=".out") as out, \
+                tempfile.NamedTemporaryFile("w+", suffix=".err") as err:
+            rc, timed_out = run_killable(
                 [sys.executable, os.path.abspath(__file__),
                  "--arm", arm, "--seq", str(args.seq)],
-                stdout=out, stderr=err, env=env, text=True,
-                start_new_session=True)
-            try:
-                rc = proc.wait(timeout=1800)
-            except subprocess.TimeoutExpired:
+                {"MXNET_FLASH_DISABLE": env["MXNET_FLASH_DISABLE"]},
+                1800, out.name, err.name)
+            if timed_out:
                 rc = None
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except OSError:
-                    pass
-                proc.wait()
-            out.seek(0)
-            err.seek(0)
-            stdout, stderr = out.read(), err.read()
+            with open(out.name) as f:
+                stdout = f.read()
+            with open(err.name) as f:
+                stderr = f.read()
         sys.stderr.write(stderr)
         line = None
         for ln in stdout.splitlines():
