@@ -296,6 +296,71 @@ def bench_fused_stage(on_accel):
     return fast, base
 
 
+def bench_fused_train_stage(on_accel):
+    """Round-5 training-fusion microbench: one ResNet stage-3-shaped
+    conv3x3+BN(batch stats)+ReLU TRAINING step (fwd+bwd), XLA composed vs
+    the fused op (`_contrib_conv_bn_relu_train`: stats in the conv
+    epilogue, xhat recomputed in backward). Logs both programs'
+    cost_analysis bytes to stderr; value = fused img/s, vs_baseline =
+    fused/composed speedup."""
+    import numpy as onp
+    from mxnet_tpu.ops import fused_conv as fc
+
+    N, H, W, C = (64, 14, 14, 256) if on_accel else (4, 8, 8, 16)
+    rng = onp.random.RandomState(0)
+    dt = jnp.bfloat16 if on_accel else jnp.float32
+    x = jnp.asarray(rng.randn(N, H, W, C), dtype=dt)
+    w = jnp.asarray(rng.randn(3, 3, C, C) * 0.05, dtype=dt)
+    gamma = jnp.asarray(rng.rand(C) + 0.5, dtype=jnp.float32)
+    beta = jnp.asarray(rng.randn(C) * 0.1, dtype=jnp.float32)
+    cot = jnp.asarray(rng.rand(N, H, W, C), dtype=dt)
+
+    def composed(x_, w_, g_, b_):
+        from jax import lax
+        conv = lax.conv_general_dilated(
+            x_, w_, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        mean = jnp.mean(conv, axis=(0, 1, 2))
+        var = jnp.var(conv, axis=(0, 1, 2))
+        y = (conv - mean) * jax.lax.rsqrt(var + 1e-3) * g_ + b_
+        return jnp.maximum(y, 0.0).astype(x_.dtype)
+
+    def fused(x_, w_, g_, b_):
+        out, _, _ = fc._cbr_train(1e-3, False, x_, w_, g_, b_, None)
+        return out
+
+    def train_step(fn):
+        def step(x_, w_, g_, b_):
+            loss_fn = lambda *a: jnp.sum(fn(*a).astype(jnp.float32)
+                                         * cot.astype(jnp.float32))
+            return jax.grad(loss_fn, argnums=(1, 2, 3))(x_, w_, g_, b_)
+        return jax.jit(step)
+
+    results = {}
+    for fn, tag in ((train_step(composed), "xla_composed"),
+                    (train_step(fused), "pallas_fused")):
+        lowered = fn.lower(x, w, gamma, beta)
+        try:
+            cost = lowered.compile().cost_analysis()
+            cost = cost[0] if isinstance(cost, list) else cost
+            print("# fused_train %s bytes accessed: %.3e" % (
+                tag, cost.get("bytes accessed", float("nan"))),
+                file=sys.stderr)
+        except Exception as e:
+            print("# fused_train %s cost_analysis unavailable: %s"
+                  % (tag, e), file=sys.stderr)
+        out = fn(x, w, gamma, beta)
+        _sync(out[0])
+        n = 50 if on_accel else 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(x, w, gamma, beta)
+        _sync(out[0])
+        results[tag] = n * N / (time.perf_counter() - t0)
+    return results["pallas_fused"], results["xla_composed"]
+
+
 def _probe_backend(timeout=240):
     """Initialize the default backend with a hang guard. The axon PjRt
     tunnel blocks indefinitely in make_c_api_client when the relay is
@@ -354,14 +419,18 @@ def main():
     dev = _probe_backend()
     on_accel = dev.platform != "cpu"
     which = os.environ.get("BENCH", "gluon")
-    if which == "fused":
+    if which in ("fused", "fused_train"):
         os.environ.setdefault("MXNET_TPU_USE_PALLAS", "1")
         if not on_accel:
             os.environ.setdefault("MXNET_FLASH_INTERPRET", "1")
-        fast, base = bench_fused_stage(on_accel)
+        bench_fn = (bench_fused_stage if which == "fused"
+                    else bench_fused_train_stage)
+        fast, base = bench_fn(on_accel)
+        name = ("fused_conv_bn_relu" if which == "fused"
+                else "fused_conv_bn_relu_train")
         print(json.dumps({
-            "metric": ("fused_conv_bn_relu_img_per_sec" if on_accel
-                       else "fused_conv_bn_relu_cpu_img_per_sec"),
+            "metric": ("%s_img_per_sec" % name if on_accel
+                       else "%s_cpu_img_per_sec" % name),
             "value": round(fast, 2),
             "unit": "img/s",
             "vs_baseline": round(fast / base, 4),   # vs XLA composed

@@ -1,0 +1,59 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+the `zhanj7/mxnet` reference (an Apache MXNet 1.x fork).
+
+Not a port: the reference's C++ engine/executor/kernel stack maps onto XLA's
+async runtime, compiler fusion, and GSPMD partitioning (see SURVEY.md §7).
+Import as `import mxnet_tpu as mx` — the public surface mirrors the reference:
+`mx.nd`, `mx.sym`, `mx.gluon`, `mx.autograd`, `mx.kv`, `mx.cpu()/mx.tpu()`.
+"""
+from . import base
+from .base import MXNetError, __version__
+
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
+                      num_gpus, num_tpus, current_context)
+
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import io
+from . import name
+from . import symbol
+from . import symbol as sym
+from . import initializer
+from . import initializer as init
+from . import lr_scheduler
+from . import optimizer
+from . import optimizer as opt
+from . import kvstore
+from . import kvstore as kv
+from . import gluon
+from . import recordio
+from . import image
+from . import metric
+from . import callback
+from . import model
+from . import module
+from . import module as mod
+from . import models
+from . import operator
+from . import profiler
+from . import runtime
+from . import rnn
+from . import visualization
+from . import visualization as viz
+from . import monitor
+from . import monitor as mon
+from . import util
+from . import attribute
+from .attribute import AttrScope
+from . import engine
+from . import libinfo
+from . import log
+from . import test_utils
+from . import contrib
+from . import native
+from . import numpy as np  # noqa: F401 — mx.np numpy-compat namespace
+from . import numpy_extension as npx
+from . import lr_scheduler as _lrs_alias  # noqa: F401

@@ -1,0 +1,52 @@
+"""`mx.attribute` — AttrScope for symbol attributes.
+
+reference: python/mxnet/attribute.py (AttrScope): a thread-local `with`
+scope whose attrs (e.g. __ctx_group__, lr_mult, wd_mult) are attached to
+every symbol created inside.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_STATE = threading.local()
+
+
+def _stack():
+    if not hasattr(_STATE, "stack"):
+        _STATE.stack = [{}]
+    return _STATE.stack
+
+
+class AttrScope:
+    """reference: attribute.py (AttrScope)."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("AttrScope values must be strings")
+        self._attrs = kwargs
+
+    def get(self, attrs=None):
+        """Merge the active scope into `attrs` (scope first, explicit
+        attrs win)."""
+        merged = dict(_stack()[-1])
+        if attrs:
+            merged.update(attrs)
+        return merged
+
+    def __enter__(self):
+        merged = dict(_stack()[-1])
+        merged.update(self._attrs)
+        _stack().append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def current():
+    """The active attribute dict."""
+    return dict(_stack()[-1])

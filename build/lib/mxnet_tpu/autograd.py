@@ -1,0 +1,391 @@
+"""Imperative autograd.
+
+TPU-native analog of the reference's tape autograd (reference:
+src/imperative/imperative.cc (Imperative::RecordOp/Backward),
+python/mxnet/autograd.py). The reference records an NNVM graph and executes a
+Gradient-pass graph; here each recorded op stores the `jax.vjp` pullback
+captured at forward time (residuals live on device), and `backward()` replays
+pullbacks in reverse tape order. Hybridized blocks record ONE tape node whose
+pullback is the vjp of the whole jitted function — same shape as the
+reference's CachedOp backward (src/imperative/cached_op.cc).
+
+Lifetime: the tape holds weak references; a node stays alive only while some
+NDArray downstream of it is alive (outputs hold their producing node, nodes
+hold their inputs). Dropping the results of a recorded branch frees its
+residuals — mirroring the reference, where the graph is owned by the arrays.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variable", "record_op", "backward", "grad",
+           "set_recording", "set_training", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []          # list[weakref.ref[_Node]]
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    """reference: MXAutogradSetIsRecording — returns previous value."""
+    st = _st()
+    prev = st.recording
+    st.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    """reference: MXAutogradSetIsTraining."""
+    st = _st()
+    prev = st.training
+    st.training = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """reference: python/mxnet/autograd.py (record)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# the tape
+# ---------------------------------------------------------------------------
+class _Node:
+    __slots__ = ("op_name", "inputs", "n_out", "out_meta", "vjp_fn",
+                 "primal_fn", "out_cots", "alive", "__weakref__")
+
+    def __init__(self, op_name, inputs, out_meta, vjp_fn, primal_fn=None):
+        self.op_name = op_name
+        self.inputs = inputs          # list[NDArray] (object refs)
+        self.n_out = len(out_meta)
+        self.out_meta = out_meta      # [(shape, dtype)] for zero-filling
+        self.vjp_fn = vjp_fn
+        self.primal_fn = primal_fn    # raw-array fn; enables create_graph
+        self.out_cots = None          # filled during backward
+        self.alive = True
+
+
+def mark_variable(nd, grad_req="write"):
+    """reference: Imperative::MarkVariables."""
+    nd._grad_req = grad_req
+
+
+def record_op(op_name, input_nds, output_nds, vjp_fn, primal_fn=None):
+    """Append one executed op to the tape (reference: Imperative::RecordOp)."""
+    st = _st()
+    meta = [(o.shape, o.dtype) for o in output_nds]
+    node = _Node(op_name, list(input_nds), meta, vjp_fn, primal_fn)
+    st.tape.append(weakref.ref(node))
+    for inp in input_nds:
+        inp._tape_used = True   # mutating it now would corrupt grad routing
+    for i, o in enumerate(output_nds):
+        o._autograd_node = (node, i)
+    if len(st.tape) >= 4096:
+        st.tape = [r for r in st.tape if r() is not None]
+
+
+def _run_backward(heads, head_grads, retain_graph, want_ids=None):
+    """Reverse replay. Returns {id(nd): (nd, cotangent)} for inputs whose
+    grad_req != 'null', plus any ids in `want_ids`. Does NOT touch .grad
+    buffers (callers decide)."""
+    st = _st()
+    tape = [r() for r in st.tape]
+    tape = [n for n in tape if n is not None]
+
+    def _wanted(nd_in):
+        return (nd_in._grad_req != "null" or
+                (want_ids is not None and id(nd_in) in want_ids))
+
+    leaf_acc = {}
+    for h, hg in zip(heads, head_grads):
+        cot = hg if hg is not None else jnp.ones(h.shape, dtype=h.dtype)
+        entry = h._autograd_node
+        if entry is None:
+            if _wanted(h):
+                _acc(leaf_acc, h, cot)
+            continue
+        node, slot = entry
+        if node.out_cots is None:
+            node.out_cots = [None] * node.n_out
+        node.out_cots[slot] = _add_maybe(node.out_cots[slot], cot)
+
+    for node in reversed(tape):
+        if node.out_cots is None or not node.alive:
+            continue
+        if node.n_out == 1:
+            cot_arg = node.out_cots[0]
+        else:
+            # zero-fill unused output slots so the pullback sees full structure
+            cot_arg = tuple(
+                c if c is not None else jnp.zeros(sh, dtype=dt)
+                for c, (sh, dt) in zip(node.out_cots, node.out_meta))
+        in_cots = node.vjp_fn(cot_arg)
+        for nd_in, cot in zip(node.inputs, in_cots):
+            if cot is None or (hasattr(cot, "dtype") and
+                               cot.dtype == jax.dtypes.float0):
+                continue
+            entry = nd_in._autograd_node
+            if entry is not None:
+                pnode, pslot = entry
+                if pnode.alive:
+                    if pnode.out_cots is None:
+                        pnode.out_cots = [None] * pnode.n_out
+                    pnode.out_cots[pslot] = _add_maybe(
+                        pnode.out_cots[pslot], cot)
+            if _wanted(nd_in):
+                _acc(leaf_acc, nd_in, cot)
+        node.out_cots = None
+        if not retain_graph:
+            node.alive = False
+            node.vjp_fn = None
+
+    if not retain_graph:
+        st.tape = [r for r in st.tape if r() is not None and r().alive]
+    return leaf_acc
+
+
+def _acc(acc, nd, cot):
+    k = id(nd)
+    if k in acc:
+        acc[k] = (nd, acc[k][1] + cot)
+    else:
+        acc[k] = (nd, cot)
+
+
+def _add_maybe(a, b):
+    return b if a is None else a + b
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """reference: MXAutogradBackwardEx via python/mxnet/autograd.py (backward).
+    Writes accumulated gradients into `.grad` of marked variables, honoring
+    grad_req 'write' (overwrite) vs 'add' (accumulate across backwards)."""
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    head_grads = [g._read() if hasattr(g, "_read") else g for g in head_grads]
+    leaf_acc = _run_backward(list(heads), head_grads, retain_graph)
+    for _, (nd_var, cot) in leaf_acc.items():
+        if nd_var._grad_req == "null":
+            continue
+        if nd_var._grad is None:
+            from .ndarray.ndarray import zeros
+            nd_var._grad = zeros(nd_var.shape, ctx=nd_var._ctx,
+                                 dtype=nd_var.dtype)
+        if nd_var._grad_req == "add":
+            nd_var._grad._write(nd_var._grad._read() + cot.astype(nd_var.dtype))
+        else:
+            nd_var._grad._write(cot.astype(nd_var.dtype))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """reference: python/mxnet/autograd.py (grad) — returns grads w.r.t.
+    `variables`; never touches their `.grad` buffers.
+
+    With create_graph=True the returned gradients are themselves recorded on
+    the tape (differentiable to any order): the recorded subgraph between
+    `variables` and `heads` is re-executed as a pure jax function and the
+    whole gradient computation becomes one new tape node whose pullback is
+    `jax.vjp` of that function — vjp-of-vjp with nothing hand-derived."""
+    from .ndarray.ndarray import NDArray, zeros
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    single = not isinstance(variables, (list, tuple))
+    variables = [variables] if single else list(variables)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if create_graph:
+        outs = _grad_create_graph(heads, variables, head_grads)
+        return outs[0] if single else outs
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    head_grads = [g._read() if hasattr(g, "_read") else g for g in head_grads]
+    acc = _run_backward(list(heads), head_grads, retain_graph,
+                        want_ids={id(v) for v in variables})
+    outs = []
+    for v in variables:
+        k = id(v)
+        if k in acc:
+            outs.append(NDArray(acc[k][1].astype(v.dtype), ctx=v._ctx))
+        else:
+            outs.append(zeros(v.shape, ctx=v._ctx, dtype=v.dtype))
+    return outs[0] if single else outs
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """Differentiable gradients via subgraph re-execution (see grad())."""
+    from .ndarray.ndarray import NDArray
+
+    var_pos0 = {id(v) for v in variables}
+    # topological order of the nodes reachable from `heads` DOWN TO the
+    # `variables` (iterative postorder: the tape can be thousands of ops
+    # deep). Anything strictly upstream of the variables is a constant of
+    # the differentiation — never replayed, so a primal-less node there
+    # (custom Function, etc.) is irrelevant, not an error.
+    ordered, seen = [], set()
+    stack = [(e[0], False) for h in heads
+             if id(h) not in var_pos0
+             and (e := h._autograd_node) is not None]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            ordered.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node.primal_fn is None:
+            raise NotImplementedError(
+                "autograd.grad(create_graph=True): op %r was recorded "
+                "without a re-executable primal (custom autograd.Function); "
+                "higher-order gradients through it are not supported"
+                % node.op_name)
+        stack.append((node, True))
+        for inp in node.inputs:
+            if id(inp) in var_pos0:  # differentiation frontier
+                continue
+            e = inp._autograd_node
+            if e is not None and id(e[0]) not in seen:
+                stack.append((e[0], False))
+
+    var_pos = {id(v): j for j, v in enumerate(variables)}
+    node_ids = seen
+
+    def replay(var_raws):
+        env = {}
+
+        def val(ndv):
+            j = var_pos.get(id(ndv))
+            if j is not None:
+                return var_raws[j]
+            e = ndv._autograd_node
+            if e is not None and id(e[0]) in node_ids:
+                return env[(id(e[0]), e[1])]
+            return ndv._read()  # constant leaf
+
+        for node in ordered:
+            outs = node.primal_fn(*[val(i) for i in node.inputs])
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+            for s, o in enumerate(outs):
+                env[(id(node), s)] = o
+        return tuple(val(h) for h in heads)
+
+    if head_grads is None:
+        cots = tuple(jnp.ones(h.shape, dtype=h.dtype) for h in heads)
+    else:
+        cots = tuple(
+            (g._read() if hasattr(g, "_read") else jnp.asarray(g))
+            if g is not None else jnp.ones(h.shape, dtype=h.dtype)
+            for h, g in zip(heads, head_grads))
+
+    def grad_fn(*var_raws):
+        _, pull = jax.vjp(lambda *vr: replay(vr), *var_raws)
+        gs = tuple(g.astype(v.dtype) for g, v in zip(pull(cots), variables))
+        # single-output nodes carry a bare cotangent on the tape, so a
+        # single-variable grad must return a bare array
+        return gs[0] if len(gs) == 1 else gs
+
+    var_raws = [v._read() for v in variables]
+    out_raws, g_vjp = jax.vjp(grad_fn, *var_raws)
+    if len(variables) == 1:
+        out_raws = (out_raws,)
+    outs = [NDArray(r, ctx=v._ctx) for r, v in zip(out_raws, variables)]
+    # record so the grads are differentiable again (grad-of-grad-of-grad
+    # works: the recorded primal is grad_fn itself)
+    record_op("_grad_create_graph", list(variables), outs, g_vjp,
+              primal_fn=grad_fn)
+    return outs
+
+
+class Function:
+    """Custom differentiable function (reference: python/mxnet/autograd.py
+    (Function) — user-defined forward/backward pair)."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn_self = self
+            n_out = len(outs)
+
+            def vjp_fn(cot):
+                cots = (cot,) if n_out == 1 else cot
+                cot_nds = [NDArray(c) for c in cots]
+                in_grads = fn_self.backward(*cot_nds)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = [in_grads]
+                return [g._read() if isinstance(g, NDArray) else g
+                        for g in in_grads]
+
+            record_op(type(self).__name__, list(inputs), outs, vjp_fn)
+        return outs[0] if single else outs

@@ -1,0 +1,231 @@
+"""AMP implementation. reference: python/mxnet/contrib/amp/amp.py.
+
+The reference rewrites the NNVM graph, inserting `amp_cast`/`amp_multicast`
+nodes around ops per the allow/deny lists. The TPU-native version installs a
+cast policy at the single imperative dispatch point
+(`ndarray.ndarray._invoke`): allow-listed ops (matmul/conv class) get their
+floating inputs cast to bf16 (feeding the MXU), deny-listed ops are pinned
+to fp32, widest-type ops promote all inputs to the widest present. Casts
+happen inside the differentiated/jitted function, so XLA fuses them and
+gradients arrive in the parameter's own dtype.
+
+Loss scaling: bf16 shares fp32's exponent range, so scaling is a no-op by
+default — but the fp16-style dynamic `LossScaler` is implemented for API
+parity (scale_loss / unscale / skip-step-on-overflow semantics).
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+
+import numpy as np
+
+from . import lists
+from ... import ndarray as nd
+from ...ndarray import ndarray as _nd_mod
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "LossScaler", "list_lp16_ops", "list_fp32_ops"]
+
+_initialized = False
+_target_dtype = None
+
+
+def list_lp16_ops(target_dtype=None):
+    return list(lists.TARGET_DTYPE_OPS)
+
+
+def list_fp32_ops(target_dtype=None):
+    return list(lists.FP32_OPS)
+
+
+def _is_float(raw):
+    dt = getattr(raw, "dtype", None)
+    if dt is None:
+        return False  # python scalars pass through untouched
+    if str(dt) == "bfloat16":
+        return True
+    try:
+        return np.dtype(dt).kind == "f"
+    except TypeError:
+        return False
+
+
+def _make_policy(target_dtype):
+    import jax.numpy as jnp
+
+    target = {"bfloat16": jnp.bfloat16, "float16": jnp.float16}[target_dtype]
+    target_ops = set(lists.TARGET_DTYPE_OPS)
+    fp32_ops = set(lists.FP32_OPS)
+    widest_ops = set(lists.WIDEST_TYPE_CASTS)
+    cache = {}
+
+    def wrap(fn, op_name):
+        key = (op_name, fn)
+        hit = cache.get(key)
+        if hit is None:
+            hit = cache[key] = _wrap_uncached(fn, op_name)
+        return hit
+
+    def _wrap_uncached(fn, op_name):
+        if op_name in target_ops:
+            def cast_fn(*args, **kw):
+                args = [a.astype(target) if _is_float(a) else a
+                        for a in args]
+                return fn(*args, **kw)
+            return cast_fn
+        if op_name in fp32_ops:
+            def cast_fn(*args, **kw):
+                args = [a.astype(jnp.float32) if _is_float(a) and
+                        a.dtype != jnp.float64 else a for a in args]
+                return fn(*args, **kw)
+            return cast_fn
+        if op_name in widest_ops:
+            def cast_fn(*args, **kw):
+                fl = [a for a in args if _is_float(a)]
+                if len(fl) > 1:
+                    widest = jnp.result_type(*[a.dtype for a in fl])
+                    args = [a.astype(widest) if _is_float(a) else a
+                            for a in args]
+                return fn(*args, **kw)
+            return cast_fn
+        return fn
+
+    return wrap
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP. reference: amp.py (init). On TPU the default (and
+    recommended) target is bfloat16; float16 is accepted for parity."""
+    global _initialized, _target_dtype
+    if target_dtype in (np.float16, "float16", "fp16"):
+        target_dtype = "float16"
+    elif target_dtype in ("bfloat16", "bf16"):
+        target_dtype = "bfloat16"
+    else:
+        raise ValueError(
+            "unsupported AMP target_dtype %r: expected 'bfloat16' or "
+            "'float16'" % (target_dtype,))
+    if _initialized:
+        warnings.warn("amp.init() is already called, ignoring.")
+        return
+    if target_precision_ops:
+        lists.TARGET_DTYPE_OPS.extend(target_precision_ops)
+    if fp32_ops:
+        lists.FP32_OPS.extend(fp32_ops)
+    _initialized = True
+    _target_dtype = target_dtype
+    _nd_mod._AMP_WRAP = _make_policy(target_dtype)
+
+
+class LossScaler:
+    """Dynamic loss scaler. reference: amp/loss_scaler.py — double on
+    `scale_window` clean steps, halve on overflow, skip the update that
+    overflowed."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any grad is non-finite."""
+        for p in params:
+            if p.grad_req == "null":
+                continue
+            for g in p.list_grad():
+                if not np.isfinite(np.asarray(g.asnumpy(),
+                                              dtype=np.float64)).all():
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a LossScaler and overflow-skip logic to a Gluon Trainer.
+    reference: amp.py (init_trainer)."""
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        return
+    scaler = LossScaler() if _target_dtype == "float16" else \
+        LossScaler(init_scale=1.0, scale_factor=1.0)
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_unscaled = False
+    orig_update = trainer._update
+
+    def patched_update(ignore_stale_grad=False):
+        scale = scaler.loss_scale
+        if scale != 1.0 and not trainer._amp_unscaled:
+            for p in trainer._params:
+                if p.grad_req == "null":
+                    continue
+                for g in p.list_grad():
+                    g[:] = g / scale
+        trainer._amp_unscaled = False
+        overflow = scaler.has_overflow(trainer._params) \
+            if _target_dtype == "float16" else False
+        scaler.update_scale(overflow)
+        if overflow:
+            return  # skip this update
+        orig_update(ignore_stale_grad)
+
+    trainer._update = patched_update
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """reference: amp.py (scale_loss). Usage::
+
+        with amp.scale_loss(loss, trainer) as scaled:
+            autograd.backward(scaled)
+    """
+    if getattr(trainer, "_amp_loss_scaler", None) is None:
+        init_trainer(trainer)
+    scale = trainer._amp_loss_scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scale for l in loss]
+    else:
+        yield loss * scale
+
+
+def unscale(trainer):
+    """Divide grads by the current loss scale (for manual clip-then-step).
+    reference: amp.py (unscale). The next trainer.step() skips its own
+    unscale for this one update; the scaler's loss_scale is untouched."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    for p in trainer._params:
+        if p.grad_req == "null":
+            continue
+        for g in p.list_grad():
+            g[:] = g / scaler.loss_scale
+    trainer._amp_unscaled = True
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a Gluon block's parameters to the target dtype (the inference
+    analog of graph conversion; reference amp.convert_model converts a
+    symbol+params pair)."""
+    import jax.numpy as jnp
+    target = {"bfloat16": jnp.bfloat16, "float16": jnp.float16}[target_dtype]
+    for p in net.collect_params().values():
+        if p._data is None:
+            p.dtype = target_dtype
+            continue
+        for ctx in list(p._data.keys()):
+            arr = p._data[ctx]
+            if _is_float(arr._read()):
+                arr._write(arr._read().astype(target))
+    return net

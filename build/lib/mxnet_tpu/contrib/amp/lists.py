@@ -1,0 +1,34 @@
+"""AMP op lists. reference: python/mxnet/contrib/amp/lists/symbol_fp16.py —
+allow (run in low precision), deny (force fp32), and widest-type ops.
+
+On TPU the low-precision dtype is bf16 (same exponent range as fp32, so the
+fp16 overflow machinery is unnecessary but kept for API parity).
+"""
+
+# Matmul/conv-class ops: the MXU wants these in bf16.
+TARGET_DTYPE_OPS = [
+    "Convolution", "Deconvolution", "FullyConnected", "dot", "batch_dot",
+    "RNN",
+]
+
+# Numerically sensitive ops pinned to fp32 (reference FP32_FUNCS core set).
+FP32_OPS = [
+    "softmax", "log_softmax", "SoftmaxOutput", "SoftmaxActivation",
+    "BatchNorm", "LayerNorm", "InstanceNorm", "L2Normalization", "norm",
+    "exp", "log", "log2", "log10", "log1p", "expm1", "rsqrt", "sqrt",
+    "square", "sum", "mean", "prod", "nansum", "nanprod", "cumsum",
+    "erf", "erfinv", "gamma", "gammaln", "power", "rcbrt", "cbrt",
+    "smooth_l1", "arcsin", "arccos", "arctan", "arcsinh", "arccosh",
+    "arctanh", "sin", "cos", "tan", "sinh", "cosh", "tanh",
+    "linalg_gemm", "linalg_gemm2", "linalg_potrf", "linalg_syrk",
+    "moments", "topk",
+]
+
+# Elementwise multi-input ops that should run in the widest input dtype.
+WIDEST_TYPE_CASTS = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_power",
+    "broadcast_hypot", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "elemwise_div", "add_n", "concat", "Concat", "stack", "where",
+    "maximum", "minimum",
+]

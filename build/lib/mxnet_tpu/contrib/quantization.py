@@ -1,0 +1,362 @@
+"""INT8 model quantization: calibration + Gluon network conversion.
+
+reference: python/mxnet/contrib/quantization.py (quantize_model,
+quantize_net, _LayerOutputMinMaxCollector, _calibrate_quantized_sym via
+src/operator/quantization/calibrate.cc).
+
+Pipeline (same shape as the reference):
+  1. collect per-layer INPUT statistics by running calibration batches
+     through the fp32 net (naive min/max, or KL-entropy thresholds over a
+     histogram — the calibrate.cc algorithm);
+  2. replace Dense/Conv2D blocks with quantized twins holding int8 weights
+     (per-output-channel symmetric scales) and the calibrated activation
+     threshold;
+  3. the quantized forward quantizes the input once, runs the int8
+     dot/conv with int32 accumulation on the MXU, and dequantizes into the
+     fp32 stream — XLA fuses the (de)quantize elementwise work into the
+     surrounding ops.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ndarray as nd
+from ..context import cpu
+from ..gluon import nn as _nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["quantize_net", "calib_thresholds", "QuantizedDense",
+           "QuantizedConv2D"]
+
+INT8_MAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+class _Collector:
+    """Per-layer input statistics: running abs-max and a histogram for the
+    entropy mode (reference: _LayerHistogramCollector)."""
+
+    def __init__(self, bins=2048):
+        self.bins = bins
+        self.absmax = {}
+        self.hist = {}
+
+    def update(self, name, arr):
+        a = _np.abs(_np.asarray(arr, dtype=_np.float32)).ravel()
+        m = float(a.max()) if a.size else 0.0
+        old = self.absmax.get(name, 0.0)
+        if name in self.hist:
+            h, edges = self.hist[name]
+            if m > old:  # re-bin the old histogram onto the wider range
+                new_edges = _np.linspace(0, m, self.bins + 1)
+                centers = (edges[:-1] + edges[1:]) / 2
+                nh, _ = _np.histogram(centers, bins=new_edges, weights=h)
+                h, edges = nh, new_edges
+            h += _np.histogram(a, bins=edges)[0]
+            self.hist[name] = (h, edges)
+        else:
+            edges = _np.linspace(0, max(m, 1e-12), self.bins + 1)
+            self.hist[name] = (_np.histogram(a, bins=edges)[0]
+                               .astype(_np.float64), edges)
+        self.absmax[name] = max(old, m)
+
+
+def _smooth_distribution(d, eps=1e-4):
+    """Move eps mass onto zero entries so KL terms stay finite.
+    reference: python/mxnet/contrib/quantization.py (_smooth_distribution)."""
+    d = d.astype(_np.float64).copy()
+    zeros = d == 0
+    n_zero, n_nonzero = zeros.sum(), (~zeros).sum()
+    if n_zero and n_nonzero:
+        d[~zeros] -= eps * n_zero / n_nonzero
+        d[zeros] = eps
+    return d
+
+
+def _entropy_threshold(hist, edges, num_quantized_bins=255):
+    """KL-divergence-optimal clip threshold over an abs-value histogram.
+    reference: src/operator/quantization/calibrate.cc (GetOptimalThreshold)."""
+    total = hist.sum()
+    if total == 0:
+        return float(edges[-1])
+    best_kl, best_t = _np.inf, float(edges[-1])
+    # candidate thresholds from num_quantized_bins//2 bins upward. P is the
+    # clipped distribution (outlier mass collapsed onto the edge bin); Q is
+    # the UNclipped slice quantized to num_quantized_bins — so clipping mass
+    # shows up as P/Q mismatch at the edge and is penalized (the TensorRT /
+    # calibrate.cc construction).
+    start = num_quantized_bins // 2
+    for i in range(start, len(hist) + 1, max(1, len(hist) // 128)):
+        t = edges[i]
+        sliced = hist[:i].astype(_np.float64)
+        if sliced.sum() == 0:
+            continue
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()                # clip mass onto the edge
+        # quantize the unclipped slice into num_quantized_bins, expand back
+        factor = len(sliced) / num_quantized_bins
+        q = _np.zeros_like(sliced)
+        for j in range(num_quantized_bins):
+            lo = int(j * factor)
+            hi = max(int((j + 1) * factor), lo + 1)
+            chunk = sliced[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = _np.where(chunk > 0, chunk.sum() / nz, 0)
+        p, q = _smooth_distribution(p), _smooth_distribution(q)
+        pn, qn = p / p.sum(), q / q.sum()
+        kl = float((pn * _np.log(pn / qn)).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, float(t)
+    return best_t
+
+
+def calib_thresholds(collector, mode="entropy"):
+    """{layer_name: activation clip threshold} from collected stats."""
+    if mode == "naive":
+        return dict(collector.absmax)
+    return {name: _entropy_threshold(h, e)
+            for name, (h, e) in collector.hist.items()}
+
+
+# ---------------------------------------------------------------------------
+# quantized layers
+# ---------------------------------------------------------------------------
+def _quantize_weight(w, axis=0):
+    """Symmetric per-output-channel int8 weights. Returns (int8, scales)."""
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    t = _np.maximum(_np.abs(w).max(axis=red, keepdims=False), 1e-30)
+    scale = INT8_MAX / t
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    q = _np.clip(_np.round(w * scale.reshape(shape)), -INT8_MAX,
+                 INT8_MAX).astype(_np.int8)
+    return q, t.astype(_np.float32)  # thresholds (per out-channel)
+
+
+class _QuantizedBase(HybridBlock):
+    """Shared machinery: int8 weight buffers + input quantization.
+
+    act_max None => dynamic per-batch range (calib_mode='none');
+    otherwise the calibrated threshold is baked into the program.
+    """
+
+    def __init__(self, weight_np, bias_np, act_max, channel_axis=0, **kw):
+        super().__init__(**kw)
+        q, w_t = _quantize_weight(weight_np, axis=channel_axis)
+        self._wq = jnp.asarray(q)
+        self._w_t = jnp.asarray(w_t)              # per-channel thresholds
+        self._bias = (jnp.asarray(bias_np, jnp.float32)
+                      if bias_np is not None else None)
+        self._act_max = act_max                   # python float | None
+
+    def _quant_input(self, x32):
+        if self._act_max is not None:
+            t = jnp.float32(self._act_max)
+        else:
+            t = jnp.maximum(jnp.max(jnp.abs(x32)), 1e-30)
+        scale = INT8_MAX / t
+        xq = jnp.clip(jnp.round(x32 * scale), -INT8_MAX,
+                      INT8_MAX).astype(jnp.int8)
+        return xq, t
+
+
+class QuantizedDense(_QuantizedBase):
+    """int8 twin of nn.Dense. reference: quantized_fully_connected.cc via
+    quantize_net's graph rewrite."""
+
+    def __init__(self, dense, act_max, **kw):
+        w = dense.weight.data().asnumpy()
+        b = dense.bias.data().asnumpy() if dense.bias is not None else None
+        super().__init__(w, b, act_max, channel_axis=0, **kw)
+        self._flatten = dense._flatten
+        self._act = dense.act
+
+    def hybrid_forward(self, F, x):
+        raw = x._read() if hasattr(x, "_read") else x
+
+        def f(xr):
+            x32 = xr.astype(jnp.float32)
+            if self._flatten and x32.ndim > 2:
+                x32 = x32.reshape(x32.shape[0], -1)
+            xq, t_x = self._quant_input(x32)
+            acc = lax.dot_general(xq, self._wq,
+                                  (((x32.ndim - 1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            deq = acc.astype(jnp.float32) * (
+                (t_x * self._w_t) / (INT8_MAX * INT8_MAX))
+            if self._bias is not None:
+                deq = deq + self._bias
+            return deq
+
+        out = nd.from_jax(f(raw), ctx=x.context) \
+            if hasattr(x, "_read") else f(raw)
+        return self._act(out) if self._act is not None else out
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """int8 twin of nn.Conv2D (NCHW). reference: quantized_conv.cc."""
+
+    def __init__(self, conv, act_max, **kw):
+        w = conv.weight.data().asnumpy()
+        b = conv.bias.data().asnumpy() if conv.bias is not None else None
+        super().__init__(w, b, act_max, channel_axis=0, **kw)
+        self._stride = conv._kwargs.get("stride", (1, 1))
+        self._pad = conv._kwargs.get("pad", (0, 0))
+        self._dilate = conv._kwargs.get("dilate", (1, 1))
+        self._groups = conv._kwargs.get("num_group", 1)
+        self._act = getattr(conv, "act", None)
+
+    def hybrid_forward(self, F, x):
+        raw = x._read() if hasattr(x, "_read") else x
+
+        def f(xr):
+            x32 = xr.astype(jnp.float32)
+            xq, t_x = self._quant_input(x32)
+            dn = lax.conv_dimension_numbers(
+                xq.shape, self._wq.shape, ("NCHW", "OIHW", "NCHW"))
+            acc = lax.conv_general_dilated(
+                xq, self._wq, window_strides=tuple(self._stride),
+                padding=[(p, p) for p in self._pad],
+                rhs_dilation=tuple(self._dilate), dimension_numbers=dn,
+                feature_group_count=self._groups,
+                preferred_element_type=jnp.int32)
+            deq = acc.astype(jnp.float32) * (
+                (t_x * self._w_t.reshape(1, -1, 1, 1))
+                / (INT8_MAX * INT8_MAX))
+            if self._bias is not None:
+                deq = deq + self._bias.reshape(1, -1, 1, 1)
+            return deq
+
+        out = nd.from_jax(f(raw), ctx=x.context) \
+            if hasattr(x, "_read") else f(raw)
+        return self._act(out) if self._act is not None else out
+
+
+# ---------------------------------------------------------------------------
+# network conversion
+# ---------------------------------------------------------------------------
+_QUANTIZABLE = (_nn.Dense, _nn.Conv2D)
+
+
+def _walk(block, prefix=""):
+    for name, child in block._children.items():
+        path = prefix + name
+        yield path, block, name, child
+        yield from _walk(child, path + ".")
+
+
+def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
+                 exclude_layers_match=None, calib_data=None,
+                 calib_mode="naive", num_calib_examples=None, ctx=None,
+                 logger=None):
+    """Quantize a Gluon network in place-of (returns the converted net).
+
+    reference: python/mxnet/contrib/quantization.py (quantize_net). The
+    network must have been initialized/forwarded once (shapes known).
+    calib_mode: 'none' (dynamic ranges), 'naive' (abs-max), 'entropy'
+    (KL-optimal thresholds, calibrate.cc).
+    """
+    if quantized_dtype != "int8":
+        raise NotImplementedError("only int8 quantization is implemented")
+    ctx = ctx or cpu()
+    log = logger or logging.getLogger(__name__)
+    exclude_layers = set(exclude_layers or ())
+    exclude_layers_match = list(exclude_layers_match or ())
+
+    targets = {}
+    for path, parent, name, child in _walk(network):
+        if not isinstance(child, _QUANTIZABLE):
+            continue
+        if child.name in exclude_layers or path in exclude_layers:
+            continue
+        if any(m in child.name or m in path for m in exclude_layers_match):
+            continue
+        if child.weight._data is None:
+            raise ValueError(
+                "quantize_net: layer %s has uninitialized weights — run a "
+                "forward pass first" % child.name)
+        targets[path] = (parent, name, child)
+    if not targets:
+        return network
+
+    thresholds = {}
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise ValueError("calib_mode=%r requires calib_data" % calib_mode)
+        collector = _Collector()
+        # a hybridized net replays its cached jit program, which would
+        # bypass the python-level probes below — run calibration eagerly
+        # and restore hybridization afterwards
+        hybrid_saved = []
+        for _, _, _, blk in _walk(network):
+            if isinstance(blk, HybridBlock) and getattr(blk, "_active",
+                                                        False):
+                hybrid_saved.append(blk)
+                blk._active = False
+                blk._clear_cached_op()
+        if isinstance(network, HybridBlock) and getattr(network, "_active",
+                                                        False):
+            hybrid_saved.append(network)
+            network._active = False
+            network._clear_cached_op()
+        # temporary forward wrappers record each target layer's INPUT
+        originals = {}
+
+        def make_probe(path, child):
+            orig = child.forward
+
+            def probe(x, *args, **kw):
+                collector.update(path, x.asnumpy())
+                return orig(x, *args, **kw)
+            return orig, probe
+
+        for path, (parent, name, child) in targets.items():
+            orig, probe = make_probe(path, child)
+            originals[path] = orig
+            child.forward = probe
+        try:
+            seen = 0
+            for batch in calib_data:
+                data = batch.data[0] if hasattr(batch, "data") else batch
+                if not isinstance(data, nd.NDArray):
+                    data = nd.array(data, ctx=ctx)
+                network(data)
+                seen += data.shape[0]
+                if num_calib_examples and seen >= num_calib_examples:
+                    break
+        finally:
+            for path, (parent, name, child) in targets.items():
+                child.forward = originals[path]
+            for blk in hybrid_saved:
+                blk._active = True
+                blk._clear_cached_op()
+        thresholds = calib_thresholds(collector, calib_mode)
+        log.info("quantize_net: calibrated %d layers over %d examples (%s)",
+                 len(thresholds), seen, calib_mode)
+
+    for path, (parent, name, child) in targets.items():
+        t = thresholds.get(path)
+        if isinstance(child, _nn.Conv2D):
+            q = QuantizedConv2D(child, t, prefix=child.prefix + "quant_")
+        else:
+            q = QuantizedDense(child, t, prefix=child.prefix + "quant_")
+        parent._children[name] = q
+        for attr, val in list(vars(parent).items()):
+            if val is child:  # attr-assigned child (e.g. self.fc1)
+                object.__setattr__(parent, attr, q)
+    # children changed: drop any cached traces so the next call re-traces
+    for _, _, _, blk in _walk(network):
+        if isinstance(blk, HybridBlock):
+            blk._clear_cached_op()
+    if isinstance(network, HybridBlock):
+        network._clear_cached_op()
+    return network

@@ -1,0 +1,31 @@
+"""`mx.engine` — execution-engine controls.
+
+reference: python/mxnet/engine.py (bulk, set_bulk_size): batches engine
+pushes into bulked segments. Under XLA the analog is a no-op-with-truth:
+dispatch is already fully async and fusion happens in the compiler, so the
+bulk size is recorded for API compat and `bulk()` remains a valid scope.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_BULK_SIZE = 15  # the reference default (MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN)
+
+
+def set_bulk_size(size):
+    """reference: engine.set_bulk_size — returns the previous size."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """reference: engine.bulk — scope with a different bulk size."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

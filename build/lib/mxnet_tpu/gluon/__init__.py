@@ -1,0 +1,19 @@
+"""Gluon: the imperative neural-network API.
+reference: python/mxnet/gluon/__init__.py."""
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import (Constant, DeferredInitializationError, Parameter,
+                        ParameterDict)
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from .trainer import Trainer
+from . import contrib
+from .fused_step import FusedTrainStep
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Constant",
+           "DeferredInitializationError", "Parameter", "ParameterDict",
+           "Trainer", "FusedTrainStep", "nn", "loss", "utils"]

@@ -1,0 +1,874 @@
+"""Gluon Block / HybridBlock — the imperative NN API and its trace-JIT bridge.
+
+TPU-native analog of reference python/mxnet/gluon/block.py. `Block` keeps the
+reference's child-registration-by-attribute, prefix scoping, parameter
+collection, hooks, and save/load. `HybridBlock.hybridize()` is the reference's
+CachedOp mechanism (reference: src/imperative/cached_op.cc, gluon/block.py
+(_build_cache)) re-based on `jax.jit`:
+
+* the whole forward subtree is traced once per (shape, dtype, train-mode)
+  signature into one XLA executable — exactly the reference's per-shape
+  cached execution plans;
+* `static_alloc`/`static_shape` flags are accepted for API parity; XLA's
+  buffer assignment already provides static planning, so they only toggle
+  donation hints;
+* autograd over a hybridized call records ONE tape node whose pullback is the
+  vjp of the jitted function — the reference's CachedOp backward.
+
+Random ops inside a trace draw from a per-call key input (see
+mxnet_tpu.random.push_trace_key), keeping dropout functional under jit.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+import warnings
+from collections import OrderedDict
+
+import numpy as _np
+
+import jax
+
+from .. import autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
+                        tensor_types)
+from .utils import _indent
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+_AUX_COLLECTOR = threading.local()
+
+# Active CachedOp trace (ctx of the traced device). While set, nested
+# hybridized children run unhybridized so they trace into the parent's graph
+# (reference: CachedOp inlines the whole subtree, cached_op.cc inline_limit).
+_TRACE_STATE = threading.local()
+
+
+def _trace_ctx():
+    return getattr(_TRACE_STATE, "ctx", None)
+
+
+def record_aux_update(aux_nd, new_raw):
+    """Record a functional update to an auxiliary state (e.g. BatchNorm
+    moving_mean). Eagerly this writes through immediately; inside a CachedOp
+    trace the update becomes an extra output of the jitted function and is
+    written back after execution — the TPU answer to the reference's in-op
+    aux-state mutation (reference: src/operator/nn/batch_norm.cc writes
+    moving stats inside FCompute, which XLA's pure functions forbid)."""
+    stack = getattr(_AUX_COLLECTOR, "stack", None)
+    if stack:
+        stack[-1].append((aux_nd, new_raw))
+    else:
+        with autograd.pause():
+            aux_nd._write(new_raw)
+
+
+class _BlockScope:
+    """Name scoping for Blocks. reference: gluon/block.py (_BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix and params for a new Block."""
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current.get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from ..name import Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, inout_str):
+    """Flatten nested list/tuple of NDArrays; returns (flat, fmt).
+    reference: gluon/block.py (_flatten)."""
+    if isinstance(args, nd.NDArray):
+        return [args], int(0)
+    if args is None:
+        return [None], None
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock %s must be (nested) list of NDArray, but got %s of type " \
+        "%s" % (inout_str, str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    """Inverse of _flatten. reference: gluon/block.py (_regroup)."""
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    if fmt is None:
+        return None, args[1:]
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+def _np_tag_outputs(out, args):
+    """np-mode output typing for Block.__call__: fresh results retag to
+    mx.np.ndarray; an output that IS one of the caller's inputs —
+    directly or inside a nested container (identity passthrough, e.g.
+    Sequential plumbing) — gets a non-mutating np view instead, because
+    converting the caller's own legacy handle in place would flip its
+    semantics (hashability, bool comparisons, flatten). The view carries
+    the output's tape node so backprop through a passthrough survives."""
+    from ..ndarray.ndarray import NDArray
+
+    caller_owned = set()
+
+    def _collect(a):
+        if isinstance(a, NDArray):
+            caller_owned.add(id(a))
+        elif isinstance(a, (list, tuple)):
+            for x in a:
+                _collect(x)
+    _collect(args)
+
+    def _tag(o):
+        if isinstance(o, (list, tuple)):
+            return type(o)(_tag(x) for x in o)
+        if isinstance(o, NDArray):
+            if id(o) in caller_owned:
+                from ..numpy import _np_view
+                view = _np_view(o)
+                view._autograd_node = o._autograd_node
+                view._grad_req = o._grad_req
+                view._grad = o._grad
+                return view
+            from ..numpy.multiarray import as_np_ndarray
+            return as_np_ndarray(o)
+        return o
+    return _tag(out)
+
+
+class Block:
+    """Base building block. reference: python/mxnet/gluon/block.py (Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(repr(block), 2))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Registers parameters and children by assignment."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError(
+                    "Changing attribute type for {name} from {type1} to "
+                    "{type2} is not allowed.".format(
+                        name=name, type1=type(existing), type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params, \
+                "Overriding Parameter attribute %s is not allowed. If you " \
+                "want to share parameters between blocks, please pass the " \
+                "shared parameters through `params` at Block construction." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """reference: Block.name_scope — `with self.name_scope():`."""
+        return self._scope
+
+    @property
+    def params(self):
+        """Direct parameters only (no children)."""
+        return self._params
+
+    def collect_params(self, select=None):
+        """All parameters of self + descendants, optionally regex-filtered.
+        reference: Block.collect_params."""
+        self._check_container_with_block()
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _check_container_with_block(self):
+        children = set(self._children.values())
+        for k, v in self.__dict__.items():
+            if isinstance(v, (list, tuple, dict)) and k != "_children":
+                it = v.values() if isinstance(v, dict) else v
+                for item in it:
+                    if isinstance(item, Block) and item not in children:
+                        warnings.warn(
+                            "'%s' is an unregistered container with Blocks. "
+                            "Note that Blocks inside the list, tuple or dict "
+                            "will not be registered automatically. Make sure "
+                            "to register them using register_child() or "
+                            "switching to nn.Sequential/nn.HybridSequential "
+                            "instead." % k, stacklevel=3)
+
+    def register_child(self, block, name=None):
+        """reference: Block.register_child."""
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks)
+        self._forward_hooks[handle.id] = hook
+        return handle
+
+    def apply(self, fn):
+        """Apply fn recursively to self and children. reference: Block.apply."""
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """reference: Block.initialize."""
+        from .. import initializer as _init
+        if init is None:
+            init = _init.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Save parameters WITHOUT prefix (loadable by any instance).
+        reference: Block.save_parameters."""
+        params = self._collect_params_with_prefix()
+        if deduplicate:
+            reverse = {}
+            for k, v in params.items():
+                reverse.setdefault(id(v), []).append(k)
+            params = {ks[0]: params[ks[0]] for ks in reverse.values()}
+            arg_dict = {k: v._reduce() for k, v in params.items()}
+        else:
+            arg_dict = {key: val._reduce() for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        """reference: Block.load_parameters — handles both save_parameters
+        format (dotted names) and full-prefix ParameterDict.save format."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in i for i in loaded.keys()):
+            # legacy ParameterDict.save format with full prefixes
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix,
+                cast_dtype=cast_dtype, dtype_source=dtype_source)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    "Parameter '%s' is missing in file '%s', which contains " \
+                    "parameters: %s. Set allow_missing=True to ignore missing " \
+                    "parameters." % (name, filename,
+                                     ", ".join(sorted(loaded.keys())))
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise ValueError(
+                    "Parameter '%s' loaded from file '%s' is not present in "
+                    "this block, which contains parameters %s. Set "
+                    "ignore_extra=True to ignore." %
+                    (name, filename, ", ".join(sorted(params.keys()))))
+            if name in params:
+                params[name]._load_init(loaded[name], ctx,
+                                        cast_dtype=cast_dtype,
+                                        dtype_source=dtype_source)
+
+    # keep reference deprecated aliases
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def cast(self, dtype):
+        """reference: Block.cast — cast params + future inputs."""
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def hybridize(self, active=True, **kwargs):
+        """Recursively activate CachedOp tracing on HybridBlock children.
+        reference: Block.hybridize (base: recurse only)."""
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print per-layer summary via temporary hooks.
+        reference: Block.summary."""
+        summary = OrderedDict()
+        seen = set()
+        hooks = []
+
+        def _get_shape_str(args):
+            flat_args, fmts = _flatten(args, "input")
+            flat_arg_shapes = [x.shape if isinstance(x, nd.NDArray) else x
+                               for x in flat_args]
+            shapes = _regroup(flat_arg_shapes, fmts)[0]
+            shape_str = str(shapes).replace("'", "")
+            return shape_str
+
+        def _register_summary_hook(block):
+            assert not isinstance(block, HybridBlock) or not block._active, \
+                "\"{}\" must not be hybridized to print summary.".format(
+                    block.name)
+
+            def _summary_hook(block, _, outputs):
+                class_name = block.__class__.__name__
+                block_idx = len(summary) - 1
+                m_key = "%s-%i" % (class_name, block_idx + 1)
+                summary[m_key] = OrderedDict()
+                summary[m_key]["output_shape"] = _get_shape_str(outputs)
+                params = 0
+                summary[m_key]["trainable"] = 0
+                summary[m_key]["shared"] = 0
+                for p in block.params.values():
+                    params += p.data().size
+                    summary[m_key]["trainable"] += 0 if p.grad_req == "null" \
+                        else p.data().size
+                    if id(p) in seen:
+                        summary[m_key]["shared"] += p.data().size
+                    else:
+                        seen.add(id(p))
+                summary[m_key]["n_params"] = params
+
+            from .nn.basic_layers import Sequential, HybridSequential
+            if not isinstance(block, (Sequential, HybridSequential)):
+                hooks.append(block.register_forward_hook(_summary_hook))
+
+        summary["Input"] = OrderedDict()
+        summary["Input"]["output_shape"] = _get_shape_str(inputs)
+        summary["Input"]["n_params"] = 0
+        summary["Input"]["trainable"] = 0
+        summary["Input"]["shared"] = 0
+        try:
+            self.apply(_register_summary_hook)
+            self(*inputs)
+            line_format = "{:>20}  {:>42} {:>15}"
+            print("-" * 80)
+            print(line_format.format("Layer (type)", "Output Shape", "Param #"))
+            print("=" * 80)
+            total_params = 0
+            trainable_params = 0
+            shared_params = 0
+            for layer in summary:
+                print(line_format.format(
+                    layer, str(summary[layer]["output_shape"]),
+                    summary[layer]["n_params"]))
+                total_params += summary[layer]["n_params"]
+                trainable_params += summary[layer]["trainable"]
+                shared_params += summary[layer]["shared"]
+            print("=" * 80)
+            print("Parameters in forward computation graph, duplicate included")
+            print("   Total params: " + str(total_params))
+            print("   Trainable params: " + str(trainable_params))
+            print("   Non-trainable params: " + str(total_params -
+                                                    trainable_params))
+            print("Shared params in forward computation graph: " +
+                  str(shared_params))
+            print("Unique parameters in model: " + str(total_params -
+                                                       shared_params))
+            print("-" * 80)
+        finally:
+            for h in hooks:
+                h.detach()
+
+    def __call__(self, *args):
+        """Calls forward, running hooks. reference: Block.__call__.
+        Under npx.set_np() the outputs come back as mx.np.ndarray
+        (reference: Gluon speaks the numpy array type in np mode)."""
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        from ..numpy_extension import is_np_array
+        if is_np_array():
+            out = _np_tag_outputs(out, args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        """Override to define computation."""
+        raise NotImplementedError
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks_dict):
+        self._hooks_dict = hooks_dict
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+
+    def detach(self):
+        self._hooks_dict.pop(self.id, None)
+
+
+class CachedOp:
+    """Per-shape-signature compiled executor for a HybridBlock subtree.
+    reference: src/imperative/cached_op.cc (CachedOp) — here one `jax.jit`
+    callable per (train-mode, uses-rng) variant; shape/dtype signatures are
+    handled by jit's own compilation cache."""
+
+    def __init__(self, block, static_alloc=False, static_shape=False):
+        self._block = block
+        self._static_alloc = static_alloc
+        self._static_shape = static_shape
+        self._jitted = {}
+
+    def _make(self, train, fmt_holder):
+        block = self._block
+
+        def run(param_raws, input_raws, rng_key):
+            from .. import random as _random
+            param_nds = self._param_nds
+            saved = [(p._data, p._base, p._idx) for p in param_nds]
+            aux_updates = []
+            if not hasattr(_AUX_COLLECTOR, "stack"):
+                _AUX_COLLECTOR.stack = []
+            _AUX_COLLECTOR.stack.append(aux_updates)
+            prev_trace = _trace_ctx()
+            _TRACE_STATE.ctx = self._trace_device
+            try:
+                for p, raw in zip(param_nds, param_raws):
+                    p._data, p._base, p._idx = raw, None, None
+                _random.push_trace_key(rng_key)
+                try:
+                    with autograd.pause(train_mode=train):
+                        in_nds = [nd.from_jax(r, ctx=self._trace_device)
+                                  for r in input_raws]
+                        args = _regroup(in_nds, fmt_holder[0])[0]
+                        if not isinstance(args, (list, tuple)):
+                            args = [args]
+                        out = block._forward_unhybridized(*args)
+                finally:
+                    _random.pop_trace_key()
+            finally:
+                _TRACE_STATE.ctx = prev_trace
+                _AUX_COLLECTOR.stack.pop()
+                for p, (d, b, i) in zip(param_nds, saved):
+                    p._data, p._base, p._idx = d, b, i
+            flat_out, out_fmt = _flatten(out, "output")
+            fmt_holder[1] = out_fmt
+            fmt_holder[2] = len(flat_out)
+            # aux updates (moving stats) become extra outputs; the targets
+            # are the Parameter NDArray objects captured at trace time
+            fmt_holder[3] = [t for t, _ in aux_updates]
+            return tuple(o._read() for o in flat_out) + \
+                tuple(v for _, v in aux_updates)
+
+        return jax.jit(run)
+
+    def __call__(self, block_params, args):
+        """block_params: list[Parameter]; args: forward inputs (nested)."""
+        from .. import profiler as _profiler
+        if _profiler._state == "run" and _profiler._config["profile_symbolic"]:
+            import time as _time
+            t0 = _time.perf_counter()
+            try:
+                return self._call_impl(block_params, args)
+            finally:
+                _profiler.record_op(
+                    "CachedOp:" + getattr(self._block, "name", "block"),
+                    _time.perf_counter() - t0)
+        return self._call_impl(block_params, args)
+
+    def _call_impl(self, block_params, args):
+        flat_args, in_fmt = _flatten(args, "input")
+        ctx = None
+        for a in flat_args:
+            if isinstance(a, nd.NDArray):
+                ctx = a.context
+                break
+        if ctx is None:
+            ctx = current_context()
+        self._trace_device = ctx
+        self._param_nds = [p.data(ctx) for p in block_params]
+        param_raws = tuple(p._read() for p in self._param_nds)
+        input_raws = tuple(a._read() for a in flat_args)
+
+        train = autograd.is_training()
+        sig = train
+        fmt_holder = [in_fmt, None, None, []]
+        if sig not in self._jitted:
+            self._jitted[sig] = (self._make(train, fmt_holder), fmt_holder)
+        fn, holder = self._jitted[sig]
+        holder[0] = in_fmt
+
+        from .. import random as _random
+        rng_key = _random.take_key(ctx)
+
+        if autograd.is_recording():
+            out_raw, vjp_fn = jax.vjp(
+                lambda p, i: fn(p, i, rng_key), param_raws, input_raws)
+            n_main = holder[2]
+            outputs = [nd.from_jax(r, ctx=ctx) for r in out_raw[:n_main]]
+            self._apply_aux(holder[3], out_raw[n_main:])
+            tape_inputs = list(self._param_nds) + list(flat_args)
+            n_total = len(out_raw)
+
+            def flat_vjp(cot):
+                cot = cot if isinstance(cot, tuple) else (cot,)
+                if len(cot) < n_total:
+                    # zero cotangents for the aux-update outputs
+                    cot = tuple(cot) + tuple(
+                        jax.numpy.zeros(r.shape, r.dtype)
+                        for r in out_raw[len(cot):])
+                p_cots, i_cots = vjp_fn(tuple(cot))
+                return list(p_cots) + list(i_cots)
+
+            autograd.record_op("CachedOp:%s" % self._block.name,
+                               tape_inputs, outputs, flat_vjp)
+        else:
+            out_raw = fn(param_raws, input_raws, rng_key)
+            n_main = holder[2]
+            outputs = [nd.from_jax(r, ctx=ctx) for r in out_raw[:n_main]]
+            self._apply_aux(holder[3], out_raw[n_main:])
+
+        out_fmt = holder[1]
+        ret = _regroup(outputs, out_fmt)[0] if out_fmt is not None else outputs
+        return ret
+
+    @staticmethod
+    def _apply_aux(targets, values):
+        with autograd.pause():
+            for t, v in zip(targets, values):
+                t._write(v)
+
+
+class HybridBlock(Block):
+    """Block with trace-JIT support. reference: gluon/block.py (HybridBlock).
+
+    Subclasses implement `hybrid_forward(F, x, *args, **params)` where F is
+    the `nd` namespace eagerly and a tracer-backed `nd` under hybridize; the
+    registered parameters of THIS block are passed as keyword NDArrays, same
+    calling convention as the reference."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._cached_op = None
+        self._active = False
+        self._flags = {}
+        self._in_trace = False
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s. If you are using Sequential, please try "
+                "HybridSequential instead." % (str(block), str(type(block))))
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """reference: HybridBlock.hybridize(active, static_alloc,
+        static_shape)."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._clear_cached_op()
+        for cld in self._children.values():
+            cld.hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Infer parameter shapes from inputs by a forward probe.
+        reference: HybridBlock.infer_shape (graph shape inference)."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        """Run an eager forward with abstract evaluation to resolve deferred
+        parameter shapes (the reference runs the NNVM InferShape pass; here
+        each layer resolves its own shapes in hybrid_forward preamble via
+        the layer's infer-shape hooks)."""
+        try:
+            params = {k: v for k, v in self._reg_params.items()}
+            for p in params.values():
+                p._finish_deferred_init()
+        except Exception:
+            pass
+
+    def infer_type(self, *args):
+        pass
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serialize symbol + params (reference: HybridBlock.export →
+        `path-symbol.json` + `path-%04d.params`)."""
+        from .. import symbol as sym_mod
+        if not self._active:
+            raise RuntimeError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        inputs = getattr(self, "_cached_graph_inputs", None)
+        if inputs is None:
+            raise RuntimeError(
+                "Please run forward with this block at least once before "
+                "calling export.")
+        out_sym = self._trace_symbol(inputs)
+        out_sym.save("%s-symbol.json" % path, remove_amp_cast=remove_amp_cast)
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            arg_dict["arg:%s" % name] = param._reduce()
+        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
+        return "%s-symbol.json" % path, "%s-%04d.params" % (path, epoch)
+
+    def _trace_symbol(self, input_shapes):
+        """Trace hybrid_forward with symbolic proxies to get an mx.sym graph."""
+        from .. import symbol as sym_mod
+        data_syms = [sym_mod.var("data%d" % i if i else "data")
+                     for i in range(len(input_shapes))]
+        out = self._symbolic_forward(*data_syms)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        return out
+
+    def _symbolic_forward(self, *syms):
+        """forward() with Symbol inputs: runs hybrid_forward with F=symbol."""
+        from .. import symbol as sym_mod
+        params = {i: j.var() for i, j in self._reg_params.items()}
+        return self.hybrid_forward(sym_mod, *syms, **params)
+
+    # ------------------------------------------------------------------
+    def _forward_unhybridized(self, *args):
+        """Eager hybrid_forward with concrete (or tracer) NDArrays."""
+        ctx = None
+        for a in _flatten(args, "input")[0]:
+            if isinstance(a, nd.NDArray):
+                ctx = a.context
+                break
+        if ctx is None:
+            ctx = current_context()
+        try:
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._infer_param_shapes(ctx, *args)
+            params = {k: v.data(ctx) for k, v in self._reg_params.items()}
+        return self.hybrid_forward(nd, *args, **params)
+
+    def _infer_param_shapes(self, ctx, *args):
+        """Resolve deferred shapes: ask the layer (shape_hook) then finish
+        init. Layers with deferred params override `_shape_from_input`."""
+        hook = getattr(self, "_shape_from_input", None)
+        if hook is not None:
+            hook(*args)
+        for p in self._reg_params.values():
+            p._finish_deferred_init()
+
+    def forward(self, x, *args):
+        """Routes to cached op when hybridized. reference:
+        HybridBlock.forward."""
+        if isinstance(x, nd.NDArray):
+            self._cached_graph_inputs = [x.shape] + [
+                a.shape for a in args if isinstance(a, nd.NDArray)]
+            if self._active and not self._in_trace and _trace_ctx() is None:
+                # ensure params initialized (deferred shapes) by an eager
+                # pre-pass ONLY when some param is uninitialized
+                need_init = False
+                for p in self.collect_params().values():
+                    if p._data is None:
+                        need_init = True
+                        break
+                if need_init:
+                    # run the whole subtree unhybridized (suppress child
+                    # CachedOps too — they'd be throwaway compilations)
+                    self._in_trace = True
+                    _TRACE_STATE.ctx = x.context
+                    try:
+                        self._forward_unhybridized(x, *args)
+                    finally:
+                        _TRACE_STATE.ctx = None
+                        self._in_trace = False
+                if self._cached_op is None:
+                    self._cached_op = CachedOp(self, **{
+                        k: v for k, v in self._flags.items()
+                        if k in ("static_alloc", "static_shape")})
+                block_params = list(self.collect_params().values())
+                return self._cached_op(block_params, [x] + list(args))
+            return self._forward_unhybridized(x, *args)
+        from .. import symbol as sym_mod
+        if isinstance(x, sym_mod.Symbol):
+            params = {i: j.var() for i, j in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, x, *args, **params)
+        raise ValueError(
+            "HybridBlock input must be NDArray or Symbol, got %s" % type(x))
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        """Override to define computation. F is `mxnet_tpu.nd` or
+        `mxnet_tpu.symbol`."""
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol graph as a Block (for imported models).
+    reference: gluon/block.py (SymbolBlock)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """reference: SymbolBlock.imports — load export()ed model."""
+        from .. import symbol as sym_mod
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(i) for i in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.collect_params().load(param_file, ctx=ctx, cast_dtype=True,
+                                      dtype_source="saved")
+        elif ctx is not None:
+            ret.collect_params().reset_ctx(ctx)
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=None)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        from .. import symbol as sym_mod
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        syms = inputs
+        self._input_names = [s.name for s in syms]
+        self._output = outputs
+        # every non-input arg/aux becomes a parameter
+        arg_params = outputs.list_arguments()
+        aux_params = outputs.list_auxiliary_states()
+        for name in arg_params:
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in aux_params:
+            self.params.get(name, grad_req="null", allow_deferred_init=True)
+        self._cached_graph_syms = (syms, outputs)
+
+    def forward(self, x, *args):
+        from .. import symbol as sym_mod
+        if isinstance(x, sym_mod.Symbol):
+            composed = {n: s for n, s in
+                        zip(self._input_names, [x] + list(args))}
+            return self._output._compose_with(composed)
+        ctx = x.context
+        in_nds = [x] + list(args)
+        feed = dict(zip(self._input_names, in_nds))
+        for name, p in self.params.items():
+            if p._data is not None:
+                feed[name] = p.data(ctx)
+        return self._output.eval_with(feed, ctx)
+
+    def _clear_cached_op(self):
+        pass
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

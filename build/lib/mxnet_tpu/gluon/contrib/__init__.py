@@ -1,0 +1,7 @@
+"""`gluon.contrib` (reference: python/mxnet/gluon/contrib/)."""
+from . import cnn
+from . import nn
+from . import rnn
+from . import estimator
+
+__all__ = ["cnn", "nn", "rnn", "estimator"]

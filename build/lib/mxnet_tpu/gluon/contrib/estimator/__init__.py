@@ -1,0 +1,14 @@
+"""gluon.contrib.estimator (reference:
+python/mxnet/gluon/contrib/estimator/) — high-level fit loop + event
+handlers."""
+from .estimator import Estimator
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, ValidationHandler,
+                            LoggingHandler, CheckpointHandler,
+                            EarlyStoppingHandler)
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]

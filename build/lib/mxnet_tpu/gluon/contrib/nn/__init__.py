@@ -1,0 +1,13 @@
+"""`gluon.contrib.nn` layers.
+
+reference: python/mxnet/gluon/contrib/nn/basic_layers.py (Concurrent,
+HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm). SyncBatchNorm
+here IS BatchNorm: under GSPMD, batch statistics reduce over the sharded
+batch axis automatically inside jit, which is the whole point of the
+reference's cross-device sync kernel.
+"""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]

@@ -1,0 +1,85 @@
+"""reference: python/mxnet/gluon/contrib/nn/basic_layers.py."""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential, BatchNorm, Embedding
+
+
+class Concurrent(Sequential):
+    """Children run on the same input; outputs concat on `axis`.
+    reference: contrib/nn (Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """reference: contrib/nn (HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """reference: contrib/nn (Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with row_sparse gradients (reference: contrib/nn
+    (SparseEmbedding) — sparse grad for kvstore row_sparse push/pull)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "sparse_grad": True}
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), init=weight_initializer,
+            dtype=dtype, grad_stype="row_sparse")
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        return nd.invoke("Embedding", x, self.weight.data(x.context),
+                         **{k: v for k, v in self._kwargs.items()
+                            if k != "sparse_grad"})
+
+    def __repr__(self):
+        return "SparseEmbedding(%d -> %d)" % (self._kwargs["input_dim"],
+                                              self._kwargs["output_dim"])
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm. reference: contrib/nn
+    (SyncBatchNorm, sync_batch_norm.cu). Under GSPMD a batch-sharded input
+    reduces its statistics over the global batch automatically inside the
+    jitted program, so the base BatchNorm already IS synchronized; the
+    class exists for API parity and ignores num_devices/key."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=
+                         running_variance_initializer,
+                         in_channels=in_channels, **kwargs)

@@ -1,0 +1,11 @@
+"""`gluon.contrib.rnn` (reference: python/mxnet/gluon/contrib/rnn/
+conv_rnn_cell.py, rnn_cell.py) — VariationalDropoutCell plus re-exports of
+the shared cell surface."""
+from ...rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                    BidirectionalCell, DropoutCell, ResidualCell,
+                    ZoneoutCell, ModifierCell)
+from .rnn_cell import VariationalDropoutCell
+
+__all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ResidualCell",
+           "ZoneoutCell", "VariationalDropoutCell"]

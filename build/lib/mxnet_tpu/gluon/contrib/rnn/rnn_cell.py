@@ -1,0 +1,50 @@
+"""reference: python/mxnet/gluon/contrib/rnn/rnn_cell.py."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import ModifierCell
+from .... import ndarray as nd
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Dropout with masks drawn ONCE per sequence and reused at every step
+    (Gal & Ghahramani; reference: contrib/rnn VariationalDropoutCell)."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        super().__init__(base_cell)
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    @staticmethod
+    def _mask(p, like):
+        return nd.invoke("Dropout", nd.ones_like(like), p=p)
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self._drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if self._drop_states:
+            if self._state_masks is None:
+                self._state_masks = [self._mask(self._drop_states, s)
+                                     for s in states]
+            states = [s * m for s, m in zip(states, self._state_masks)]
+        out, next_states = self.base_cell(inputs, states)
+        if self._drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self._drop_outputs, out)
+            out = out * self._output_mask
+        return out, next_states

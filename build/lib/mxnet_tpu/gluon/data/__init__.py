@@ -1,0 +1,9 @@
+"""Gluon data API. reference: python/mxnet/gluon/data/__init__.py."""
+from .dataset import *  # noqa: F401,F403
+from .sampler import *  # noqa: F401,F403
+from .dataloader import *  # noqa: F401,F403
+from . import vision  # noqa: F401
+
+from . import dataset, sampler, dataloader
+
+__all__ = dataset.__all__ + sampler.__all__ + dataloader.__all__ + ["vision"]

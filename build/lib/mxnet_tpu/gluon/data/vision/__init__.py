@@ -1,0 +1,6 @@
+"""Vision data API. reference: python/mxnet/gluon/data/vision/__init__.py."""
+from .datasets import *  # noqa: F401,F403
+from . import transforms  # noqa: F401
+from . import datasets
+
+__all__ = datasets.__all__ + ["transforms"]

@@ -1,0 +1,7 @@
+"""Model zoo. reference: python/mxnet/gluon/model_zoo/__init__.py (vision)
++ the BERT family the reference ecosystem served through GluonNLP."""
+from . import vision
+from . import bert
+from .vision import get_model
+
+__all__ = ["vision", "bert", "get_model"]

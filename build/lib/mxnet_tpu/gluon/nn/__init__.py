@@ -1,0 +1,9 @@
+"""Gluon neural-network layers. reference: python/mxnet/gluon/nn/__init__.py."""
+from .activations import *  # noqa: F401,F403
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *  # noqa: F401,F403
+
+from . import activations, basic_layers, conv_layers
+
+__all__ = (activations.__all__ + basic_layers.__all__ +
+           conv_layers.__all__)

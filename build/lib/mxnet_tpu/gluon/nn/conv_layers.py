@@ -1,0 +1,390 @@
+"""Convolution & pooling layers.
+reference: python/mxnet/gluon/nn/conv_layers.py.
+
+Both channels-first (NCW/NCHW/NCDHW, the reference default) and
+channels-last (NWC/NHWC/NDHWC) layouts are supported end-to-end; XLA
+relayouts to the TPU-native tiling internally either way.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .activations import Activation
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _to_tuple(x, n):
+    if isinstance(x, int):
+        return (x,) * n
+    assert len(x) == n
+    return tuple(x)
+
+
+class _Conv(HybridBlock):
+    """Base conv. reference: nn/conv_layers.py (_Conv)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", op_name="Convolution",
+                 adj=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self._channels = channels
+            self._in_channels = in_channels
+            from ...ops.nn import layout_info
+            _, self._channels_last = layout_info(
+                layout, len(kernel_size), type(self).__name__)
+            self._kwargs = {
+                "kernel": kernel_size, "stride": strides, "dilate": dilation,
+                "pad": padding, "num_filter": channels, "num_group": groups,
+                "no_bias": not use_bias, "layout": layout}
+            if adj is not None:
+                self._kwargs["adj"] = adj
+            self._op_name = op_name
+
+            if op_name == "Convolution":
+                if self._channels_last:
+                    # reference NHWC weight layout: (O, *kernel, I/groups)
+                    wshape = (channels,) + kernel_size + \
+                        (in_channels // groups,)
+                else:
+                    wshape = (channels, in_channels // groups) + kernel_size
+            else:  # Deconvolution: weight is (in, out//groups, *k)
+                assert not self._channels_last, \
+                    "Deconvolution supports channels-first layouts only"
+                wshape = (in_channels, channels // groups) + kernel_size
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def _shape_from_input(self, x, *args):
+        in_channels = x.shape[-1 if self._channels_last else 1]
+        k = self._kwargs["kernel"]
+        groups = self._kwargs["num_group"]
+        if self._op_name == "Convolution":
+            if self._channels_last:
+                self.weight.shape = (self._channels,) + k + \
+                    (in_channels // groups,)
+            else:
+                self.weight.shape = \
+                    (self._channels, in_channels // groups) + k
+        else:
+            self.weight.shape = (in_channels, self._channels // groups) + k
+        self._in_channels = in_channels
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        if bias is None:
+            act = op(x, weight, **self._kwargs)
+        else:
+            act = op(x, weight, bias, **self._kwargs)
+        if self.act is not None:
+            act = self.act(act)
+        return act
+
+    def _alias(self):
+        return "conv"
+
+    def __repr__(self):
+        s = "{name}({mapping}, kernel_size={kernel}, stride={stride}"
+        len_kernel_size = len(self._kwargs["kernel"])
+        if self._kwargs["pad"] != (0,) * len_kernel_size:
+            s += ", padding={pad}"
+        if self._kwargs["dilate"] != (1,) * len_kernel_size:
+            s += ", dilation={dilate}"
+        if hasattr(self, "out_pad") and self.out_pad != (0,) * len_kernel_size:
+            s += ", output_padding={out_pad}".format(out_pad=self.out_pad)
+        if self._kwargs["num_group"] != 1:
+            s += ", groups={num_group}"
+        if self.bias is None:
+            s += ", bias=False"
+        if self.act:
+            s += ", {}".format(self.act)
+        s += ")"
+        shape = self.weight.shape
+        in_ch = shape[-1] if self._channels_last else shape[1]
+        return s.format(name=self.__class__.__name__,
+                        mapping="{0} -> {1}".format(
+                            in_ch if in_ch else None, shape[0]),
+                        **self._kwargs)
+
+
+class Conv1D(_Conv):
+    """reference: nn/conv_layers.py (Conv1D)."""
+
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        kernel_size = _to_tuple(kernel_size, 1)
+        strides = _to_tuple(strides, 1)
+        padding = _to_tuple(padding, 1)
+        dilation = _to_tuple(dilation, 1)
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    """reference: nn/conv_layers.py (Conv2D)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        kernel_size = _to_tuple(kernel_size, 2)
+        strides = _to_tuple(strides, 2)
+        padding = _to_tuple(padding, 2)
+        dilation = _to_tuple(dilation, 2)
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    """reference: nn/conv_layers.py (Conv3D)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        kernel_size = _to_tuple(kernel_size, 3)
+        strides = _to_tuple(strides, 3)
+        padding = _to_tuple(padding, 3)
+        dilation = _to_tuple(dilation, 3)
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class _ConvTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides, padding,
+                 output_padding, dilation, groups, layout, in_channels,
+                 activation, use_bias, weight_initializer, bias_initializer,
+                 **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="Deconvolution", adj=output_padding, **kwargs)
+        self.outpad = output_padding
+        self.out_pad = output_padding
+
+
+class Conv1DTranspose(_ConvTranspose):
+    """reference: nn/conv_layers.py (Conv1DTranspose)."""
+
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _to_tuple(kernel_size, 1),
+                         _to_tuple(strides, 1), _to_tuple(padding, 1),
+                         _to_tuple(output_padding, 1), _to_tuple(dilation, 1),
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv2DTranspose(_ConvTranspose):
+    """reference: nn/conv_layers.py (Conv2DTranspose)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _to_tuple(kernel_size, 2),
+                         _to_tuple(strides, 2), _to_tuple(padding, 2),
+                         _to_tuple(output_padding, 2), _to_tuple(dilation, 2),
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv3DTranspose(_ConvTranspose):
+    """reference: nn/conv_layers.py (Conv3DTranspose)."""
+
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _to_tuple(kernel_size, 3),
+                         _to_tuple(strides, 3), _to_tuple(padding, 3),
+                         _to_tuple(output_padding, 3), _to_tuple(dilation, 3),
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class _Pooling(HybridBlock):
+    """Base pooling. reference: nn/conv_layers.py (_Pooling)."""
+
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=None, **kwargs):
+        super().__init__(**kwargs)
+        if strides is None:
+            strides = pool_size
+        from ...ops.nn import layout_info
+        layout_info(layout, len(pool_size), type(self).__name__)
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "global_pool": global_pool, "pool_type": pool_type,
+            "layout": layout,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+    def __repr__(self):
+        return "{name}(size={kernel}, stride={stride}, padding={pad}, " \
+            "ceil_mode={ceil_mode})".format(
+                name=self.__class__.__name__,
+                ceil_mode=self._kwargs["pooling_convention"] == "full",
+                **self._kwargs)
+
+
+class MaxPool1D(_Pooling):
+    """reference: nn/conv_layers.py (MaxPool1D)."""
+
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_to_tuple(pool_size, 1),
+                         strides if strides is None else _to_tuple(strides, 1),
+                         _to_tuple(padding, 1), ceil_mode, False, "max",
+                         layout, **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    """reference: nn/conv_layers.py (MaxPool2D)."""
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(_to_tuple(pool_size, 2),
+                         strides if strides is None else _to_tuple(strides, 2),
+                         _to_tuple(padding, 2), ceil_mode, False, "max",
+                         layout, **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    """reference: nn/conv_layers.py (MaxPool3D)."""
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_to_tuple(pool_size, 3),
+                         strides if strides is None else _to_tuple(strides, 3),
+                         _to_tuple(padding, 3), ceil_mode, False, "max",
+                         layout, **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    """reference: nn/conv_layers.py (AvgPool1D)."""
+
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_to_tuple(pool_size, 1),
+                         strides if strides is None else _to_tuple(strides, 1),
+                         _to_tuple(padding, 1), ceil_mode, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    """reference: nn/conv_layers.py (AvgPool2D)."""
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_to_tuple(pool_size, 2),
+                         strides if strides is None else _to_tuple(strides, 2),
+                         _to_tuple(padding, 2), ceil_mode, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    """reference: nn/conv_layers.py (AvgPool3D)."""
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_to_tuple(pool_size, 3),
+                         strides if strides is None else _to_tuple(strides, 3),
+                         _to_tuple(padding, 3), ceil_mode, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    """reference: nn/conv_layers.py (GlobalMaxPool1D)."""
+
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), True, True, "max", layout,
+                         **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    """reference: nn/conv_layers.py (GlobalMaxPool2D)."""
+
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, True, "max", layout,
+                         **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    """reference: nn/conv_layers.py (GlobalMaxPool3D)."""
+
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max",
+                         layout, **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    """reference: nn/conv_layers.py (GlobalAvgPool1D)."""
+
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), True, True, "avg", layout,
+                         **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    """reference: nn/conv_layers.py (GlobalAvgPool2D)."""
+
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, True, "avg", layout,
+                         **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    """reference: nn/conv_layers.py (GlobalAvgPool3D)."""
+
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg",
+                         layout, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """reference: nn/conv_layers.py (ReflectionPad2D)."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = padding
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)

@@ -1,0 +1,606 @@
+"""Gluon Parameter / ParameterDict.
+
+TPU-native analog of reference python/mxnet/gluon/parameter.py. Preserved
+semantics: deferred shape init (0-dims resolved on first forward), grad_req
+modes (write/add/null), per-context replica lists (`list_data`), `var()` for
+hybridize tracing, shared parameter scoping via ParameterDict prefixes, and
+row_sparse parameters (reduced to dense on save, as the reference does).
+
+Delta from the reference: replicas are jax.Arrays placed per device; the
+"master copy lives wherever initialize(ctx=...) put it" rule is identical.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as _np
+
+from .. import autograd, initializer
+from .. import ndarray as nd
+from ..base import MXNetError, np_dtype
+from ..context import Context, cpu, current_context
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (nd.NDArray, _np.ndarray)
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its deferred shape is known.
+    reference: gluon/parameter.py (DeferredInitializationError)."""
+
+
+class Parameter:
+    """A weight/aux tensor held by Blocks.
+    reference: python/mxnet/gluon/parameter.py (Parameter)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None          # OrderedDict[Context, NDArray]
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self.name = name
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = None
+        self.grad_req = grad_req
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if stype not in ("default", "row_sparse", "csr"):
+            raise ValueError("invalid stype %s" % stype)
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self._shape, _np.dtype(self.dtype).name)
+
+    # ------------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError("grad_req must be write/add/null, got %s" % req)
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # merging unknown (0) dims — reference allows refining 0 dims only
+        if len(self._shape) != len(new_shape) or any(
+                s != n and s != 0 for s, n in zip(self._shape, new_shape)):
+            raise AssertionError(
+                "Expected shape %s is incompatible with given shape %s for "
+                "Parameter %s" % (str(new_shape), str(self._shape), self.name))
+        self._shape = tuple(new_shape)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # ------------------------------------------------------------------
+    def _check_and_get(self, arr_dict, ctx):
+        if arr_dict is not None:
+            if ctx is list:
+                return list(arr_dict.values())
+            if ctx is None:
+                if len(arr_dict) == 1:
+                    return list(arr_dict.values())[0]
+                ctx = current_context()
+            ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
+            if ctx in arr_dict:
+                return arr_dict[ctx]
+            # reference falls back by device type group
+            for c, v in arr_dict.items():
+                if c.device_type == ctx.device_type:
+                    return v
+            raise RuntimeError(
+                "Parameter '%s' was not initialized on context %s. It was "
+                "only initialized on %s." % (self.name, ctx,
+                                             list(arr_dict.keys())))
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass." % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. You should initialize "
+            "parameters and create Trainer with Block.collect_params() "
+            "instead of Block.params because the later does not include "
+            "Parameters of nested child Blocks" % self.name)
+
+    def _load_init(self, data, ctx, cast_dtype=False, dtype_source="current"):
+        """Set data from a loaded checkpoint (reference: Parameter._load_init)."""
+        if self.shape:
+            unknown_dim_size = -1 if _np.prod(self.shape) <= 0 else \
+                int(data.size // max(1, -_np.prod(
+                    [d for d in self.shape if d != 0]) * -1))
+            for s, d in zip(self.shape, data.shape):
+                if s != 0 and s != d:
+                    raise AssertionError(
+                        "Failed loading Parameter '%s' from saved params: "
+                        "shape incompatible expected %s vs saved %s"
+                        % (self.name, str(self.shape), str(data.shape)))
+            self._shape = tuple(data.shape)
+        if cast_dtype and _np.dtype(data.dtype) != _np.dtype(self.dtype):
+            if dtype_source == "current":
+                data = data.astype(self.dtype)
+            else:
+                self.dtype = data.dtype
+        elif _np.dtype(data.dtype) != _np.dtype(self.dtype):
+            raise AssertionError(
+                "Failed loading Parameter '%s' from saved params: dtype "
+                "incompatible expected %s vs saved %s. Set cast_dtype=True "
+                "to cast the dtype of saved params." %
+                (self.name, _np.dtype(self.dtype).name,
+                 _np.dtype(data.dtype).name))
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is None:
+            if self._deferred_init:
+                if ctx is not None and set(ctx) != set(self._deferred_init[1]):
+                    raise AssertionError(
+                        "Failed to load Parameter '%s' on %s because it was "
+                        "previous initialized on %s." %
+                        (self.name, str(ctx), str(self.list_ctx())))
+                ctx = self._deferred_init[1]
+            elif ctx is None:
+                ctx = [cpu()]
+            self._init_impl(data, ctx)
+        else:
+            for arr in self._check_and_get(self._data, list):
+                arr[:] = data.asnumpy() if isinstance(data, nd.NDArray) else data
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        """reference: Parameter._finish_deferred_init — run the stored init
+        once the shape is fully known (first forward)."""
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        if self._shape is None or any(d == 0 for d in self._shape):
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self._shape)))
+        with autograd.pause():
+            if data is None:
+                data = nd.zeros(self._shape, dtype=self.dtype, ctx=cpu())
+                initializer.create(default_init)(
+                    initializer.InitDesc(self.name,
+                                         {"__init__": init.dumps() if init else ""}),
+                    data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(_np.asarray(data), dtype=self.dtype)
+        self._ctx_list = list(ctx_list)
+        self._data = OrderedDict()
+        for ctx in self._ctx_list:
+            self._data[ctx] = data.copyto(ctx) if ctx != data.context \
+                else data.copy()
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = OrderedDict()
+        for ctx, d in self._data.items():
+            g = nd.zeros(d.shape, dtype=d.dtype, ctx=ctx)
+            self._grad[ctx] = g
+            d._grad = g
+            d._grad_req = self.grad_req
+            autograd.mark_variable(d, self.grad_req)
+
+    def _reduce(self):
+        """Average data across contexts to cpu (used by save).
+        row_sparse params are densified here, as in the reference."""
+        blocks = self._check_and_get(self._data, list)
+        if len(blocks) == 1:
+            data = blocks[0].copyto(cpu())
+        else:
+            acc = blocks[0].asnumpy().astype("float64")
+            for b in blocks[1:]:
+                acc = acc + b.asnumpy()
+            data = nd.array(acc / len(blocks), dtype=self.dtype, ctx=cpu())
+        if self._stype != "default":
+            data = data.tostype("default") if data.stype != "default" else data
+        return data
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """reference: Parameter.initialize."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        init = initializer.create(init) if isinstance(init, str) else init
+        if self._shape is None or any(d == 0 for d in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s. Please specify in_units, in_channels, etc for "
+                "`Block`s." % (self.name, str(self._shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        """Re-place data on new contexts. reference: Parameter.reset_ctx."""
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = self._reduce()
+            with autograd.pause():
+                self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError("Cannot reset context for Parameter '%s' because "
+                             "it has not been initialized." % self.name)
+
+    def set_data(self, data):
+        """reference: Parameter.set_data."""
+        self.shape = data.shape
+        if self._data is None:
+            if not self._deferred_init:
+                raise AssertionError(
+                    "Parameter '%s' has not been initialized" % self.name)
+            self._deferred_init = self._deferred_init[:3] + (
+                data if isinstance(data, nd.NDArray) else nd.array(data),)
+            return
+        for arr in self._check_and_get(self._data, list):
+            arr[:] = data.asnumpy() if isinstance(data, nd.NDArray) \
+                else _np.asarray(data)
+
+    def row_sparse_data(self, row_id):
+        """Sparse row pull (dense-backed; sharded-gather path lives in
+        kvstore). reference: Parameter.row_sparse_data."""
+        if self._stype != "row_sparse":
+            raise RuntimeError(
+                "Cannot return a copy of Parameter %s via row_sparse_data() "
+                "because its storage type is %s" % (self.name, self._stype))
+        return self.data(row_id.context)
+
+    def list_row_sparse_data(self, row_id):
+        if self._stype != "row_sparse":
+            raise RuntimeError(
+                "Cannot return copies of Parameter '%s' on all contexts via "
+                "list_row_sparse_data() because its storage type is %s"
+                % (self.name, self._stype))
+        return self.list_data()
+
+    def data(self, ctx=None):
+        """reference: Parameter.data. Under npx.set_np() the handle comes
+        back np-typed (a zero-copy view: writes through it reach the
+        parameter payload, and the caller's legacy handle is untouched)."""
+        if self._stype != "default":
+            raise RuntimeError(
+                "Cannot return a copy of Parameter '%s' on ctx %s via data() "
+                "because its storage type is %s. Please use row_sparse_data() "
+                "instead." % (self.name, str(ctx), self._stype))
+        out = self._check_and_get(self._data, ctx)
+        from ..numpy_extension import is_np_array
+        if is_np_array():
+            from ..numpy import _np_view
+            # ONE view per payload object: the tape routes and ACCUMULATES
+            # gradients by leaf identity, so a parameter used at several
+            # sites in one recorded graph must present the same leaf every
+            # time data() is called (fresh views would each get a partial
+            # cotangent and overwrite the shared grad buffer)
+            cache = getattr(self, "_np_view_cache", None)
+            if cache is None or cache[0] is not out:
+                cache = (out, _np_view(out))
+                self._np_view_cache = cache
+            view = cache[1]
+            # grad marking can change after attach_grad/zero_grad swaps
+            view._grad_req = out._grad_req
+            view._grad = out._grad
+            return view
+        return out
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter '%s' has not been initialized"
+                               % self.name)
+        return list(self._data.keys())
+
+    def zero_grad(self):
+        """reference: Parameter.zero_grad."""
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g._write(g._read() * 0)
+
+    def var(self):
+        """Symbolic variable for this parameter (used in hybridize traces).
+        reference: Parameter.var."""
+        if self._var is None:
+            from .. import symbol
+            self._var = symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype, init=self.init,
+                                   lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                                   stype=self._stype)
+        return self._var
+
+    def cast(self, dtype):
+        """reference: Parameter.cast."""
+        self.dtype = np_dtype(dtype)
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = OrderedDict(
+                (c, d.astype(self.dtype)) for c, d in self._data.items())
+            self._init_grad()
+
+
+class Constant(Parameter):
+    """Non-trainable constant. reference: gluon/parameter.py (Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(_np.asarray(value))
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=initializer.Constant(value.asnumpy().tolist()))
+
+
+class ParameterDict:
+    """Prefix-scoped dict of Parameters with sharing.
+    reference: python/mxnet/gluon/parameter.py (ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            "  " + repr(v) for v in self.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get-or-create `prefix+name`, checking attribute compatibility.
+        reference: ParameterDict.get."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        v = tuple(v) if not isinstance(v, int) else (v,)
+                        if len(v) == len(existing) and all(
+                                a == b or a == 0 or b == 0
+                                for a, b in zip(v, existing)):
+                            param.shape = tuple(
+                                a if a != 0 else b for a, b in zip(existing, v))
+                            continue
+                    if k == "dtype":
+                        if _np.dtype(v) == _np.dtype(existing):
+                            continue
+                    elif v is None or existing == v:
+                        continue
+                    raise AssertionError(
+                        "Cannot retrieve Parameter '%s' because desired "
+                        "attribute does not match with stored for attribute "
+                        "'%s': desired '%s' vs stored '%s'." %
+                        (name, k, str(v), str(getattr(param, k))))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        """reference: ParameterDict.get_constant."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(
+                    "No constant named '{}'. Please specify value if you want "
+                    "to create a new constant.".format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            if not isinstance(param, Constant):
+                raise TypeError("Parameter '{}' already exists but is not a "
+                                "constant.".format(name))
+            if isinstance(value, nd.NDArray):
+                value = value.asnumpy()
+            if param.shape != tuple(_np.asarray(value).shape) or not \
+                    _np.allclose(param.value.asnumpy(), _np.asarray(value)):
+                raise AssertionError(
+                    "Constant '{}' already exists but its value doesn't "
+                    "match new value".format(name))
+        return param
+
+    def update(self, other):
+        """Merge (share) parameters from another dict."""
+        for k, v in other.items():
+            if k in self._params:
+                if self._params[k] is not v:
+                    raise ValueError(
+                        "Cannot update self with other because they have "
+                        "different Parameters with the same name '%s'" % k)
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """reference: ParameterDict.initialize."""
+        if init is None:
+            init = initializer.Uniform()
+        if verbose and hasattr(init, "set_verbosity"):
+            init.set_verbosity(verbose=verbose)
+        for v in self.values():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def list_ctx(self):
+        s = set()
+        for v in self.values():
+            s.update(v.list_ctx())
+        return list(s)
+
+    def setattr(self, name, value):
+        """Set an attribute on all parameters (e.g. lr_mult).
+        reference: ParameterDict.setattr."""
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        """reference: ParameterDict.save → .params file."""
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with '%s'"
+                    % (strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="", cast_dtype=False,
+             dtype_source="current"):
+        """reference: ParameterDict.load."""
+        if restore_prefix:
+            for name in self.keys():
+                if not name.startswith(restore_prefix):
+                    raise AssertionError(
+                        "restore_prefix is '%s' but Parameters name '%s' does "
+                        "not start with '%s'" % (restore_prefix, name,
+                                                 restore_prefix))
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {}
+        for k, v in loaded.items():
+            k = k[4:] if k.startswith(("arg:", "aux:")) else k
+            arg_dict[restore_prefix + k] = v
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise AssertionError(
+                        "Parameter '%s' is missing in file '%s', which "
+                        "contains parameters: %s. Set allow_missing=True to "
+                        "ignore missing parameters."
+                        % (name[lprefix:], filename,
+                           ", ".join(sorted(arg_dict.keys()))))
+        for name in arg_dict:
+            if name not in self._params:
+                if not ignore_extra:
+                    raise AssertionError(
+                        "Parameter '%s' loaded from file '%s' is not present "
+                        "in ParameterDict, which contains parameters %s. Set "
+                        "ignore_extra=True to ignore."
+                        % (name[lprefix:], filename,
+                           ", ".join(sorted(self._params.keys()))))
+                continue
+            self[name]._load_init(arg_dict[name], ctx, cast_dtype=cast_dtype,
+                                  dtype_source=dtype_source)

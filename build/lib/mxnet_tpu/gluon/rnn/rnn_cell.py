@@ -1,0 +1,678 @@
+"""Unfused recurrent cells. reference: python/mxnet/gluon/rnn/rnn_cell.py.
+
+Same cell classes and `unroll` protocol as the reference. Under
+`hybridize()` the python unroll loop is traced once and XLA compiles the
+unrolled graph; the fused `lax.scan` path lives in rnn_layer.py.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import Block, HybridBlock
+from ..utils import _indent
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _get_begin_state(cell, F, begin_state, inputs, batch_size):
+    if begin_state is None:
+        begin_state = cell.begin_state(batch_size=batch_size,
+                                       func=F.zeros if hasattr(F, "zeros")
+                                       else nd.zeros)
+    return begin_state
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    """Normalize inputs to a list of per-step tensors or one merged tensor.
+    reference: rnn_cell.py (_format_sequence)."""
+    assert inputs is not None
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    batch_size = 0
+    in_axis = in_layout.find("T") if in_layout is not None else axis
+    if isinstance(inputs, nd.NDArray) or not isinstance(inputs,
+                                                        (list, tuple)):
+        F = None
+        batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            assert length is None or length == inputs.shape[in_axis]
+            inputs = list(nd.split_v2(
+                inputs, inputs.shape[in_axis], axis=in_axis,
+                squeeze_axis=True)) if isinstance(inputs, nd.NDArray) else \
+                [inputs.slice_axis(in_axis, i, i + 1).reshape(
+                    _squeeze_shape(inputs, in_axis))
+                 for i in range(inputs.shape[in_axis])]
+    else:
+        assert length is None or len(inputs) == length
+        batch_size = inputs[0].shape[batch_axis]
+        if merge is True:
+            inputs = nd.concat(*[i.expand_dims(axis) for i in inputs],
+                               dim=axis)
+    if isinstance(inputs, (list, tuple)):
+        length = len(inputs)
+    else:
+        length = inputs.shape[in_axis] if merge is not True else length
+    return inputs, axis, batch_size, length
+
+
+def _squeeze_shape(x, axis):
+    shape = list(x.shape)
+    shape.pop(axis)
+    return tuple(shape)
+
+
+def _mask_sequence_variable_length(F, data, length, valid_length, time_axis,
+                                   merge):
+    assert valid_length is not None
+    if not isinstance(data, (list, tuple)):
+        return F.SequenceMask(data, sequence_length=valid_length,
+                              use_sequence_length=True, axis=time_axis)
+    outputs = [
+        F.SequenceMask(x.expand_dims(time_axis), sequence_length=valid_length,
+                       use_sequence_length=True, axis=time_axis)
+        for x in data]
+    if merge:
+        return nd.concat(*outputs, dim=time_axis)
+    return [o.reshape(_squeeze_shape(o, time_axis)) for o in outputs]
+
+
+class RecurrentCell(Block):
+    """Abstract cell. reference: rnn_cell.py (RecurrentCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        """Reset before re-use (new sequence)."""
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError()
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states. reference: RecurrentCell.begin_state."""
+        assert not self._modified, \
+            "After applying modifier cells (e.g. ZoneoutCell) the base cell " \
+            "cannot be called directly. Call the modifier cell instead."
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            state = func(name="%sbegin_state_%d" % (self._prefix,
+                                                    self._init_counter),
+                         **info) if _func_takes_name(func) else \
+                func(info["shape"])
+            states.append(state)
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell for `length` steps.
+        reference: RecurrentCell.unroll."""
+        self.reset()
+        F = nd
+        inputs, axis, batch_size, length = _format_sequence(
+            length, inputs, layout, False)
+        begin_state = _get_begin_state(self, F, begin_state, inputs,
+                                       batch_size)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [nd.invoke("SequenceLast",
+                                nd.stack(*ele_list, axis=0),
+                                valid_length,
+                                use_sequence_length=True, axis=0)
+                      for ele_list in zip(*all_states)]
+            outputs = _mask_sequence_variable_length(F, outputs, length,
+                                                     valid_length, axis, True)
+        if merge_outputs:
+            # per-step (N,C) outputs -> one (.., T, ..) tensor on the
+            # layout's time axis
+            outputs = nd.concat(*[o.expand_dims(axis) for o in outputs],
+                                dim=axis)
+        return outputs, states
+
+    def _get_activation(self, F, inputs, activation, **kwargs):
+        func = {"tanh": F.tanh, "relu": F.relu, "sigmoid": F.sigmoid,
+                "softsign": lambda x: F.Activation(x, act_type="softsign")}
+        if isinstance(activation, str):
+            if activation in func:
+                return func[activation](inputs, **kwargs) \
+                    if activation not in ("tanh", "relu", "sigmoid") else \
+                    getattr(inputs, activation)()
+            return F.Activation(inputs, act_type=activation, **kwargs)
+        if isinstance(activation, HybridBlock):
+            return activation(inputs, **kwargs)
+        return activation(inputs, **kwargs)
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states)
+
+
+def _func_takes_name(func):
+    import inspect
+    try:
+        return "name" in inspect.signature(func).parameters
+    except (ValueError, TypeError):
+        return False
+
+
+class HybridRecurrentCell(RecurrentCell, HybridBlock):
+    """reference: rnn_cell.py (HybridRecurrentCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return HybridBlock.forward(self, inputs, states)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell. reference: rnn_cell.py (RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _shape_from_input(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prefix = "t%d_" % self._counter
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        i2h_plus_h2h = i2h + h2h
+        output = self._get_activation(F, i2h_plus_h2h, self._activation)
+        return output, [output]
+
+    def __repr__(self):
+        s = "{name}({mapping}"
+        if hasattr(self, "_activation"):
+            s += ", {_activation}"
+        s += ")"
+        shape = self.i2h_weight.shape
+        mapping = "{0} -> {1}".format(shape[1] if shape[1] else None,
+                                      shape[0])
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell. reference: rnn_cell.py (LSTMCell). Gate order [i,f,g,o]."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None, activation="tanh",
+                 recurrent_activation="sigmoid"):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def _shape_from_input(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slice_gates = F.split(gates, num_outputs=4, axis=-1)
+        in_gate = self._get_activation(F, slice_gates[0],
+                                       self._recurrent_activation)
+        forget_gate = self._get_activation(F, slice_gates[1],
+                                           self._recurrent_activation)
+        in_transform = self._get_activation(F, slice_gates[2],
+                                            self._activation)
+        out_gate = self._get_activation(F, slice_gates[3],
+                                        self._recurrent_activation)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(F, next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+    def __repr__(self):
+        shape = self.i2h_weight.shape
+        mapping = "{0} -> {1}".format(shape[1] if shape[1] else None,
+                                      shape[0] // 4)
+        return "{name}({mapping})".format(name=self.__class__.__name__,
+                                          mapping=mapping)
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell. reference: rnn_cell.py (GRUCell). Gate order [r,z,n]."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(3 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(3 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(3 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(3 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def _shape_from_input(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_state_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h = F.split(i2h, num_outputs=3, axis=-1)
+        h2h_r, h2h_z, h2h = F.split(h2h, num_outputs=3, axis=-1)
+        reset_gate = (i2h_r + h2h_r).sigmoid()
+        update_gate = (i2h_z + h2h_z).sigmoid()
+        next_h_tmp = (i2h + reset_gate * h2h).tanh()
+        next_h = (1. - update_gate) * next_h_tmp + update_gate * prev_state_h
+        return next_h, [next_h]
+
+    def __repr__(self):
+        shape = self.i2h_weight.shape
+        mapping = "{0} -> {1}".format(shape[1] if shape[1] else None,
+                                      shape[0] // 3)
+        return "{name}({mapping})".format(name=self.__class__.__name__,
+                                          mapping=mapping)
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells. reference: rnn_cell.py (SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        return s.format(name=self.__class__.__name__,
+                        modstr="\n".join(
+                            "({i}): {m}".format(i=i, m=_indent(repr(m), 2))
+                            for i, m in self._children.items()))
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        assert all(not isinstance(cell, BidirectionalCell)
+                   for cell in self._children.values())
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        num_cells = len(self._children)
+        _, _, batch_size, _ = _format_sequence(length, inputs, layout, None)
+        begin_state = _get_begin_state(self, nd, begin_state, inputs,
+                                       batch_size)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._children.values()):
+            n = len(cell.state_info())
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+                valid_length=valid_length)
+            next_states.extend(states)
+        return inputs, next_states
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class HybridSequentialRNNCell(HybridRecurrentCell):
+    """reference: rnn_cell.py (HybridSequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        return SequentialRNNCell.unroll(
+            self, length, inputs, begin_state, layout, merge_outputs,
+            valid_length)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+    def hybrid_forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class DropoutCell(HybridRecurrentCell):
+    """reference: rnn_cell.py (DropoutCell)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        assert isinstance(rate, (int, float)), "rate must be a number"
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        inputs, _, _, _ = _format_sequence(length, inputs, layout,
+                                           merge_outputs)
+        if isinstance(inputs, nd.NDArray):
+            return self.hybrid_forward(nd, inputs, begin_state or [])
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs,
+                              valid_length=valid_length)
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells wrapping another cell.
+    reference: rnn_cell.py (ModifierCell)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified, \
+            "Cell %s is already modified. One cell cannot be modified " \
+            "twice" % base_cell.name
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(),
+                         params=None)
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def hybrid_forward(self, F, inputs, states):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return "{name}({base_cell})".format(name=self.__class__.__name__,
+                                            base_cell=self.base_cell)
+
+
+class ZoneoutCell(ModifierCell):
+    """reference: rnn_cell.py (ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout since it doesn't " \
+            "support step. Please add ZoneoutCell to the cells underneath " \
+            "instead."
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        super().__init__(base_cell)
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        cell, p_outputs, p_states = (self.base_cell, self._zoneout_outputs,
+                                     self._zoneout_states)
+        next_output, next_states = cell(inputs, states)
+
+        def mask(p, like):
+            return nd.invoke("Dropout", nd.ones_like(like), p=p)
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = nd.zeros_like(next_output)
+        output = F.where(mask(p_outputs, next_output), next_output,
+                         prev_output) if p_outputs != 0. else next_output
+        new_states = [F.where(mask(p_states, new_s), new_s, old_s)
+                      for new_s, old_s in zip(next_states, states)] \
+            if p_states != 0. else next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Output = base(x) + x. reference: rnn_cell.py (ResidualCell)."""
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def _alias(self):
+        return "residual"
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs, valid_length=valid_length)
+        self.base_cell._modified = True
+        if isinstance(outputs, list):
+            inputs_l, _, _, _ = _format_sequence(length, inputs, layout,
+                                                 False)
+            outputs = [o + i for o, i in zip(outputs, inputs_l)]
+        else:
+            inputs_m, _, _, _ = _format_sequence(length, inputs, layout,
+                                                 True)
+            outputs = outputs + inputs_m
+        return outputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """reference: rnn_cell.py (BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def __repr__(self):
+        return "{name}(forward={l_cell}, backward={r_cell})".format(
+            name=self.__class__.__name__,
+            l_cell=self._children["l_cell"],
+            r_cell=self._children["r_cell"])
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        inputs, _, batch_size, length = _format_sequence(length, inputs,
+                                                         layout, False)
+        reversed_inputs = list(reversed(inputs))
+        begin_state = _get_begin_state(self, nd, begin_state, inputs,
+                                       batch_size)
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=states[:len(l_cell.state_info())],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=reversed_inputs,
+            begin_state=states[len(l_cell.state_info()):],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        reversed_r_outputs = list(reversed(r_outputs))
+        outputs = [nd.concat(l_o, r_o, dim=1)
+                   for l_o, r_o in zip(l_outputs, reversed_r_outputs)]
+        if merge_outputs:
+            outputs = nd.concat(*[o.expand_dims(axis) for o in outputs],
+                                dim=axis)
+        states = l_states + r_states
+        return outputs, states

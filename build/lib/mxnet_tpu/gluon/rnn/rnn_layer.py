@@ -1,0 +1,223 @@
+"""Fused recurrent layers (LSTM/GRU/RNN) over the fused RNN op.
+
+reference: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer via sym.RNN →
+cuDNN). Here the fused op is a `lax.scan` kernel (ops/rnn_ops.py); parameter
+naming (`l0_i2h_weight`, `r0_h2h_bias`, ...) and layouts (TNC/NTC) match the
+reference so checkpoints interchange.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import HybridBlock
+from . import rnn_cell
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    """reference: rnn_layer.py (_RNNLayer)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param("{}{}_i2h_weight".format(j, i),
+                                     shape=(ng * nh, ni),
+                                     init=i2h_weight_initializer)
+                self._register_param("{}{}_h2h_weight".format(j, i),
+                                     shape=(ng * nh, nh),
+                                     init=h2h_weight_initializer)
+                self._register_param("{}{}_i2h_bias".format(j, i),
+                                     shape=(ng * nh,),
+                                     init=i2h_bias_initializer)
+                self._register_param("{}{}_h2h_bias".format(j, i),
+                                     shape=(ng * nh,),
+                                     init=h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        # reference stores these directly (no children)
+        return super()._collect_params_with_prefix(prefix)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _shape_from_input(self, x, *args):
+        layout_in = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        ni = x.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, "{}{}_i2h_weight".format(j, i)).shape = \
+                    (ng * nh, ni)
+            ni = nh * self._dir
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent state. reference: _RNNLayer.begin_state."""
+        if func is None:
+            func = nd.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            states.append(func(info.pop("shape", ()), **{
+                k: v for k, v in info.items() if k in ("ctx", "dtype")}))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **kwargs):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, 0, 1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size,
+                                      ctx=inputs.context if hasattr(
+                                          inputs, "context") else None,
+                                      dtype=inputs.dtype)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        for state, info in zip(states, self.state_info(batch_size)):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    "Invalid recurrent state shape. Expecting %s, got %s." % (
+                        str(info["shape"]), str(state.shape)))
+        out = self._forward_kernel(F, inputs, states, **kwargs)
+        # out: (output, [state(s)])
+        outputs, new_states = out
+        if self._layout == "NTC":
+            outputs = nd.invoke("swapaxes", outputs, dim1=0, dim2=1) if \
+                isinstance(outputs, nd.NDArray) else outputs.swapaxes(0, 1)
+        return outputs if skip_states else (outputs, new_states)
+
+    def _flat_params(self, kwargs):
+        order = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                order.append(kwargs["{}{}_i2h_weight".format(j, i)])
+                order.append(kwargs["{}{}_h2h_weight".format(j, i)])
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                order.append(kwargs["{}{}_i2h_bias".format(j, i)])
+                order.append(kwargs["{}{}_h2h_bias".format(j, i)])
+        flat = [w.reshape((-1,)) for w in order]
+        return nd.concat(*flat, dim=0)
+
+    def _forward_kernel(self, F, inputs, states, **kwargs):
+        params = self._flat_params(kwargs)
+        if self._mode == "lstm":
+            h, c = states
+            rnn_out = F.RNN(inputs, params, h, c,
+                            state_size=self._hidden_size,
+                            num_layers=self._num_layers, mode=self._mode,
+                            bidirectional=self._dir == 2, p=self._dropout,
+                            state_outputs=True)
+            outputs, state_n, cell_n = rnn_out
+            return outputs, [state_n, cell_n]
+        h = states[0]
+        rnn_out = F.RNN(inputs, params, h, None,
+                        state_size=self._hidden_size,
+                        num_layers=self._num_layers, mode=self._mode,
+                        bidirectional=self._dir == 2, p=self._dropout,
+                        state_outputs=True)
+        outputs, state_n, _ = rnn_out
+        return outputs, [state_n]
+
+
+class RNN(_RNNLayer):
+    """Elman RNN layer. reference: rnn_layer.py (RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """LSTM layer. reference: rnn_layer.py (LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", projection_size,
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """GRU layer. reference: rnn_layer.py (GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

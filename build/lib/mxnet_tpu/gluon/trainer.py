@@ -1,0 +1,312 @@
+"""Gluon Trainer — ties parameters ↔ optimizer ↔ kvstore.
+
+TPU-native analog of reference python/mxnet/gluon/trainer.py. Same contract:
+`step(batch_size)` = allreduce_grads (kvstore push/pull) + update (optimizer),
+`update_on_kvstore` decides whether the optimizer runs inside the store
+(server-side semantics) or locally per device. Compute/comm overlap that the
+reference got from engine dependencies is recovered on TPU by the fused
+`mxnet_tpu.parallel` jitted train step; this class remains the imperative
+API-parity path.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .. import kvstore as kvs
+from .. import optimizer as opt
+from ..context import current_context
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """reference: python/mxnet/gluon/trainer.py (Trainer)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict, ParameterDict)):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._set_trainer(self) if hasattr(param, "_set_trainer") else None
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._distributed = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or \
+                param._deferred_init else [current_context()]
+            assert contexts is None or contexts == ctx, \
+                "All Parameters must be initialized on the same set of " \
+                "contexts, but Parameter %s is initialized on %s while " \
+                "previous Parameters are initialized on %s." % (
+                    param.name, str(ctx), str(contexts))
+            contexts = ctx
+        return contexts
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        if self._optimizer.aggregate_num == 0:
+            # reference: Trainer enables multi-tensor (aggregated) updates,
+            # sized by MXNET_OPTIMIZER_AGGREGATION_SIZE; 0 disables
+            import os as _os
+            self._optimizer.aggregate_num = int(
+                _os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4"))
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _reset_kvstore(self):
+        if self._kvstore and "dist" in self._kvstore.type:
+            raise RuntimeError("Cannot reset distributed KVStore.")
+        self._kv_initialized = False
+        self._kvstore = None
+        self._distributed = None
+        self._update_on_kvstore = None
+        self._params_to_init = [param for param in self._params]
+
+    def _init_kvstore(self):
+        """Create the kvstore and decide update_on_kvstore.
+        reference: Trainer._init_kvstore."""
+        config = self._kvstore_params
+        arg_arrays = {}
+        contexts = self._contexts
+        kvstore_name = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        kvstore = None
+        sparse_params = any(p._stype != "default" for p in self._params)
+        if kvstore_name:
+            # single-device non-dist: aggregation is a no-op, skip the store
+            # entirely (reference: _init_kvstore with one context and dense
+            # params also bypasses push/pull via update_on_kvstore=False and
+            # CommCPU short-circuit; here the dispatch cost matters more).
+            # An explicit update_on_kvstore=True keeps the store.
+            single = (isinstance(kvstore_name, str) and
+                      not kvstore_name.startswith("dist") and
+                      len(contexts) == 1 and not sparse_params and
+                      update_on_kvstore is not True)
+            if not single:
+                kvstore = kvs.create(kvstore_name) if isinstance(
+                    kvstore_name, str) else kvstore_name
+        self._distributed = "dist" in kvstore.type if kvstore else False
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            if update_on_kvstore is None:
+                # reference default: update on kvstore for dist and sparse
+                update_on_kvstore = self._distributed or sparse_params
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            self._kvstore = kvstore
+            self._update_on_kvstore = update_on_kvstore
+        else:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    def _init_params(self):
+        assert self._kv_initialized, \
+            "Cannot initialize parameters in KVStore when KVStore is not " \
+            "initialized."
+        params_to_init = []
+        if self._kvstore:
+            for param in self._params_to_init:
+                if param._deferred_init:
+                    params_to_init.append(param)
+                else:
+                    param_arrays = param._check_and_get(param._data, list)
+                    idx = self._param2idx[param.name]
+                    self._kvstore.init(idx, param_arrays[0])
+                    if param._stype == "default" and self._update_on_kvstore:
+                        # weights live on the store; pull initial value back
+                        self._kvstore.pull(idx, param_arrays, priority=-idx)
+        self._params_to_init = params_to_init
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate can be accessed.")
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate is mutated.")
+        self._optimizer.set_learning_rate(lr)
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        """Internal: pull sparse rows for a parameter before forward.
+        reference: Trainer._row_sparse_pull."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        idx = self._param2idx[parameter.name]
+        if full_idx:
+            self._kvstore.pull(idx, out=out, ignore_sparse=False)
+        else:
+            self._kvstore.row_sparse_pull(idx, out=out, row_ids=row_id)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Make one parameter update step: grad allreduce + optimizer.
+        reference: Trainer.step."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._distributed and \
+                self._kv_initialized:
+            if self._optimizer.rescale_grad != scale:
+                raise UserWarning(
+                    "Possible change in the `batch_size` from previous "
+                    "`step` detected. Optimizer gradient normalizing factor "
+                    "will not change w.r.t new batch_size when "
+                    "update_on_kvstore=True")
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        """Explicit grad-sum across devices, without optimizer step."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "allreduce_grads() when parameters are updated on kvstore is " \
+            "not supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if not self._kvstore:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                idx = self._param2idx[param.name]
+                grad_list = param.list_grad()
+                self._kvstore.push(idx, grad_list, priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(idx, grad_list, priority=-i,
+                                       ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Optimizer step only (user already reduced grads).
+        reference: Trainer.update."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore is not " \
+            "supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        # aggregate per updater slot so the whole step is ONE fused jitted
+        # optimizer call (reference: Optimizer.aggregate_num / multi_sgd)
+        batched = [[] for _ in self._updaters]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if not ignore_stale_grad:
+                for data in param._check_and_get(param._data, list):
+                    pass  # staleness tracking: jax arrays are always fresh
+            if self._kvstore and self._update_on_kvstore:
+                if param._stype == "default":
+                    idx = self._param2idx[param.name]
+                    self._kvstore.pull(idx, param.list_data(), priority=-i)
+                continue
+            for slot, (arr, grad) in enumerate(zip(param.list_data(),
+                                                   param.list_grad())):
+                batched[slot].append((i, grad, arr))
+        for upd, entries in zip(self._updaters, batched):
+            if not entries:
+                continue
+            if len(entries) == 1:
+                upd(entries[0][0], entries[0][1], entries[0][2])
+            else:
+                idxs, grads, arrs = zip(*entries)
+                upd(list(idxs), list(grads), list(arrs))
+
+    def save_states(self, fname):
+        """reference: Trainer.save_states."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            assert not self._params_to_init, \
+                "Cannot save trainer states when some parameters are not " \
+                "yet initialized in kvstore."
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """reference: Trainer.load_states."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

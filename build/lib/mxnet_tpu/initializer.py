@@ -1,0 +1,342 @@
+"""Weight initializers.
+
+TPU-native analog of the reference's initializer module (reference:
+python/mxnet/initializer.py). Same registry/`__call__` protocol: an
+`Initializer` is called with an `InitDesc` (name + attrs) and the destination
+NDArray; pattern dispatch on the name ("_weight", "_bias", "gamma", ...) is
+preserved so `init.Xavier()` etc. behave like the reference.
+
+Randomness draws from the framework RNG (mxnet_tpu.random), so
+`mx.random.seed` makes init reproducible, as in the reference.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as _np
+
+from . import ndarray as nd
+from .base import np_dtype
+
+__all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
+           "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "Load"]
+
+_INIT_REGISTRY = {}
+
+
+class InitDesc(str):
+    """Name + attrs descriptor handed to initializers.
+    reference: python/mxnet/initializer.py (InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+def register(klass):
+    """Register an initializer class under its lowercased name.
+    reference: python/mxnet/initializer.py (register)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs):
+    """Create an initializer from str / instance / None."""
+    if init is None:
+        return None
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        return _INIT_REGISTRY[init.lower()](**kwargs)
+    raise TypeError("cannot create initializer from %r" % (init,))
+
+
+class Initializer:
+    """Base class. reference: python/mxnet/initializer.py (Initializer)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func or (lambda x: None)
+        return self
+
+    def dumps(self):
+        """JSON [name, kwargs] — the serialization the reference sends to
+        parameter servers (kvstore.set_optimizer path)."""
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        if desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "")
+        if init:
+            # symbol __init__ attrs are either the JSON [name, kwargs] an
+            # Initializer dumps, or a bare registered name ("zeros")
+            try:
+                spec = json.loads(init)
+                create(spec[0], **spec[1])._init_weight(desc, arr)
+            except ValueError:
+                create(init)._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("min"):
+            self._init_zero(desc, arr)
+        elif name.endswith("max"):
+            self._init_one(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- per-kind defaults (reference behavior) --------------------------
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override _init_weight")
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __repr__(self):
+        return "%s(%s)" % (self.__class__.__name__,
+                           ", ".join("%s=%r" % kv for kv in self._kwargs.items()))
+
+    def __eq__(self, other):
+        return (type(self) is type(other) and self._kwargs == other._kwargs)
+
+    __hash__ = object.__hash__
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+_INIT_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        if isinstance(self.value, (list, tuple, _np.ndarray)):
+            arr[:] = _np.asarray(self.value, dtype=arr.dtype).reshape(arr.shape)
+        else:
+            arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale). reference default scale=0.07."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        from . import random as _r
+        arr[:] = _r.uniform(-self.scale, self.scale, shape=arr.shape,
+                            dtype=arr.dtype, ctx=arr.ctx).asnumpy()
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma). reference default sigma=0.01."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        from . import random as _r
+        arr[:] = _r.normal(0, self.sigma, shape=arr.shape,
+                           dtype=arr.dtype, ctx=arr.ctx).asnumpy()
+
+
+@register
+class Orthogonal(Initializer):
+    """QR/SVD-orthogonal init. reference: Orthogonal(scale, rand_type)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _s, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init. reference: Xavier(rnd_type, factor_type, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            raise ValueError(
+                "Xavier initializer cannot init %s with shape %s: at least 2D"
+                % (name, shape))
+        hw_scale = 1.0
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0,
+                  "in": fan_in, "out": fan_out}.get(self.factor_type)
+        if factor is None:
+            raise ValueError("Incorrect factor type")
+        scale = _np.sqrt(self.magnitude / factor)
+        from . import random as _r
+        if self.rnd_type == "uniform":
+            arr[:] = _r.uniform(-scale, scale, shape=shape, dtype=arr.dtype,
+                                ctx=arr.ctx).asnumpy()
+        elif self.rnd_type == "gaussian":
+            arr[:] = _r.normal(0, scale, shape=shape, dtype=arr.dtype,
+                               ctx=arr.ctx).asnumpy()
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init variant. reference: MSRAPrelu(factor_type, slope)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for transposed conv)."""
+
+    def _init_weight(self, _, arr):
+        weight = _np.zeros(arr.shape, dtype="float32").reshape(-1)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(_np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = `forget_bias`, others 0 (reference semantics;
+    gate order i, f, c, o)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, _, arr):
+        b = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(arr.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+
+class Mixed:
+    """Pattern→initializer dispatch. reference: initializer.Mixed."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must have same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError("Parameter name %s did not match any pattern" % name)
+
+
+class Load:
+    """Init from a loaded param dict, falling back to default_init.
+    reference: initializer.Load."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            param = nd.load(param)
+        self.param = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            p = self.param[name]
+            if tuple(p.shape) != tuple(arr.shape):
+                raise ValueError("Parameter %s cannot be initialized from "
+                                 "loading. Incompatible shape %s vs %s"
+                                 % (name, p.shape, arr.shape))
+            arr[:] = p.asnumpy()
+        else:
+            if self.default_init is None:
+                raise ValueError("Cannot Initialize parameter %s" % name)
+            self.default_init(name, arr)

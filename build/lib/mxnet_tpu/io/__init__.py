@@ -1,0 +1,6 @@
+"""`mx.io` — data loading (reference: python/mxnet/io/)."""
+from . import params_serde
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, LibSVMIter)
+from .image_iters import (ImageRecordIter, CSVIter, MNISTIter,
+                          ImageDetRecordIter)

@@ -1,0 +1,362 @@
+"""Data iterators.
+
+TPU-native analog of the reference's `mx.io` (reference: python/mxnet/io/io.py
+(DataIter, NDArrayIter, DataBatch, DataDesc), src/io/iter_prefetcher.h).
+The C++ PrefetcherIter double-buffering maps to async PjRt H2D transfers:
+`as_in_context` on a jax backend is non-blocking, so handing the next batch to
+the device while the current one computes happens naturally.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "LibSVMIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """reference: python/mxnet/io/io.py (DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), _np.dtype(dtype), layout)
+
+
+class DataBatch:
+    """reference: python/mxnet/io/io.py (DataBatch)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """reference DataIter protocol: reset / next / iter_next / getdata."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {("%s_%d" % (default_name, i)) if len(data) > 1 else
+                default_name: d for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, _np.ndarray):
+            v = array(v, dtype=v.dtype if v.dtype != _np.float64 else None)
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """reference: python/mxnet/io/io.py (NDArrayIter) — iterate over in-memory
+    arrays with optional shuffle and last-batch padding/discard."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 shuffle_seed=None,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.last_batch_handle = last_batch_handle
+        self.shuffle = shuffle
+        self._shuffle_seed = shuffle_seed
+        self.cursor = -batch_size
+        self._order = _np.arange(self.num_data)
+        if shuffle:
+            self._rng = _np.random.RandomState(shuffle_seed)
+            self._rng.shuffle(self._order)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrs):
+        out = []
+        for _, v in arrs:
+            idx = self._order[self.cursor:self.cursor + self.batch_size]
+            if len(idx) < self.batch_size and self.last_batch_handle == "pad":
+                wrap = self._order[:self.batch_size - len(idx)]
+                idx = _np.concatenate([idx, wrap])
+            out.append(v[array(idx, dtype="int32")]
+                       if isinstance(v, NDArray) else array(v[idx]))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """reference: io.py (ResizeIter) — resize an iterator to n batches/epoch."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+
+class PrefetchingIter(DataIter):
+    """reference: io.py (PrefetchingIter) — background-thread prefetch
+    (the C++ PrefetcherIter analog; device H2D is already async under PjRt)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self._queue = queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    def _start(self):
+        import threading
+
+        def worker():
+            try:
+                while not self._stop.is_set():
+                    batches = [i.next() for i in self.iters]
+                    self._queue.put(batches)
+            except StopIteration:
+                self._queue.put(None)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for i in self.iters:
+            i.reset()
+        self._stop.clear()
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        b = batches[0]
+        if len(batches) > 1:
+            data = sum([x.data for x in batches], [])
+            label = sum([x.label for x in batches], [])
+            return DataBatch(data=data, label=label, pad=b.pad)
+        return b
+
+    def iter_next(self):
+        raise NotImplementedError
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator yielding CSR data batches. reference:
+    src/io/iter_libsvm.cc (LibSVMIter) — the input path of the sparse
+    linear/FM configs (BASELINE config #4). Format per line:
+    ``label idx:val idx:val ...`` (indices may be 0- or 1-based; pass
+    the feature dim via data_shape)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = (data_shape,) if isinstance(data_shape, int) \
+            else tuple(data_shape)
+        dim = self._data_shape[-1]
+        labels, rows_data, rows_idx = [], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                idx, val = [], []
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                rows_idx.append(idx)
+                rows_data.append(val)
+        if label_libsvm is not None:
+            # separate label file (reference: iter_libsvm.cc label_libsvm) —
+            # first token per line is the label; feature tokens are ignored
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        labels.append(float(parts[0]))
+            if len(labels) != len(rows_data):
+                raise ValueError(
+                    "label_libsvm has %d rows but data has %d"
+                    % (len(labels), len(rows_data)))
+        self._num = len(labels)
+        self._labels = _np.asarray(labels, dtype=_np.float32)
+        self._rows_idx = rows_idx
+        self._rows_data = rows_data
+        self._dim = dim
+        self.cursor = -batch_size
+        self.round_batch = round_batch
+        self.num_batches = (self._num + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._dim),
+                         _np.float32)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,), _np.float32)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self._num
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from ..ndarray import sparse as _sp
+        start = self.cursor
+        stop = min(start + self.batch_size, self._num)
+        sel = list(range(start, stop))
+        pad = self.batch_size - len(sel)
+        if pad and self.round_batch:
+            # wrap around (reference round_batch); modulo handles datasets
+            # smaller than one batch
+            sel += [i % self._num for i in range(pad)]
+        data_vals, col_idx, indptr = [], [], [0]
+        for i in sel:
+            data_vals.extend(self._rows_data[i])
+            col_idx.extend(self._rows_idx[i])
+            indptr.append(len(col_idx))
+        csr = _sp.csr_matrix(
+            (_np.asarray(data_vals, _np.float32),
+             _np.asarray(col_idx, _np.int32),
+             _np.asarray(indptr, _np.int32)),
+            shape=(len(sel), self._dim))
+        label = array(self._labels[sel])
+        # pad counts wrap rows so consumers (BaseModule.predict) can slice
+        # them off — same contract as NDArrayIter.getpad()
+        return DataBatch(data=[csr], label=[label],
+                         pad=pad if self.round_batch else 0)

@@ -1,0 +1,108 @@
+"""Binary serialization of NDArray dicts — the `.params` checkpoint format.
+
+TPU-native analog of the reference's dmlc-serialized save/load (reference:
+src/ndarray/ndarray.cc (NDArray::Save/Load), src/c_api/c_api.cc
+(MXNDArraySave/MXNDArrayLoad); format constants from include/mxnet/ndarray.h).
+
+Layout (little-endian), following the reference's 1.x on-disk framing:
+  uint64 kMXAPINDArrayListMagic (0x112)
+  uint64 reserved (0)
+  uint64 num_arrays
+  per array (NDArray::Save V2):
+    uint32 NDARRAY_V2_MAGIC (0xF993FAC9)
+    int32  stype (0=default; sparse saved densified, like gluon Parameter._reduce)
+    uint32 ndim, int64 dims[ndim]
+    int32  dev_type, int32 dev_id        (context; ignored on load)
+    int32  dtype (mshadow type code)
+    raw data bytes (shape.prod * dtype size)
+  uint64 num_names
+  per name: uint64 len, bytes
+
+NOTE: the reference mount was empty at survey time (SURVEY.md §0); magic
+values follow upstream Apache MXNet 1.x and should be spot-checked against a
+real `.params` file when one is available.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ..base import _DTYPE_NP_TO_MX, _DTYPE_MX_TO_NP
+
+_LIST_MAGIC = 0x112
+_NDARRAY_V2_MAGIC = 0xF993FAC9
+
+
+def _write_ndarray(f, arr):
+    a = _np.ascontiguousarray(arr.asnumpy() if hasattr(arr, "asnumpy")
+                              else _np.asarray(arr))
+    f.write(struct.pack("<I", _NDARRAY_V2_MAGIC))
+    f.write(struct.pack("<i", 0))                       # stype: dense
+    f.write(struct.pack("<I", a.ndim))
+    for d in a.shape:
+        f.write(struct.pack("<q", d))
+    f.write(struct.pack("<ii", 1, 0))                   # cpu(0)
+    f.write(struct.pack("<i", _DTYPE_NP_TO_MX[_np.dtype(a.dtype)]))
+    f.write(a.tobytes())
+
+
+def _read_ndarray(f):
+    magic, = struct.unpack("<I", f.read(4))
+    if magic != _NDARRAY_V2_MAGIC:
+        raise IOError("bad NDArray magic 0x%x (expected 0x%x)" %
+                      (magic, _NDARRAY_V2_MAGIC))
+    stype, = struct.unpack("<i", f.read(4))
+    ndim, = struct.unpack("<I", f.read(4))
+    shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+    struct.unpack("<ii", f.read(8))                     # context, ignored
+    dtype_code, = struct.unpack("<i", f.read(4))
+    dt = _DTYPE_MX_TO_NP[dtype_code]
+    n = 1
+    for d in shape:
+        n *= d
+    buf = f.read(n * dt.itemsize)
+    return _np.frombuffer(buf, dtype=dt).reshape(shape).copy()
+
+
+def save_ndarrays(fname, data):
+    """reference: mx.nd.save — accepts a dict[str, NDArray], list, or single."""
+    from ..ndarray.ndarray import NDArray
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_ndarray(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for nm in names:
+            b = nm.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load_ndarrays(fname, ctx=None):
+    """reference: mx.nd.load — returns dict if names present, else list."""
+    from ..ndarray.ndarray import array
+    with open(fname, "rb") as f:
+        magic, _ = struct.unpack("<QQ", f.read(16))
+        if magic != _LIST_MAGIC:
+            raise IOError("bad .params magic 0x%x" % magic)
+        n, = struct.unpack("<Q", f.read(8))
+        arrays = [_read_ndarray(f) for _ in range(n)]
+        n_names, = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(n_names):
+            ln, = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    nds = [array(a, ctx=ctx, dtype=a.dtype) for a in arrays]
+    if names:
+        return dict(zip(names, nds))
+    return nds

@@ -1,0 +1,4 @@
+"""KVStore package. reference: python/mxnet/kvstore/__init__.py."""
+from .kvstore import KVStore, KVStoreLocal, create
+
+__all__ = ["KVStore", "KVStoreLocal", "create"]

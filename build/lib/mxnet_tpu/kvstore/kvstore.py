@@ -1,0 +1,255 @@
+"""KVStore: the key-value gradient/parameter aggregation API.
+
+TPU-native analog of reference src/kvstore/ + python/mxnet/kvstore/kvstore.py.
+The API (create/init/push/pull/pushpull/row_sparse_pull/set_optimizer) is
+preserved verbatim. Backend mapping (SURVEY.md §5.8):
+
+* `local` / `device` — single-process multi-device aggregation. The
+  reference reduces on CPU (`KVStoreLocal`, src/kvstore/kvstore_local.h) or
+  P2P on GPUs (`CommDevice`, src/kvstore/comm.h); here the reduce is a jnp
+  sum over per-device replicas — XLA emits the transfer+add chain, and on a
+  sharded mesh the same call lowers to an ICI all-reduce.
+* `nccl` — alias of `device` (the ring-allreduce role is played by XLA
+  collectives; reference: src/kvstore/kvstore_nccl.h).
+* `dist_sync` / `dist_async` / `dist_device_sync` — multi-process global
+  mesh over `jax.distributed` (see kvstore_dist.py). Parameter-server
+  semantics (server-side optimizer via set_optimizer) are preserved with
+  optimizer states sharded ZeRO-style instead of server processes.
+
+Push/pull keeps the reference's aggregation contract: push accumulates the
+sum of all pushed values per key; pull broadcasts the merged value.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+
+__all__ = ["KVStore", "KVStoreLocal", "create"]
+
+
+def _key_list(key):
+    return key if isinstance(key, (list, tuple)) else [key]
+
+
+def _val_list(value, nkeys):
+    if isinstance(value, (list, tuple)):
+        if len(value) and isinstance(value[0], (list, tuple)):
+            return list(value)
+        if nkeys == 1:
+            return [list(value)] if isinstance(value[0], nd.NDArray) and \
+                len(value) > 1 else [value[0] if len(value) == 1 else
+                                     list(value)]
+        return list(value)
+    return [value]
+
+
+class KVStore:
+    """Base/abstract store. reference: python/mxnet/kvstore/kvstore.py."""
+
+    def __init__(self):
+        self._updater = None
+        self._compression_params = None
+
+    # -- interface ------------------------------------------------------
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise NotImplementedError
+
+    def set_gradient_compression(self, compression_params):
+        """reference: KVStore::SetGradientCompression (2bit/signum).
+        Stored and applied by dist backends; local stores note it only."""
+        self._compression_params = dict(compression_params)
+
+    def set_optimizer(self, optimizer):
+        """Run the optimizer on the store (server-side update semantics).
+        reference: kvstore.py (set_optimizer) — pickles the optimizer to
+        servers; here the updater runs wherever the merged value lives."""
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def barrier(self):
+        nd.waitall()
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+class KVStoreLocal(KVStore):
+    """Single-process aggregation store (types local/device/nccl).
+    reference: src/kvstore/kvstore_local.h (KVStoreLocal) + comm.h
+    (CommCPU/CommDevice)."""
+
+    def __init__(self, type_name="local"):
+        super().__init__()
+        self._type = type_name
+        self._store = {}          # key -> merged NDArray (master copy)
+        self._updater = None
+
+    @property
+    def type(self):
+        return self._type
+
+    def init(self, key, value):
+        keys = _key_list(key)
+        values = _val_list(value, len(keys))
+        assert len(keys) == len(values), "key/value length mismatch"
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            if str(k) in self._store:
+                raise ValueError("duplicate init of key " + str(k))
+            self._store[str(k)] = v.copy()
+
+    def _check_keys(self, keys):
+        for k in keys:
+            if str(k) not in self._store:
+                raise MXNetError("key %s has not been initialized" % str(k))
+
+    def _merge(self, vals):
+        """Sum device replicas (reference: CommDevice::Reduce). All-rsp
+        pushes stay row_sparse so the updater's lazy path applies
+        (reference: CommCPU::ReduceRowSparse)."""
+        from ..ndarray import sparse as _sp
+        if isinstance(vals, nd.NDArray):
+            return vals
+        if len(vals) == 1:
+            return vals[0]
+        if all(isinstance(v, _sp.RowSparseNDArray) for v in vals):
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = _sp.elemwise_add(acc, v)
+            return acc
+        ctx = self._store_ctx_for(vals)
+        acc = vals[0].as_in_context(ctx)._read()
+        for v in vals[1:]:
+            acc = acc + v.as_in_context(ctx)._read()
+        return nd.from_jax(acc, ctx=ctx)
+
+    @staticmethod
+    def _store_ctx_for(vals):
+        return vals[0].context
+
+    def push(self, key, value, priority=0):
+        """Merge (sum) the pushed device values per key. Without an updater
+        the merged value REPLACES the store; with an updater the store holds
+        weights and the updater applies the merged gradient (reference:
+        KVStoreLocal::PushImpl — updater_ path vs CopyFromTo path)."""
+        keys = _key_list(key)
+        values = _val_list(value, len(keys))
+        assert len(keys) == len(values), "key/value length mismatch"
+        self._check_keys(keys)
+        for k, v in zip(keys, values):
+            merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
+            k = str(k)
+            stored = self._store[k]
+            if self._updater is not None:
+                idx = int(k) if k.isdigit() else k
+                self._updater(idx, merged, stored)
+            else:
+                stored._write(merged.as_in_context(
+                    stored.context)._read().astype(stored.dtype))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast merged value to all outs (reference:
+        KVStoreLocal::PullImpl → comm Broadcast)."""
+        assert out is not None, "pull requires out="
+        keys = _key_list(key)
+        outs = _val_list(out, len(keys))
+        self._check_keys(keys)
+        for k, o in zip(keys, outs):
+            src = self._store[str(k)]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                src.copyto(t)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only requested rows (reference: KVStoreLocal
+        RowSparsePull). Dense-backed: gathers rows by id."""
+        assert out is not None and row_ids is not None
+        keys = _key_list(key)
+        outs = _val_list(out, len(keys))
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(keys) > 1:
+            rids = rids * len(keys)
+        self._check_keys(keys)
+        from ..ndarray import sparse as _sp
+        for k, o, r in zip(keys, outs, rids):
+            src = self._store[str(k)]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                rows = r.data_jax.astype("int32") if isinstance(
+                    r, nd.NDArray) else _sp.jnp.asarray(r, dtype="int32")
+                # sorted unique ids: the RowSparseNDArray invariant that
+                # retain()'s searchsorted relies on
+                rows = _sp.jnp.unique(rows)
+                if isinstance(src, _sp.RowSparseNDArray):
+                    gathered = _sp.retain(src, rows)
+                    vals, idx = gathered._values, gathered._indices
+                else:  # dense-backed store: plain row gather
+                    vals, idx = src._read()[rows], rows
+                if not isinstance(t, _sp.RowSparseNDArray):
+                    raise ValueError(
+                        "row_sparse_pull requires row_sparse outs "
+                        "(reference kvstore restriction); got stype %s"
+                        % t.stype)
+                t._values = vals.astype(t.dtype)
+                t._indices = idx
+
+
+def create(name="local"):
+    """Factory. reference: python/mxnet/kvstore/kvstore.py (create)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "nccl"):
+        return KVStoreLocal("device" if name in ("device", "nccl") else
+                            "local")
+    if name.startswith("dist"):
+        from .kvstore_dist import KVStoreDist
+        return KVStoreDist(name)
+    raise ValueError("unknown KVStore type %s" % name)

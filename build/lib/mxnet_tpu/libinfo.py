@@ -1,0 +1,32 @@
+"""`mx.libinfo` — build/version info.
+
+reference: python/mxnet/libinfo.py (__version__, find_lib_path,
+find_include_path). There is no libmxnet.so here — the "library" is the
+native host-kernel .so plus the JAX/XLA runtime; find_lib_path points at
+the former when built.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import __version__  # noqa: F401  (re-export, reference parity)
+
+__all__ = ["__version__", "find_lib_path", "find_include_path",
+           "features"]
+
+
+def find_lib_path():
+    """Path(s) to the native host-kernel library, if built."""
+    from .native import lib, _OUT
+    return [_OUT] if lib() is not None and os.path.exists(_OUT) else []
+
+
+def find_include_path():
+    """Native sources directory (the ctypes ABI has no headers)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(here), "native")
+
+
+def features():
+    from .runtime import Features
+    return Features()

@@ -1,0 +1,27 @@
+"""`mx.log` — logging helpers (reference: python/mxnet/log.py)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger"]
+
+_LOG_FMT = "%(asctime)s [%(levelname)s] %(name)s %(message)s"
+_DATE_FMT = "%m%d %H:%M:%S"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=logging.WARNING):
+    """reference: log.get_logger — logger with the mxnet format."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_init_done", False):
+        logger.setLevel(level)
+        return logger
+    logger._init_done = True
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_LOG_FMT, _DATE_FMT))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    return logger

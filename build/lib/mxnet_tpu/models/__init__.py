@@ -1,0 +1,23 @@
+"""First-class model families (TPU-native, functional JAX).
+
+The reference ships vision models in ``gluon/model_zoo/vision`` (mirrored
+here under :mod:`mxnet_tpu.gluon.model_zoo`) and relies on external GluonNLP
+for transformers. The TPU build promotes transformers to first-class
+citizens because the north-star configs (BERT-base, Llama-3-8B sharded)
+require them: these are pure-functional param-tree models designed to
+compose with :mod:`mxnet_tpu.parallel` (sharding rules, flash/ring
+attention, fused train step).
+"""
+from . import llama
+from . import bert
+from . import resnet
+from .llama import LlamaConfig, llama_init, llama_forward, llama_loss
+from .bert import BertConfig, bert_init, bert_forward, bert_mlm_loss
+from .resnet import ResNetConfig, resnet_init, resnet_forward, resnet_loss
+
+__all__ = [
+    "llama", "bert", "resnet",
+    "LlamaConfig", "llama_init", "llama_forward", "llama_loss",
+    "BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
+    "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",
+]

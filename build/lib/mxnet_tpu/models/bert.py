@@ -1,0 +1,144 @@
+"""BERT encoder, TPU-native (BASELINE.json configs[2]: BERT-base).
+
+The reference served BERT through external GluonNLP built on the fused
+attention ops in ``src/operator/contrib/transformer.cc``
+(``_contrib_interleaved_matmul_selfatt_qk`` etc.); here the whole encoder is
+first-class. Param names (``word_embed``, ``layers/<i>/attn/wq``,
+``ffn/w1`` …) match :data:`mxnet_tpu.parallel.sharding.BERT_RULES` so the
+same tree shards TP+FSDP on a mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.flash_attention import flash_attention
+from .llama import _dense_init
+
+__all__ = ["BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
+           "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_seq_len: int = 512
+    n_types: int = 2
+    norm_eps: float = 1e-12
+    dtype: object = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "bert_base": BertConfig(),
+    "bert_large": BertConfig(dim=1024, n_layers=24, n_heads=16,
+                             hidden_dim=4096),
+    "bert_tiny": BertConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                            hidden_dim=128, max_seq_len=128),
+}
+
+
+def bert_init(key, cfg: BertConfig):
+    d = cfg.dim
+    keys = jax.random.split(key, cfg.n_layers + 4)
+    params = {
+        "word_embed": _dense_init(keys[0], (cfg.vocab_size, d), cfg.dtype,
+                                  scale=0.02),
+        "position_embed": _dense_init(keys[1], (cfg.max_seq_len, d),
+                                      cfg.dtype, scale=0.02),
+        "token_type_embed": _dense_init(keys[2], (cfg.n_types, d),
+                                        cfg.dtype, scale=0.02),
+        "embed_norm": {"gamma": jnp.ones((d,), jnp.float32),
+                       "beta": jnp.zeros((d,), jnp.float32)},
+        "layers": {},
+    }
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[i + 3], 6)
+        params["layers"][str(i)] = {
+            "attn": {
+                "wq": _dense_init(lk[0], (d, d), cfg.dtype),
+                "wk": _dense_init(lk[1], (d, d), cfg.dtype),
+                "wv": _dense_init(lk[2], (d, d), cfg.dtype),
+                "wo": _dense_init(lk[3], (d, d), cfg.dtype),
+                "bq": jnp.zeros((d,), cfg.dtype),
+                "bk": jnp.zeros((d,), cfg.dtype),
+                "bv": jnp.zeros((d,), cfg.dtype),
+                "bo": jnp.zeros((d,), cfg.dtype),
+            },
+            "attn_norm": {"gamma": jnp.ones((d,), jnp.float32),
+                          "beta": jnp.zeros((d,), jnp.float32)},
+            "ffn": {
+                "w1": _dense_init(lk[4], (d, cfg.hidden_dim), cfg.dtype),
+                "b1": jnp.zeros((cfg.hidden_dim,), cfg.dtype),
+                "w2": _dense_init(lk[5], (cfg.hidden_dim, d), cfg.dtype),
+                "b2": jnp.zeros((d,), cfg.dtype),
+            },
+            "ffn_norm": {"gamma": jnp.ones((d,), jnp.float32),
+                         "beta": jnp.zeros((d,), jnp.float32)},
+        }
+    return params
+
+
+def layer_norm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * p["gamma"]
+            + p["beta"]).astype(x.dtype)
+
+
+def _encoder_layer(lp, x, cfg):
+    B, S, _ = x.shape
+    a = lp["attn"]
+    q = (x @ a["wq"] + a["bq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = (x @ a["wk"] + a["bk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    v = (x @ a["wv"] + a["bv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                        v.transpose(0, 2, 1, 3), causal=False)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+    x = layer_norm(x + (o @ a["wo"] + a["bo"]), lp["attn_norm"], cfg.norm_eps)
+    f = lp["ffn"]
+    h = jax.nn.gelu(x @ f["w1"] + f["b1"], approximate=True)
+    return layer_norm(x + (h @ f["w2"] + f["b2"]), lp["ffn_norm"],
+                      cfg.norm_eps)
+
+
+def bert_forward(params, tokens, cfg: BertConfig, token_types=None):
+    """tokens (B,S) int32 → hidden states (B,S,D) in cfg.dtype."""
+    B, S = tokens.shape
+    x = params["word_embed"][tokens]
+    x = x + params["position_embed"][None, :S]
+    if token_types is None:
+        x = x + params["token_type_embed"][0][None, None]
+    else:
+        x = x + params["token_type_embed"][token_types]
+    x = layer_norm(x, params["embed_norm"], cfg.norm_eps)
+    layer = (jax.checkpoint(_encoder_layer, static_argnums=(2,))
+             if cfg.remat else _encoder_layer)
+    for i in range(cfg.n_layers):
+        x = layer(params["layers"][str(i)], x, cfg)
+    return x
+
+
+def bert_mlm_loss(params, batch, cfg: BertConfig):
+    """Masked-LM loss with weight-tied decoder (hidden @ word_embed.T).
+    batch = {'tokens', 'targets', 'mask'} each (B,S); mask 1 where the
+    position is an MLM prediction site."""
+    h = bert_forward(params, batch["tokens"], cfg)
+    logits = (h @ params["word_embed"].T.astype(h.dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["targets"][..., None],
+                               axis=-1)[..., 0]
+    mask = batch["mask"].astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,8 @@
+"""Module API. reference: python/mxnet/module/__init__.py."""
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule",
+           "DataParallelExecutorGroup"]

@@ -1,0 +1,85 @@
+"""`mx.monitor` — training introspection.
+
+reference: python/mxnet/monitor.py (Monitor): registers a stat function
+over intermediate outputs/weights/gradients each N batches and prints an
+aggregate table. The reference hooks the executor's output callback; here
+Module calls `tic_print` around forward/backward and the monitor reads the
+bound arrays directly (same information, no engine callback needed since
+dispatch is async under PjRt anyway).
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """reference: monitor.py (Monitor)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):  # |x|_1 / size — the reference default
+                return x.abs().mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        """Track an executor's arrays (reference: Monitor.install)."""
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+
+    def toc(self):
+        """Collect stats from installed executors; returns (step, name,
+        stat) triples (reference: Monitor.toc)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            arrays = {}
+            arg_names = getattr(exe, "arg_names", None) or []
+            arg_arrays = getattr(exe, "arg_arrays", None) or []
+            arrays.update(zip(arg_names, arg_arrays))
+            grads = getattr(exe, "grad_arrays", None) or []
+            arrays.update(("%s_grad" % n, g)
+                          for n, g in zip(arg_names, grads) if g is not None)
+            outs = getattr(exe, "outputs", None) or []
+            arrays.update(("output%d" % i, o) for i, o in enumerate(outs))
+            for name, arr in arrays.items():
+                if not isinstance(arr, NDArray):
+                    continue
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(arr)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for step, name, stat in self.queue:
+            val = float(stat.asnumpy().reshape(-1)[0]) \
+                if isinstance(stat, NDArray) else float(stat)
+            res.append((step, name, val))
+        self.step += 1
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log (reference: Monitor.toc_print)."""
+        res = self.toc()
+        for step, name, value in res:
+            logging.info("Batch: %7d %30s %s", step, name,
+                         "nan" if math.isnan(value) else "%.8g" % value)
+        return res

@@ -1,0 +1,145 @@
+"""ctypes bindings for the native host kernels (native/mxnet_tpu_native.cc).
+
+The reference ships its IO stack in C++ (dmlc RecordIO, the image decode/
+augment thread pool); this module is the TPU build's equivalent. The shared
+library is compiled lazily with g++ on first use and cached next to the
+source; every caller falls back to pure python when the toolchain or build
+is unavailable, so the package never hard-depends on it.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as _np
+
+__all__ = ["available", "lib", "index_recordio_buffer", "batch_to_chw_norm",
+           "img_to_chw_norm"]
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native",
+    "mxnet_tpu_native.cc")
+_OUT = os.path.join(os.path.dirname(_SRC), "_build",
+                    "libmxnet_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
+           _SRC, "-o", _OUT]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        # retry without OpenMP (toolchains lacking libgomp)
+        try:
+            subprocess.run([a for a in cmd if a != "-fopenmp"], check=True,
+                           capture_output=True, timeout=120)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            return False
+
+
+def lib():
+    """The loaded CDLL, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_OUT) or (
+                os.path.exists(_SRC) and
+                os.path.getmtime(_SRC) > os.path.getmtime(_OUT)):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            cdll = ctypes.CDLL(_OUT)
+        except OSError:
+            return None
+        cdll.mxtpu_recordio_index.restype = ctypes.c_int64
+        cdll.mxtpu_recordio_index.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
+        cdll.mxtpu_img_to_chw_norm.restype = None
+        cdll.mxtpu_batch_to_chw_norm.restype = None
+        _lib = cdll
+    return _lib
+
+
+def available():
+    return lib() is not None
+
+
+def index_recordio_buffer(buf):
+    """Index a .rec byte buffer → (starts, sizes) int64 arrays of logical
+    records (reference: dmlc::RecordIOReader framing scan). Returns None
+    when the native lib is unavailable (callers fall back to python)."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    n = len(buf)
+    cap = max(16, n // 8)       # worst case: empty payloads, 8B per record
+    starts = _np.empty(cap, _np.int64)
+    sizes = _np.empty(cap, _np.int64)
+    count = cdll.mxtpu_recordio_index(
+        buf, n, starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+    if count == -1:
+        raise IOError("Invalid RecordIO magic number")
+    if count == -2:  # capacity exceeded (adversarial framing); python path
+        return None
+    return starts[:count].copy(), sizes[:count].copy()
+
+
+def img_to_chw_norm(img, mean=None, std=None):
+    """uint8 HWC image → normalized float32 CHW, one fused pass."""
+    cdll = lib()
+    img = _np.ascontiguousarray(img, dtype=_np.uint8)
+    h, w, c = img.shape
+    if cdll is None:
+        out = img.astype(_np.float32) / 255.0
+        if mean is not None:
+            out = out - _np.asarray(mean, _np.float32)
+        if std is not None:
+            out = out / _np.asarray(std, _np.float32)
+        return out.transpose(2, 0, 1).copy()
+    dst = _np.empty((c, h, w), _np.float32)
+    mean_p = (_np.ascontiguousarray(mean, _np.float32)
+              .ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              if mean is not None else None)
+    std_p = (_np.ascontiguousarray(std, _np.float32)
+             .ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+             if std is not None else None)
+    cdll.mxtpu_img_to_chw_norm(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w, c,
+        mean_p, std_p, dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return dst
+
+
+def batch_to_chw_norm(batch, mean=None, std=None):
+    """uint8 (B,H,W,C) → float32 (B,C,H,W) normalized, OpenMP across the
+    batch (reference: ImageRecordIOParser2's decode thread pool)."""
+    cdll = lib()
+    batch = _np.ascontiguousarray(batch, dtype=_np.uint8)
+    b, h, w, c = batch.shape
+    if cdll is None:
+        return _np.stack([img_to_chw_norm(im, mean, std) for im in batch])
+    dst = _np.empty((b, c, h, w), _np.float32)
+    mean_p = (_np.ascontiguousarray(mean, _np.float32)
+              .ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              if mean is not None else None)
+    std_p = (_np.ascontiguousarray(std, _np.float32)
+             .ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+             if std is not None else None)
+    cdll.mxtpu_batch_to_chw_norm(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), b, h, w, c,
+        mean_p, std_p, dst.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return dst

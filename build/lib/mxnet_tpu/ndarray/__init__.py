@@ -1,0 +1,56 @@
+"""`mx.nd` — the imperative NDArray namespace.
+
+reference: python/mxnet/ndarray/__init__.py. Every registered op appears here
+as a function (codegen'd from the registry), alongside the NDArray class and
+creation routines.
+"""
+import sys as _sys
+import types as _types
+
+from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty,
+                      arange, concat, stack, waitall, from_jax, save, load,
+                      moveaxis, split_v2)
+from . import register as _register
+
+_register.populate(globals())
+
+# mx.nd.random.* sub-namespace (reference: python/mxnet/ndarray/random.py)
+random = _types.ModuleType(__name__ + ".random")
+for _pub, _src in [("uniform", "_random_uniform"), ("normal", "_random_normal"),
+                   ("randint", "_random_randint"), ("gamma", "_random_gamma"),
+                   ("exponential", "_random_exponential"),
+                   ("poisson", "_random_poisson"),
+                   ("negative_binomial", "_random_negative_binomial"),
+                   ("generalized_negative_binomial",
+                    "_random_generalized_negative_binomial"),
+                   ("multinomial", "_sample_multinomial"),
+                   ("shuffle", "_shuffle"),
+                   ("randn", "_random_normal")]:
+    setattr(random, _pub, _register.make_op_func(_src))
+_sys.modules[random.__name__] = random
+
+from . import sparse  # noqa: E402  (row_sparse / csr)
+
+
+def Custom(*args, **kwargs):
+    """Run a registered custom op (reference: mx.nd.Custom → custom.cc)."""
+    from ..operator import invoke_custom
+    return invoke_custom(*args, **kwargs)
+
+
+# mx.nd.contrib.* sub-namespace (reference: python/mxnet/ndarray/contrib.py —
+# every `_contrib_*` registered op under its short name)
+contrib = _types.ModuleType(__name__ + ".contrib")
+from ..ops import registry as _reg_mod  # noqa: E402
+for _full in list(_reg_mod.list_ops()):
+    if _full.startswith("_contrib_"):
+        setattr(contrib, _full[len("_contrib_"):],
+                _register.make_op_func(_full))
+# control-flow contrib ops are python-level (they take function-valued
+# args, like the reference's contrib.foreach/while_loop/cond)
+from .contrib_flow import foreach as _foreach, \
+    while_loop as _while_loop, cond as _cond  # noqa: E402
+contrib.foreach = _foreach
+contrib.while_loop = _while_loop
+contrib.cond = _cond
+_sys.modules[contrib.__name__] = contrib

@@ -1,0 +1,203 @@
+"""Control-flow contrib ops: foreach / while_loop / cond.
+
+reference: src/operator/control_flow.cc + python/mxnet/ndarray/contrib.py
+(foreach, while_loop, cond) — the reference's dynamic-model building
+blocks. Two regimes, exactly like the reference:
+
+* imperative (eager NDArrays): a Python loop / branch call, so the
+  autograd tape records every op — gradients flow to any NDArray the body
+  closes over, and `while_loop` runs its true dynamic trip count;
+* traced (inside hybridize()/jit, payloads are tracers): `foreach` IS
+  `lax.scan`, `while_loop` IS `lax.while_loop` over a
+  max_iterations-sized buffer, `cond` IS `lax.cond` — compiled control
+  flow, not an unrolled graph (the reference's C++ subgraph ops made the
+  same move).
+
+For shape stability across both regimes, `while_loop` always returns a
+(max_iterations, ...) output buffer, zero-padded past the trip count —
+the reference's symbolic-mode convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ndarray import NDArray, from_jax, _is_tracer
+from ..context import current_context
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _wrap(x, ctx):
+    return from_jax(x, ctx=ctx) if not isinstance(x, NDArray) else x
+
+
+def _unwrap(x):
+    return x._read() if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _map_unwrap(tree):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_map_unwrap(t) for t in tree)
+    return _unwrap(tree)
+
+
+def _map_wrap(tree, ctx):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_map_wrap(t, ctx) for t in tree)
+    return _wrap(tree, ctx)
+
+
+def _any_tracer(tree):
+    if isinstance(tree, (list, tuple)):
+        return any(_any_tracer(t) for t in tree)
+    return _is_tracer(_unwrap(tree))
+
+
+def foreach(body, data, init_states):
+    """Scan `body(data_slice, states) -> (outputs, new_states)` along
+    axis 0 of `data`; returns (stacked_outputs, final_states).
+    reference: contrib.foreach."""
+    ctx = current_context()
+    if _any_tracer(data) or _any_tracer(init_states):
+        data_raw = _map_unwrap(data)
+        states_raw = _map_unwrap(init_states)
+
+        def scan_body(carry, x):
+            out, new_states = body(_map_wrap(x, ctx), _map_wrap(carry, ctx))
+            return _map_unwrap(new_states), _map_unwrap(out)
+
+        final_raw, outs_raw = lax.scan(scan_body, states_raw, data_raw)
+        return _map_wrap(outs_raw, ctx), _map_wrap(final_raw, ctx)
+
+    # imperative: python loop — every body op lands on the autograd tape
+    from . import stack as _nd_stack
+    n = (data.shape[0] if isinstance(data, NDArray)
+         else data[0].shape[0])
+    states = init_states
+    outs = []
+    for i in range(n):
+        x = data[i] if isinstance(data, NDArray) else \
+            type(data)(d[i] for d in data)
+        out, states = body(x, states)
+        outs.append(out)
+    if not outs:   # T == 0: empty buffer, like the lax.scan path
+        out_shapes = jax.eval_shape(
+            lambda d, st: _map_unwrap(body(_map_wrap(d, ctx),
+                                           _map_wrap(st, ctx))[0]),
+            _map_unwrap(data[0] if isinstance(data, NDArray)
+                        else type(data)(d[0] for d in data))
+            if n else jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape[1:], a.dtype),
+                _map_unwrap(data)),
+            _map_unwrap(init_states))
+        empty = jax.tree_util.tree_map(
+            lambda sh: from_jax(jnp.zeros((0,) + sh.shape, sh.dtype),
+                                ctx=ctx), out_shapes)
+        return empty, states
+    if isinstance(outs[0], (list, tuple)):
+        stacked = type(outs[0])(
+            _nd_stack(*[o[j] for o in outs], axis=0)
+            for j in range(len(outs[0])))
+    else:
+        stacked = _nd_stack(*outs, axis=0)
+    return stacked, states
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """`while cond_fn(*loop_vars): outputs, loop_vars = func(*loop_vars)`.
+    Returns (stacked_outputs, final_loop_vars); outputs live in a
+    (max_iterations, ...) buffer zero-padded past the trip count.
+    reference: contrib.while_loop."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static "
+                         "shapes: the output buffer must be allocated "
+                         "before tracing)")
+    ctx = current_context()
+    loop_vars = tuple(loop_vars) if isinstance(loop_vars, (list, tuple)) \
+        else (loop_vars,)
+    if _any_tracer(loop_vars):
+        vars_raw = _map_unwrap(loop_vars)
+        out_shapes = jax.eval_shape(
+            lambda vr: _map_unwrap(func(*_map_wrap(vr, ctx))[0]), vars_raw)
+        out_buf = jax.tree_util.tree_map(
+            lambda s: jnp.zeros((max_iterations,) + s.shape, s.dtype),
+            out_shapes)
+
+        def cond_wrap(state):
+            i, buf, vr = state
+            c = _unwrap(cond_fn(*_map_wrap(vr, ctx)))
+            return jnp.logical_and(i < max_iterations,
+                                   c.reshape(()).astype(bool))
+
+        def body_wrap(state):
+            i, buf, vr = state
+            out, new_vars = func(*_map_wrap(vr, ctx))
+            if not isinstance(new_vars, (list, tuple)):
+                new_vars = (new_vars,)
+            new_vars = tuple(new_vars)
+            out_raw = _map_unwrap(out)
+            buf = jax.tree_util.tree_map(
+                lambda b, o: lax.dynamic_update_index_in_dim(b, o, i, 0),
+                buf, out_raw)
+            return i + 1, buf, _map_unwrap(new_vars)
+
+        _, buf, final_raw = lax.while_loop(
+            cond_wrap, body_wrap, (jnp.int32(0), out_buf, vars_raw))
+        return _map_wrap(buf, ctx), _map_wrap(final_raw, ctx)
+
+    # imperative: true dynamic trip count; pad with zeros via nd ops so
+    # the result shape matches the traced regime
+    from . import stack as _nd_stack, zeros_like as _nd_zeros_like
+    vars_ = tuple(loop_vars)
+    outs = []
+    steps = 0
+    while steps < max_iterations and bool(
+            _unwrap(cond_fn(*vars_)).reshape(())):
+        out, new_vars = func(*vars_)
+        vars_ = tuple(new_vars) if isinstance(new_vars, (list, tuple)) \
+            else (new_vars,)
+        outs.append(out)
+        steps += 1
+    if not outs:   # zero trips: zero buffer, same as the traced regime
+        out_shapes = jax.eval_shape(
+            lambda vr: _map_unwrap(func(*_map_wrap(vr, ctx))[0]),
+            _map_unwrap(vars_))
+        zero = jax.tree_util.tree_map(
+            lambda sh: from_jax(
+                jnp.zeros((max_iterations,) + sh.shape, sh.dtype), ctx=ctx),
+            out_shapes)
+        return zero, vars_
+
+    def pad_stack(slices):
+        pad = [_nd_zeros_like(slices[-1])] * (max_iterations - len(slices))
+        return _nd_stack(*(list(slices) + pad), axis=0)
+
+    if isinstance(outs[0], (list, tuple)):
+        stacked = type(outs[0])(
+            pad_stack([o[j] for o in outs]) for j in range(len(outs[0])))
+    else:
+        stacked = pad_stack(outs)
+    return stacked, vars_
+
+
+def cond(pred, then_func, else_func, inputs=()):
+    """`then_func(*inputs)` when pred else `else_func(*inputs)`.
+    Imperatively only the taken branch runs (reference behavior); under
+    tracing both branches compile into one `lax.cond`.
+    reference: contrib.cond."""
+    ctx = current_context()
+    if _any_tracer(pred) or _any_tracer(tuple(inputs)):
+        pred_raw = _unwrap(pred).reshape(()).astype(bool)
+        in_raw = _map_unwrap(tuple(inputs))
+
+        def mk(fn):
+            def br(raws):
+                return _map_unwrap(fn(*_map_wrap(raws, ctx)))
+            return br
+
+        out_raw = lax.cond(pred_raw, mk(then_func), mk(else_func), in_raw)
+        return _map_wrap(out_raw, ctx)
+    taken = then_func if bool(_unwrap(pred).reshape(())) else else_func
+    return taken(*inputs)

@@ -1,0 +1,30 @@
+"""Code-generate the `mx.nd.*` namespace from the op registry.
+
+TPU-native analog of the reference's import-time codegen (reference:
+python/mxnet/ndarray/register.py — introspects the C op registry via
+MXSymbolListAtomicSymbolCreators and emits one Python function per op). Here
+the registry is Python-side, so generation is a loop over
+`ops.registry.list_ops()`.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .ndarray import invoke
+
+
+def make_op_func(name):
+    op = _reg.get(name)
+
+    def op_func(*args, out=None, **kwargs):
+        return invoke(name, *args, out=out, **kwargs)
+
+    op_func.__name__ = name.lstrip("_") or name
+    op_func.__qualname__ = op_func.__name__
+    op_func.__doc__ = op.doc or ("%s (auto-generated from the op registry)" % name)
+    return op_func
+
+
+def populate(namespace, names=None):
+    for name in (names or _reg.list_ops()):
+        namespace.setdefault(name, make_op_func(name))
+    return namespace

@@ -1,0 +1,269 @@
+"""Sparse NDArray: `row_sparse` and `csr` storage.
+
+TPU-native analog of the reference's sparse storage types (reference:
+include/mxnet/ndarray.h (kRowSparseStorage/kCSRStorage),
+python/mxnet/ndarray/sparse.py, src/operator/tensor/cast_storage-inl.h).
+XLA has no native sparse tensors, so — per SURVEY.md §2.1 — row_sparse is an
+(indices, values) pair driving `segment_sum`/gather-scatter, and csr is
+(data, indices, indptr). Dense fallbacks are used where a fused kernel is not
+yet provided; thresholds are documented per-op.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..context import current_context
+from .ndarray import NDArray, from_jax
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros", "retain", "dot", "add", "elemwise_add"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; `_read()` densifies so any dense op still works
+    (the reference's FComputeEx fallback-to-dense behavior)."""
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """reference: python/mxnet/ndarray/sparse.py (RowSparseNDArray) — a set of
+    rows (`indices`) plus their values; rows absent are zero."""
+
+    __slots__ = ("_indices", "_values", "_shape_full")
+
+    def __init__(self, values, indices, shape, ctx=None):
+        super().__init__(None, ctx=ctx or current_context(), stype="row_sparse")
+        self._values = values          # (nnz_rows, *row_shape) jax array
+        self._indices = indices        # (nnz_rows,) int32, sorted unique
+        self._shape_full = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._shape_full
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values.dtype)
+
+    @property
+    def indices(self):
+        return from_jax(self._indices, ctx=self._ctx)
+
+    @property
+    def data(self):
+        return from_jax(self._values, ctx=self._ctx)
+
+    def _read(self):  # densify
+        out = jnp.zeros(self._shape_full, dtype=self._values.dtype)
+        return out.at[self._indices].set(self._values)
+
+    def _write(self, value):
+        # dense write collapses to dense storage of all rows
+        self._indices = jnp.arange(self._shape_full[0], dtype=jnp.int32)
+        self._values = value
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def copy(self):
+        return RowSparseNDArray(self._values, self._indices, self._shape_full,
+                                ctx=self._ctx)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % (
+            "x".join(str(d) for d in self._shape_full), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """reference: python/mxnet/ndarray/sparse.py (CSRNDArray)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_indptr", "_shape_full")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(None, ctx=ctx or current_context(), stype="csr")
+        self._sp_data = data
+        self._sp_indices = indices
+        self._indptr = indptr
+        self._shape_full = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._shape_full
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._sp_data.dtype)
+
+    @property
+    def data(self):
+        return from_jax(self._sp_data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return from_jax(self._sp_indices, ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return from_jax(self._indptr, ctx=self._ctx)
+
+    def _row_ids(self):
+        # expand indptr → per-nnz row ids (static nnz)
+        nnz = self._sp_data.shape[0]
+        return jnp.searchsorted(self._indptr, jnp.arange(nnz) + 1) - 0
+
+    def _read(self):
+        m, n = self._shape_full
+        rows = jnp.searchsorted(
+            self._indptr, jnp.arange(self._sp_data.shape[0]), side="right") - 1
+        out = jnp.zeros((m, n), dtype=self._sp_data.dtype)
+        return out.at[rows, self._sp_indices].add(self._sp_data)
+
+    def _write(self, value):
+        raise NotImplementedError("in-place write to csr is not supported "
+                                  "(matches reference restriction)")
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % (
+            "x".join(str(d) for d in self._shape_full), self._ctx)
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference: mx.nd.sparse.row_sparse_array / csr_matrix)
+# ---------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    dtype = _np.dtype(dtype) if dtype else _np.float32
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not _np.isscalar(arg1[0]):
+        values, indices = arg1
+        values = jnp.asarray(_np.asarray(values), dtype=dtype)
+        indices = jnp.asarray(_np.asarray(indices), dtype=jnp.int32)
+        return RowSparseNDArray(values, indices, shape, ctx=ctx)
+    dense = _np.asarray(arg1, dtype=dtype)
+    nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz]),
+                            jnp.asarray(nz, dtype=jnp.int32),
+                            dense.shape, ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    dtype = _np.dtype(dtype) if dtype else _np.float32
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(_np.asarray(data), dtype=dtype),
+                          jnp.asarray(_np.asarray(indices), dtype=jnp.int32),
+                          jnp.asarray(_np.asarray(indptr), dtype=jnp.int32),
+                          shape, ctx=ctx)
+    dense = _np.asarray(arg1, dtype=dtype)
+    try:
+        import scipy.sparse as sps
+        sp = sps.csr_matrix(dense)
+        return CSRNDArray(jnp.asarray(sp.data, dtype=dtype),
+                          jnp.asarray(sp.indices, dtype=jnp.int32),
+                          jnp.asarray(sp.indptr, dtype=jnp.int32),
+                          dense.shape, ctx=ctx)
+    except ImportError:
+        rows, cols = _np.nonzero(dense)
+        order = _np.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        indptr = _np.zeros(dense.shape[0] + 1, dtype=_np.int32)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr).astype(_np.int32)
+        return CSRNDArray(jnp.asarray(dense[rows, cols]),
+                          jnp.asarray(cols.astype(_np.int32)),
+                          jnp.asarray(indptr), dense.shape, ctx=ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = _np.dtype(dtype) if dtype else _np.float32
+    if stype == "row_sparse":
+        row_shape = tuple(shape[1:])
+        return RowSparseNDArray(jnp.zeros((0,) + row_shape, dtype=dtype),
+                                jnp.zeros((0,), dtype=jnp.int32), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype=dtype),
+                          jnp.zeros((0,), dtype=jnp.int32),
+                          jnp.zeros((shape[0] + 1,), dtype=jnp.int32),
+                          shape, ctx=ctx)
+    from .ndarray import zeros as dzeros
+    return dzeros(shape, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage casts & sparse ops (reference: cast_storage-inl.h, dot.cc sparse
+# kernels, sparse_retain.cc)
+# ---------------------------------------------------------------------------
+def cast_storage(arr, stype):
+    if stype == arr.stype:
+        return arr
+    if stype == "default":
+        return NDArray(arr._read(), ctx=arr._ctx)
+    dense = _np.asarray(arr._read())
+    if stype == "row_sparse":
+        return row_sparse_array(dense, shape=dense.shape, ctx=arr._ctx,
+                                dtype=dense.dtype)
+    if stype == "csr":
+        return csr_matrix(dense, shape=dense.shape, ctx=arr._ctx,
+                          dtype=dense.dtype)
+    raise ValueError("unknown stype " + stype)
+
+
+def retain(arr, indices):
+    """reference: sparse_retain op — keep only the given rows."""
+    idx = jnp.asarray(_np.asarray(indices), dtype=jnp.int32) if not isinstance(
+        indices, NDArray) else indices.data_jax.astype(jnp.int32)
+    pos = jnp.searchsorted(arr._indices, idx)
+    pos = jnp.clip(pos, 0, max(arr._indices.shape[0] - 1, 0))
+    present = (arr._indices[pos] == idx) if arr._indices.shape[0] else (
+        jnp.zeros(idx.shape, dtype=bool))
+    vals = arr._values[pos] * present.reshape(
+        (-1,) + (1,) * (arr._values.ndim - 1)).astype(arr._values.dtype)
+    return RowSparseNDArray(vals, idx, arr.shape, ctx=arr._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (reference: dot.cc sparse kernels). csr.dense routes
+    through the registered `_sparse_dot_csr_dense` op (per-nnz gather +
+    segment-sum) so autograd records it -- gradients flow to the dense rhs,
+    which is what sparse linear models (BASELINE config #4 FM) train."""
+    from .ndarray import invoke
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise NotImplementedError("transpose_b with csr lhs")
+        m, k = lhs.shape
+        rows = jnp.searchsorted(
+            lhs._indptr, jnp.arange(lhs._sp_data.shape[0]), side="right") - 1
+        return invoke("_sparse_dot_csr_dense",
+                      from_jax(lhs._sp_data, ctx=lhs._ctx),
+                      from_jax(lhs._sp_indices, ctx=lhs._ctx),
+                      from_jax(rows, ctx=lhs._ctx), rhs,
+                      m=m, k=k, transpose_a=transpose_a)
+    if isinstance(lhs, RowSparseNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_a:
+            # rsp^T(m,k) . dense(m,n) -> only stored rows contribute
+            vals = jnp.matmul(lhs._values.T, rhs.data_jax[lhs._indices])
+            return NDArray(vals, ctx=lhs._ctx)
+        return NDArray(jnp.matmul(lhs._read(), rhs.data_jax), ctx=lhs._ctx)
+    return invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                  transpose_b=transpose_b)
+
+
+def elemwise_add(a, b):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        if a.shape != b.shape:
+            raise ValueError("shape mismatch")
+        idx = jnp.concatenate([a._indices, b._indices])
+        vals = jnp.concatenate([a._values, b._values])
+        uniq, inv = _np.unique(_np.asarray(idx), return_inverse=True)
+        summed = jax.ops.segment_sum(vals, jnp.asarray(inv),
+                                     num_segments=len(uniq))
+        return RowSparseNDArray(summed, jnp.asarray(uniq.astype(_np.int32)),
+                                a.shape, ctx=a._ctx)
+    return NDArray(a._read() + b._read(), ctx=a._ctx)
+
+
+add = elemwise_add

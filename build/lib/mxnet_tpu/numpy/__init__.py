@@ -1,0 +1,605 @@
+"""`mx.np` — NumPy-compatible array namespace.
+
+reference: python/mxnet/numpy/ (mx.np) + numpy_extension (mx.npx): a
+numpy-semantics array API (zero-dim arrays, numpy broadcasting/naming)
+running on the framework engine. The reference's `multiarray.py` is ~20K
+LoC of per-function ctypes veneers over `_npi_*` C ops; here every function
+is registered once as an op (`_np_<name>`) wrapping the jax.numpy
+implementation and dispatched through the standard imperative `invoke`, so
+autograd recording, the profiler, AMP casts, and the NaiveEngine sync mode
+all apply exactly as for `mx.nd` ops — and `hybridize()` can trace through
+them.
+
+Surface organization (mirrors the reference's groups in
+python/mxnet/numpy/multiarray.py and numpy/function_base.py):
+  - dispatched ops: one `_np_*` registry entry per jnp callable
+  - creation: host-builds the value, wraps on the current Context
+  - mutating (fill_diagonal/place/put/copyto/...): functional jnp result
+    buffer-swapped into the target NDArray (engine-safe mutation)
+  - host-side metadata (result_type/can_cast/finfo/...): no dispatch
+  - dtypes/constants: numpy's own scalars (jnp consumes them 1:1)
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+
+import numpy as _onp
+
+import jax.numpy as jnp
+
+from ..ops import registry as _reg
+from ..ndarray.ndarray import NDArray, invoke, array as _nd_array, from_jax
+from ..context import current_context
+from .multiarray import ndarray, as_np_ndarray
+
+# ---------------------------------------------------------------------------
+# (name, differentiable) — jnp callables surfaced 1:1 through the registry.
+# Integer/boolean/index producers are non-differentiable (the reference marks
+# the matching `_npi_*` ops FGradient-less the same way).
+# ---------------------------------------------------------------------------
+_FUNCS = [
+    # -- elementwise arithmetic ------------------------------------------
+    ("add", True), ("subtract", True), ("multiply", True), ("divide", True),
+    ("true_divide", True), ("mod", True), ("remainder", True), ("fmod", True),
+    ("power", True), ("pow", True), ("float_power", True),
+    ("maximum", True), ("minimum", True), ("fmax", True), ("fmin", True),
+    ("hypot", True), ("negative", True), ("positive", True),
+    ("reciprocal", True), ("abs", True), ("absolute", True), ("fabs", True),
+    ("sign", True), ("heaviside", True), ("copysign", True), ("ldexp", True),
+    ("nextafter", False), ("spacing", False), ("signbit", False),
+    # -- exp/log/trig ----------------------------------------------------
+    ("exp", True), ("exp2", True), ("expm1", True), ("log", True),
+    ("log2", True), ("log10", True), ("log1p", True),
+    ("logaddexp", True), ("logaddexp2", True),
+    ("sqrt", True), ("cbrt", True), ("square", True),
+    ("sin", True), ("cos", True), ("tan", True),
+    ("arcsin", True), ("arccos", True), ("arctan", True), ("arctan2", True),
+    ("asin", True), ("acos", True), ("atan", True), ("atan2", True),
+    ("sinh", True), ("cosh", True), ("tanh", True),
+    ("arcsinh", True), ("arccosh", True), ("arctanh", True),
+    ("asinh", True), ("acosh", True), ("atanh", True),
+    ("sinc", True), ("i0", True), ("angle", True), ("unwrap", True),
+    ("degrees", True), ("radians", True), ("deg2rad", True),
+    ("rad2deg", True),
+    # -- rounding --------------------------------------------------------
+    ("rint", True), ("floor", True), ("ceil", True), ("trunc", True),
+    ("round", True), ("around", True), ("clip", True), ("nan_to_num", True),
+    # -- linear algebra / products ---------------------------------------
+    ("dot", True), ("matmul", True), ("inner", True), ("outer", True),
+    ("tensordot", True), ("einsum", True), ("vdot", True), ("vecdot", True),
+    ("kron", True), ("cross", True), ("trace", True),
+    ("matrix_transpose", True),
+    # -- reductions ------------------------------------------------------
+    ("sum", True), ("prod", True), ("mean", True), ("std", True),
+    ("var", True), ("cumsum", True), ("cumprod", True),
+    ("max", True), ("min", True), ("amax", True), ("amin", True),
+    ("ptp", True), ("median", True), ("quantile", True),
+    ("percentile", True), ("average", True),
+    ("nansum", True), ("nanprod", True), ("nanmean", True),
+    ("nanstd", True), ("nanvar", True), ("nanmedian", True),
+    ("nanquantile", True), ("nanpercentile", True),
+    ("nanmax", True), ("nanmin", True),
+    ("nancumsum", True), ("nancumprod", True),
+    ("nanargmax", False), ("nanargmin", False),
+    ("trapezoid", True), ("corrcoef", True), ("cov", True),
+    # -- shape manipulation ----------------------------------------------
+    ("reshape", True), ("ravel", True), ("transpose", True),
+    ("permute_dims", True), ("swapaxes", True), ("moveaxis", True),
+    ("rollaxis", True), ("expand_dims", True), ("squeeze", True),
+    ("broadcast_to", True), ("concatenate", True), ("concat", True),
+    ("stack", True), ("vstack", True), ("hstack", True), ("dstack", True),
+    ("column_stack", True), ("split", True), ("array_split", True),
+    ("vsplit", True), ("hsplit", True), ("dsplit", True),
+    ("tile", True), ("repeat", True), ("roll", True), ("flip", True),
+    ("fliplr", True), ("flipud", True), ("rot90", True), ("pad", True),
+    ("append", True), ("delete", True), ("insert", True), ("resize", True),
+    ("trim_zeros", True), ("broadcast_arrays", True), ("atleast_1d", True),
+    ("atleast_2d", True), ("atleast_3d", True), ("astype", True),
+    ("copy", True),
+    # -- indexing / selection --------------------------------------------
+    ("take", True), ("take_along_axis", True), ("where", True),
+    ("select", True), ("compress", True), ("choose", True),
+    ("extract", False), ("diag", True), ("diagflat", True),
+    ("diagonal", True), ("tril", True), ("triu", True),
+    ("meshgrid", True), ("ix_", False),
+    # -- sorting / searching ---------------------------------------------
+    ("sort", True), ("partition", True), ("argpartition", False),
+    ("argmax", False), ("argmin", False), ("argsort", False),
+    ("argwhere", False), ("searchsorted", False), ("flatnonzero", False),
+    ("count_nonzero", False), ("nonzero", False), ("lexsort", False),
+    ("sort_complex", False), ("digitize", False),
+    # -- logic / comparison ----------------------------------------------
+    ("floor_divide", False), ("equal", False), ("not_equal", False),
+    ("greater", False), ("greater_equal", False), ("less", False),
+    ("less_equal", False), ("logical_and", False), ("logical_or", False),
+    ("logical_not", False), ("logical_xor", False),
+    ("isnan", False), ("isinf", False), ("isfinite", False),
+    ("isposinf", False), ("isneginf", False), ("isreal", False),
+    ("iscomplex", False), ("all", False), ("any", False),
+    ("allclose", False), ("isclose", False), ("array_equal", False),
+    ("array_equiv", False), ("isin", False),
+    # -- sets ------------------------------------------------------------
+    ("unique", False), ("union1d", False), ("intersect1d", False),
+    ("setdiff1d", False), ("setxor1d", False),
+    ("unique_all", False), ("unique_counts", False),
+    ("unique_inverse", False), ("unique_values", False),
+    # -- integer / bit ops -----------------------------------------------
+    ("lcm", False), ("gcd", False), ("bincount", False),
+    ("bitwise_and", False), ("bitwise_or", False), ("bitwise_xor", False),
+    ("bitwise_not", False), ("bitwise_invert", False),
+    ("bitwise_count", False), ("invert", False),
+    ("left_shift", False), ("right_shift", False),
+    ("bitwise_left_shift", False), ("bitwise_right_shift", False),
+    ("packbits", False), ("unpackbits", False),
+    # -- misc numerics ---------------------------------------------------
+    ("interp", True), ("diff", True), ("ediff1d", True), ("gradient", True),
+    ("convolve", True), ("correlate", True), ("real", True), ("imag", True),
+    ("conj", True), ("conjugate", True), ("histogram", False),
+    ("histogram2d", False), ("histogramdd", False),
+    ("histogram_bin_edges", False),
+    # -- multi-output numerics -------------------------------------------
+    ("frexp", False), ("modf", True), ("divmod", False),
+    ("unravel_index", False), ("ravel_multi_index", False),
+    # -- polynomials -----------------------------------------------------
+    ("polyval", True), ("polyadd", True), ("polysub", True),
+    ("polymul", True), ("polyder", True), ("polyint", True),
+    ("polydiv", True), ("polyfit", True), ("poly", False), ("roots", False),
+    ("vander", True),
+    # -- functional ------------------------------------------------------
+    ("apply_along_axis", False), ("apply_over_axes", False),
+    ("piecewise", False),
+]
+
+# functions whose first argument is a sequence of arrays: the sequence is
+# unpacked into positional args so the autograd tape records every input
+_SEQ_FUNCS = {"concatenate", "concat", "stack", "vstack", "hstack",
+              "dstack", "column_stack", "lexsort"}
+# `fix` rounds toward zero == trunc; registered with an explicit impl
+# because jnp.fix is deprecated (removal in jax 0.10) and jax warns on
+# attribute access.
+if "_np_fix" not in _reg.list_ops():
+    _reg.register("_np_fix", differentiable=True)(
+        lambda x: jnp.trunc(x))
+
+_here = globals()
+
+
+def _make(op_name, public_name, seq):
+    def _fn(*args, **kwargs):
+        if seq and len(args) >= 1 and isinstance(args[0], (list, tuple)):
+            if len(args) > 1:
+                # numpy allows axis positionally: concatenate((a, b), 1)
+                kwargs.setdefault("axis", args[1])
+            out = invoke(op_name, *args[0], **kwargs)
+        else:
+            out = invoke(op_name, *args, **kwargs)
+        if out is kwargs.get("out"):
+            return out  # caller-owned destination: don't retag it
+        return as_np_ndarray(out)
+    _fn.__name__ = public_name
+    _fn.__qualname__ = public_name
+    _fn.__doc__ = "numpy-compatible %s (jax.numpy.%s under invoke)" % (
+        public_name, public_name)
+    return _fn
+
+
+for _name, _diff in _FUNCS:
+    _jfn = getattr(jnp, _name, None)
+    if _jfn is None:
+        continue
+    _op_name = "_np_" + _name
+    if _op_name not in _reg.list_ops():
+        if _name in _SEQ_FUNCS:
+            def _seq_impl(*arrays, _jfn=_jfn, **kwargs):
+                return _jfn(list(arrays), **kwargs)
+            _reg.register(_op_name, differentiable=_diff)(_seq_impl)
+        else:
+            # normalize namedtuple results (unique_all, frexp via xla, ...)
+            # to plain tuples: the tape hands plain-tuple cotangents to
+            # jax.vjp, which rejects a pytree-structure mismatch
+            def _impl(*args, _jfn=_jfn, **kwargs):
+                out = _jfn(*args, **kwargs)
+                if isinstance(out, tuple):
+                    return tuple(out)
+                if isinstance(out, list):
+                    return tuple(out)
+                return out
+            _reg.register(_op_name, differentiable=_diff)(_impl)
+
+_here["fix"] = _make("_np_fix", "fix", False)
+for _name, _diff in _FUNCS:
+    if getattr(jnp, _name, None) is None:
+        continue
+    _here[_name] = _make("_np_" + _name, _name, _name in _SEQ_FUNCS)
+
+
+# ---------------------------------------------------------------------------
+# creation & constants
+# ---------------------------------------------------------------------------
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+
+# dtype aliases (reference: mx.np exposes numpy's scalar types verbatim)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+half = _onp.half
+single = _onp.single
+double = _onp.double
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+intc = _onp.intc
+intp = _onp.intp
+int_ = _onp.int_
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+uint = _onp.uint
+byte = _onp.byte
+ubyte = _onp.ubyte
+short = _onp.short
+ushort = _onp.ushort
+longlong = _onp.longlong
+ulonglong = _onp.ulonglong
+complex64 = _onp.complex64
+complex128 = _onp.complex128
+csingle = _onp.csingle
+cdouble = _onp.cdouble
+bool_ = _onp.bool_
+float_ = _onp.float64
+generic = _onp.generic
+number = _onp.number
+integer = _onp.integer
+signedinteger = _onp.signedinteger
+unsignedinteger = _onp.unsignedinteger
+inexact = _onp.inexact
+floating = _onp.floating
+complexfloating = _onp.complexfloating
+dtype = _onp.dtype
+bfloat16 = jnp.bfloat16          # TPU-native extra (not in numpy proper)
+
+
+def _np_view(obj):
+    """np-typed zero-copy view of a legacy NDArray. The caller's object is
+    left untouched (retagging it in place would flip ITS semantics:
+    unhashable, bool comparisons, 1-D flatten); the view reads and writes
+    through the same payload."""
+    if type(obj) is ndarray:
+        return obj
+    view = NDArray.__getitem__(obj, Ellipsis)
+    view.__class__ = ndarray
+    return view
+
+
+def array(obj, dtype=None, ctx=None, copy=True, ndmin=0):
+    if isinstance(obj, NDArray):
+        if dtype is None and not copy and ndmin == 0:
+            return _np_view(obj)
+        obj = obj.asnumpy()
+    host = _onp.array(obj, dtype=dtype, ndmin=ndmin)
+    if dtype is None:
+        # reference np.array semantics: dtype-carrying sources keep their
+        # dtype; python scalars/lists default to float32 (mx.np deviation
+        # from numpy, documented in the reference's multiarray.array)
+        dtype = host.dtype if hasattr(obj, "dtype") else _onp.float32
+    return as_np_ndarray(_nd_array(host, dtype=dtype, ctx=ctx))
+
+
+def _creation(jnp_name, jfn=None):
+    jfn = jfn or getattr(jnp, jnp_name)
+
+    def fn(*args, ctx=None, **kwargs):
+        out = jfn(*args, **kwargs)
+        c = ctx or current_context()
+        if isinstance(out, tuple):   # index generators (tril_indices, ...)
+            return tuple(as_np_ndarray(from_jax(o, ctx=c)) for o in out)
+        return as_np_ndarray(from_jax(out, ctx=c))
+    fn.__name__ = jnp_name
+    fn.__doc__ = "numpy-compatible %s on the current Context" % jnp_name
+    return fn
+
+
+zeros = _creation("zeros")
+ones = _creation("ones")
+empty = _creation("zeros")          # XLA has no uninitialized alloc
+full = _creation("full")
+arange = _creation("arange")
+linspace = _creation("linspace")
+logspace = _creation("logspace")
+geomspace = _creation("geomspace")
+eye = _creation("eye")
+identity = _creation("identity")
+tri = _creation("tri")
+indices = _creation("indices")
+# window functions (reference: mx.np window ops, src/operator/numpy/np_window_op.cc)
+bartlett = _creation("bartlett")
+blackman = _creation("blackman")
+hamming = _creation("hamming")
+hanning = _creation("hanning")
+kaiser = _creation("kaiser")
+# index generators (host-computed, device-resident results)
+tril_indices = _creation("tril_indices")
+triu_indices = _creation("triu_indices")
+diag_indices = _creation("diag_indices")
+mask_indices = _creation("mask_indices")
+
+
+def zeros_like(a, dtype=None, ctx=None):
+    return zeros(a.shape, dtype=dtype or a.dtype,
+                 ctx=ctx or getattr(a, "context", None))
+
+
+def ones_like(a, dtype=None, ctx=None):
+    return ones(a.shape, dtype=dtype or a.dtype,
+                ctx=ctx or getattr(a, "context", None))
+
+
+def full_like(a, fill_value, dtype=None, ctx=None):
+    return full(a.shape, fill_value, dtype=dtype or a.dtype,
+                ctx=ctx or getattr(a, "context", None))
+
+
+empty_like = zeros_like
+
+
+def asarray(obj, dtype=None):
+    if isinstance(obj, NDArray) and dtype is None:
+        return _np_view(obj)
+    return array(obj, dtype=dtype)
+
+
+def ascontiguousarray(a, dtype=None):
+    return asarray(a, dtype=dtype)   # XLA buffers are always contiguous
+
+
+asfortranarray = ascontiguousarray
+
+
+def asnumpy(a):
+    return a.asnumpy() if isinstance(a, NDArray) else _onp.asarray(a)
+
+
+def frombuffer(buffer, dtype=float, count=-1, offset=0):
+    return array(_onp.frombuffer(buffer, dtype=dtype, count=count,
+                                 offset=offset))
+
+
+def fromiter(iterable, dtype, count=-1):
+    return array(_onp.fromiter(iterable, dtype, count=count))
+
+
+def fromfunction(function, shape, *, dtype=float, **kwargs):
+    return array(_onp.fromfunction(function, shape, dtype=dtype, **kwargs))
+
+
+def fromstring(string, dtype=float, count=-1, sep=" "):
+    return array(_onp.fromstring(string, dtype=dtype, count=count, sep=sep))
+
+
+def fromfile(file, dtype=float, count=-1, sep="", offset=0):
+    return array(_onp.fromfile(file, dtype=dtype, count=count, sep=sep,
+                               offset=offset))
+
+
+def block(arrays):
+    def _realize(a):
+        if isinstance(a, list):
+            return [_realize(x) for x in a]
+        return a.data_jax if isinstance(a, NDArray) else a
+    return as_np_ndarray(from_jax(jnp.block(_realize(arrays)),
+                                  ctx=current_context()))
+
+
+def tril_indices_from(arr, k=0):
+    return tril_indices(arr.shape[-2], k=k, m=arr.shape[-1])
+
+
+def triu_indices_from(arr, k=0):
+    return triu_indices(arr.shape[-2], k=k, m=arr.shape[-1])
+
+
+def diag_indices_from(arr):
+    return diag_indices(arr.shape[0], ndim=arr.ndim)
+
+
+# ---------------------------------------------------------------------------
+# mutating functions — functional jnp result buffer-swapped into the target
+# (reference mutates the C++ NDArray payload; here mutation is the engine's
+# buffer-swap, so views and the async queue stay consistent)
+# ---------------------------------------------------------------------------
+def _as_raw(v):
+    return v.data_jax if isinstance(v, NDArray) else v
+
+
+def fill_diagonal(a, val, wrap=False):
+    a._check_inplace_ok()
+    a._write(jnp.fill_diagonal(a.data_jax, _as_raw(val), wrap=wrap,
+                               inplace=False))
+
+
+def place(arr, mask, vals):
+    arr._check_inplace_ok()
+    arr._write(jnp.place(arr.data_jax, _as_raw(mask), _as_raw(vals),
+                         inplace=False))
+
+
+def put(a, ind, v, mode="clip"):
+    a._check_inplace_ok()
+    a._write(jnp.put(a.data_jax, _as_raw(ind), _as_raw(v), mode=mode,
+                     inplace=False))
+
+
+def put_along_axis(arr, indices, values, axis):
+    arr._check_inplace_ok()
+    arr._write(jnp.put_along_axis(arr.data_jax, _as_raw(indices),
+                                  _as_raw(values), axis, inplace=False))
+
+
+def copyto(dst, src, where=True):
+    dst._check_inplace_ok()
+    raw = jnp.broadcast_to(jnp.asarray(_as_raw(src), dtype=dst.dtype),
+                           dst.shape)
+    if where is not True:
+        raw = jnp.where(jnp.broadcast_to(_as_raw(where), dst.shape),
+                        raw, dst.data_jax)
+    dst._write(raw)
+
+
+# ---------------------------------------------------------------------------
+# host-side metadata / inspection — no dispatch (reference: numpy re-exports)
+# ---------------------------------------------------------------------------
+finfo = _onp.finfo
+iinfo = _onp.iinfo
+can_cast = _onp.can_cast
+promote_types = _onp.promote_types
+issubdtype = _onp.issubdtype
+isscalar = _onp.isscalar
+iterable = _onp.iterable
+broadcast_shapes = _onp.broadcast_shapes
+isdtype = jnp.isdtype
+get_printoptions = _onp.get_printoptions
+set_printoptions = _onp.set_printoptions
+printoptions = _onp.printoptions
+einsum_path = _onp.einsum_path
+
+
+def result_type(*args):
+    return _onp.result_type(*[
+        a.dtype if isinstance(a, NDArray) else a for a in args])
+
+
+def isrealobj(x):
+    return not iscomplexobj(x)
+
+
+def iscomplexobj(x):
+    d = x.dtype if isinstance(x, NDArray) else _onp.asarray(x).dtype
+    return _onp.issubdtype(d, _onp.complexfloating)
+
+
+def shape(a):
+    return a.shape if hasattr(a, "shape") else _onp.shape(a)
+
+
+def ndim(a):
+    return len(shape(a))
+
+
+def size(a):
+    s = 1
+    for d in shape(a):
+        s *= d
+    return s
+
+
+def array_repr(arr, *args, **kwargs):
+    return _onp.array_repr(asnumpy(arr), *args, **kwargs)
+
+
+def array_str(a, *args, **kwargs):
+    return _onp.array_str(asnumpy(a), *args, **kwargs)
+
+
+def shares_memory(a, b, max_work=None):
+    """True when two arrays alias the same engine payload (view chain)."""
+    def _root(x):
+        while getattr(x, "_base", None) is not None:
+            x = x._base
+        return x
+    return isinstance(a, NDArray) and isinstance(b, NDArray) and \
+        _root(a) is _root(b)
+
+
+may_share_memory = shares_memory
+
+
+def save(file, arr):
+    _onp.save(file, asnumpy(arr))
+
+
+def savez(file, *args, **kwargs):
+    _onp.savez(file, *[asnumpy(a) for a in args],
+               **{k: asnumpy(v) for k, v in kwargs.items()})
+
+
+def load(file, **kwargs):
+    out = _onp.load(file, **kwargs)
+    if isinstance(out, _onp.ndarray):
+        return array(out)
+    return out   # NpzFile: lazily-loaded dict of host arrays
+
+
+def loadtxt(fname, **kwargs):
+    return array(_onp.loadtxt(fname, **kwargs))
+
+
+def savetxt(fname, X, **kwargs):
+    _onp.savetxt(fname, asnumpy(X), **kwargs)
+
+
+def vectorize(pyfunc, **kwargs):
+    vf = _onp.vectorize(pyfunc, **kwargs)
+
+    def wrapped(*args, **kw):
+        return array(vf(*[asnumpy(a) if isinstance(a, NDArray) else a
+                          for a in args], **kw))
+    return wrapped
+
+
+def r_like(*rows):   # helper for tests; numpy's r_ is an indexer object
+    return concatenate([atleast_1d(array(r)) for r in rows])
+
+
+class _CClass:
+    """np.c_ / np.r_ concatenation indexers (reference re-exports numpy's).
+    Slice keys expand like numpy's: r_[0:5] -> arange(0, 5); a complex
+    step is a linspace point count (r_[0:1:5j])."""
+    def __init__(self, axis):
+        self.axis = axis
+
+    @staticmethod
+    def _expand(a):
+        if isinstance(a, slice):
+            start = a.start if a.start is not None else 0
+            stop = a.stop
+            step = a.step if a.step is not None else 1
+            if isinstance(step, complex):
+                return linspace(start, stop, int(abs(step)))
+            return arange(start, stop, step)
+        return a if isinstance(a, NDArray) else array(a)
+
+    def __getitem__(self, key):
+        if not isinstance(key, tuple):
+            key = (key,)
+        parts = [self._expand(a) for a in key]
+        if self.axis == -1:   # c_: promote 1-D to columns
+            parts = [p.reshape(-1, 1) if p.ndim == 1 else p for p in parts]
+            return concatenate(parts, axis=1)
+        return concatenate([atleast_1d(p) for p in parts], axis=0)
+
+
+c_ = _CClass(-1)
+r_ = _CClass(0)
+s_ = _onp.s_
+index_exp = _onp.index_exp
+
+
+from . import random  # noqa: E402
+from . import linalg  # noqa: E402
+
+__all__ = ["ndarray", "array", "asarray", "asnumpy", "zeros", "ones",
+           "empty", "full", "arange", "linspace", "logspace", "geomspace",
+           "eye", "identity", "tri", "indices", "zeros_like", "ones_like",
+           "full_like", "empty_like", "frombuffer", "fromiter",
+           "fromfunction", "block", "fill_diagonal", "place", "put",
+           "put_along_axis", "copyto", "result_type", "finfo", "iinfo",
+           "shares_memory", "may_share_memory", "save", "savez", "load",
+           "random", "linalg", "fix", "pi", "e", "inf", "nan", "newaxis"] + \
+    [n for n, _ in _FUNCS if n in _here]

@@ -1,0 +1,349 @@
+"""The dedicated `mx.np.ndarray` type.
+
+reference: python/mxnet/numpy/multiarray.py — a distinct array class with
+numpy semantics, separate from the legacy `mx.nd.NDArray`. Here it is a
+zero-storage subclass (same buffer-swap payload, same autograd tape, same
+async engine semantics) whose operations return `mx.np.ndarray` again and
+whose surface follows numpy: `array(...)` repr, `.item()/.tolist()`,
+boolean-mask and fancy indexing, zero-dim arrays, numpy-style `astype`,
+the full numpy method surface (`argsort/cumsum/std/var/dot/trace/...`),
+the full operator-protocol set (`@`, `//`, `divmod`, bitwise, shifts,
+in-place variants), and numpy deviations from the legacy namespace
+(`flatten()` -> 1-D, `.sort()` in place, bool comparison results).
+Retagging (not wrapping) keeps interop free in both directions: an
+mx.np.ndarray IS an NDArray everywhere the framework takes one.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..ndarray.ndarray import NDArray, invoke
+
+__all__ = ["ndarray", "as_np_ndarray"]
+
+
+def _raw_key(key):
+    """Realize NDArray (and nested tuple/list) index elements to jax arrays
+    so jnp's advanced-indexing engine sees plain arrays. A bare python list
+    key is a fancy index in numpy — promote it to an array (jax refuses
+    non-tuple sequences outright)."""
+    if isinstance(key, NDArray):
+        return key.data_jax
+    if isinstance(key, tuple):
+        return tuple(_raw_key(k) for k in key)
+    if isinstance(key, list):
+        return _onp.asarray(key)
+    return key
+
+
+class ndarray(NDArray):
+    __slots__ = ()
+
+    # -- numpy-flavored surface ---------------------------------------
+    def __repr__(self):
+        try:
+            return repr(self.asnumpy())  # numpy's own 'array(...)' style
+        except Exception:
+            return "array(<unrealized %s>)" % ("x".join(
+                str(d) for d in self.shape))
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def tobytes(self, order="C"):
+        return self.asnumpy().tobytes(order=order)
+
+    def astype(self, dtype, copy=True):
+        out = NDArray.astype(self, dtype)
+        return as_np_ndarray(out)
+
+    @property
+    def T(self):
+        return as_np_ndarray(NDArray.T.fget(self))
+
+    @property
+    def itemsize(self):
+        return _onp.dtype(self.dtype).itemsize
+
+    @property
+    def nbytes(self):
+        return self.size * self.itemsize
+
+    @property
+    def real(self):
+        return as_np_ndarray(invoke("_np_real", self))
+
+    @property
+    def imag(self):
+        return as_np_ndarray(invoke("_np_imag", self))
+
+    @property
+    def flat(self):
+        return iter(self.reshape(-1))
+
+    def __getitem__(self, key):
+        key = _raw_key(key)
+        if NDArray._is_basic_index(key):
+            # zero-copy view (reference: NDArray::Slice/At), retagged np
+            out = NDArray.__getitem__(self, key)
+            out.__class__ = ndarray
+            return out
+        return as_np_ndarray(NDArray.__getitem__(self, key))
+
+    def __setitem__(self, key, value):
+        NDArray.__setitem__(self, _raw_key(key), value)
+
+    def __iter__(self):
+        # not a generator: iter() on a 0-d array must raise immediately
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d array")
+        return (self[i] for i in range(self.shape[0]))
+
+    def __contains__(self, value):
+        return bool((self == value).asnumpy().any())
+
+    def as_nd_ndarray(self):
+        """Legacy-namespace view of the same payload (reference:
+        ndarray.as_nd_ndarray)."""
+        out = NDArray(self._data, ctx=self._ctx, base=self._base,
+                      idx=self._idx)
+        return out
+
+    def copy(self):
+        return as_np_ndarray(NDArray.copy(self))
+
+    # -- numpy deviations from the legacy namespace -------------------
+    def flatten(self, order="C"):
+        """numpy semantics: full collapse to 1-D (the legacy `mx.nd`
+        flatten keeps the batch axis, reference: ndarray.flatten vs
+        np.ndarray.flatten)."""
+        return as_np_ndarray(invoke("_np_reshape", self, (-1,)))
+
+    def ravel(self, order="C"):
+        return self.flatten()
+
+    def reshape(self, *shape, order="C"):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        # pure numpy reshape semantics (no legacy 0/-2/-3 codes)
+        return as_np_ndarray(invoke("_np_reshape", self, shape))
+
+    def sort(self, axis=-1, kind=None, order=None):
+        """In place, matching numpy (the function form returns a copy)."""
+        self._check_inplace_ok()
+        res = invoke("_np_sort", self, axis=axis)
+        self._write(res._read())
+
+    def fill(self, value):
+        self._check_inplace_ok()
+        import jax.numpy as jnp
+        self._write(jnp.full(self.shape, value, dtype=self.dtype))
+
+    # -- numpy method surface (each rides the registered _np_* op) ----
+    def _np1(self, opname, *args, **kwargs):
+        return as_np_ndarray(invoke(opname, self, *args, **kwargs))
+
+    def all(self, axis=None, keepdims=False):
+        return self._np1("_np_all", axis=axis, keepdims=keepdims)
+
+    def any(self, axis=None, keepdims=False):
+        return self._np1("_np_any", axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, kind=None, order=None):
+        return self._np1("_np_argsort", axis=axis)
+
+    def cumsum(self, axis=None, dtype=None):
+        return self._np1("_np_cumsum", axis=axis, dtype=dtype)
+
+    def cumprod(self, axis=None, dtype=None):
+        return self._np1("_np_cumprod", axis=axis, dtype=dtype)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return self._np1("_np_std", axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return self._np1("_np_var", axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def dot(self, b):
+        return self._np1("_np_dot", b)
+
+    def diagonal(self, offset=0, axis1=0, axis2=1):
+        return self._np1("_np_diagonal", offset=offset, axis1=axis1,
+                         axis2=axis2)
+
+    def trace(self, offset=0, axis1=0, axis2=1):
+        return self._np1("_np_trace", offset=offset, axis1=axis1,
+                         axis2=axis2)
+
+    def nonzero(self):
+        return tuple(as_np_ndarray(o) for o in invoke("_np_nonzero", self))
+
+    def searchsorted(self, v, side="left", sorter=None):
+        return self._np1("_np_searchsorted", v, side=side)
+
+    def ptp(self, axis=None, keepdims=False):
+        return self._np1("_np_ptp", axis=axis, keepdims=keepdims)
+
+    def conj(self):
+        return self._np1("_np_conj")
+
+    conjugate = conj
+
+    def compress(self, condition, axis=None):
+        return as_np_ndarray(invoke("_np_compress", condition, self,
+                                    axis=axis))
+
+    def repeat(self, repeats, axis=None):
+        return self._np1("_np_repeat", repeats=repeats, axis=axis)
+
+    def take(self, indices, axis=None, mode="clip"):
+        return self._np1("_np_take", indices, axis=axis, mode=mode)
+
+    def clip(self, a_min=None, a_max=None):
+        return self._np1("_np_clip", a_min, a_max)
+
+    def round(self, decimals=0):
+        return self._np1("_np_round", decimals=decimals)
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        kw = {} if dtype is None else {"dtype": dtype}
+        return self._np1("_np_mean", axis=axis, keepdims=keepdims, **kw)
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        kw = {} if dtype is None else {"dtype": dtype}
+        return self._np1("_np_sum", axis=axis, keepdims=keepdims, **kw)
+
+    def prod(self, axis=None, dtype=None, keepdims=False):
+        kw = {} if dtype is None else {"dtype": dtype}
+        return self._np1("_np_prod", axis=axis, keepdims=keepdims, **kw)
+
+    def max(self, axis=None, keepdims=False):
+        return self._np1("_np_max", axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._np1("_np_min", axis=axis, keepdims=keepdims)
+
+    # -- operator protocols beyond the legacy base --------------------
+    def __matmul__(self, other):
+        return self._np1("_np_matmul", other)
+
+    def __rmatmul__(self, other):
+        return as_np_ndarray(invoke("_np_matmul", other, self))
+
+    def __floordiv__(self, other):
+        return self._np1("_np_floor_divide", other)
+
+    def __rfloordiv__(self, other):
+        return as_np_ndarray(invoke("_np_floor_divide", other, self))
+
+    def __divmod__(self, other):
+        q, r = invoke("_np_divmod", self, other)
+        return as_np_ndarray(q), as_np_ndarray(r)
+
+    def __rdivmod__(self, other):
+        q, r = invoke("_np_divmod", other, self)
+        return as_np_ndarray(q), as_np_ndarray(r)
+
+    def __and__(self, other):
+        return self._np1("_np_bitwise_and", other)
+
+    __rand__ = __and__
+
+    def __or__(self, other):
+        return self._np1("_np_bitwise_or", other)
+
+    __ror__ = __or__
+
+    def __xor__(self, other):
+        return self._np1("_np_bitwise_xor", other)
+
+    __rxor__ = __xor__
+
+    def __invert__(self):
+        return self._np1("_np_invert")
+
+    def __lshift__(self, other):
+        return self._np1("_np_left_shift", other)
+
+    def __rlshift__(self, other):
+        return as_np_ndarray(invoke("_np_left_shift", other, self))
+
+    def __rshift__(self, other):
+        return self._np1("_np_right_shift", other)
+
+    def __rrshift__(self, other):
+        return as_np_ndarray(invoke("_np_right_shift", other, self))
+
+    def __ifloordiv__(self, other):
+        return NDArray._inplace(self, "_np_floor_divide", other)
+
+    def __ipow__(self, other):
+        return NDArray._inplace(self, "_np_power", other)
+
+    def __imod__(self, other):
+        return NDArray._inplace(self, "_np_mod", other)
+
+
+def as_np_ndarray(x):
+    """Retag NDArray results (and containers of them) as mx.np.ndarray.
+    reference: NDArray.as_np_ndarray."""
+    if isinstance(x, NDArray):
+        if type(x) is NDArray:
+            x.__class__ = ndarray
+        return x
+    if isinstance(x, (list, tuple)):
+        return type(x)(as_np_ndarray(v) for v in x)
+    return x
+
+
+def _retag(name):
+    base_fn = getattr(NDArray, name)
+
+    def method(self, *args, **kwargs):
+        out = base_fn(self, *args, **kwargs)
+        # never retag a caller-owned array handed back through the op
+        # (copyto/out= return their destination): converting someone
+        # else's legacy NDArray in place would change ITS semantics
+        if out is self or any(out is a for a in args) \
+                or out is kwargs.get("out"):
+            return out
+        return as_np_ndarray(out)
+    method.__name__ = name
+    return method
+
+
+# every op-returning method keeps the np type through the operation
+for _name in ["__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+              "__rmul__", "__truediv__", "__rtruediv__", "__mod__",
+              "__rmod__", "__pow__", "__rpow__", "__neg__", "__abs__",
+              "transpose", "squeeze", "expand_dims", "swapaxes",
+              "broadcast_to", "tile", "pick",
+              "slice", "slice_axis",
+              "argmax", "argmin", "exp", "log", "sqrt", "square",
+              "abs", "sign", "flip", "as_in_context",
+              "copyto", "detach", "split"]:
+    if hasattr(NDArray, _name):
+        setattr(ndarray, _name, _retag(_name))
+
+
+def _bool_cmp(name):
+    base_fn = getattr(NDArray, name)
+
+    def method(self, other):
+        # numpy semantics: comparisons yield BOOL arrays (usable as masks);
+        # the legacy mx.nd namespace yields 0/1 float32 like the reference
+        out = base_fn(self, other)
+        if isinstance(out, NDArray):
+            return as_np_ndarray(out.astype(_onp.bool_))
+        return out
+    method.__name__ = name
+    return method
+
+
+for _name in ["__eq__", "__ne__", "__lt__", "__le__", "__gt__", "__ge__"]:
+    setattr(ndarray, _name, _bool_cmp(_name))
+
+ndarray.__hash__ = None   # numpy arrays are unhashable

@@ -1,0 +1,184 @@
+"""`mx.operator` — the CustomOp extension bridge.
+
+reference: python/mxnet/operator.py (CustomOp, CustomOpProp, register) and
+src/operator/custom/custom.cc. The reference runs python callbacks on a
+dedicated worker thread behind the engine; here the callback simply runs
+eagerly on the host (JAX dispatch is already async around it) and its
+backward is recorded on the autograd tape like any other op. Outputs of a
+Custom op are host-computed NDArrays — the escape hatch the reference
+provides for "not expressible in the op library", at the same cost profile
+(host sync per call).
+
+Usage (identical to the reference):
+
+    @mx.operator.register("softsign")
+    class SoftsignProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+        def list_arguments(self):
+            return ['data']
+        def list_outputs(self):
+            return ['output']
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes):
+            return Softsign()
+
+    class Softsign(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], x / (1 + abs(x)))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            ...
+
+    y = mx.nd.Custom(x, op_type='softsign')
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import autograd
+from .base import MXNetError
+from .context import current_context
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_entry"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for the user's kernel. reference: operator.py (CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write `src` into `dst` honoring the grad_req (reference:
+        CustomOp.assign — 'null' skip, 'write'/'inplace' overwrite,
+        'add' accumulate)."""
+        if req == "null":
+            return
+        from .ndarray.ndarray import NDArray
+        if not isinstance(src, NDArray):
+            src = NDArray(src) if hasattr(src, "dtype") else \
+                NDArray(_np.asarray(src))
+        if req in ("write", "inplace"):
+            dst._write(src._read().astype(dst.dtype))
+        elif req == "add":
+            dst._write((dst._read() + src._read()).astype(dst.dtype))
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Shape/type metadata + operator factory.
+    reference: operator.py (CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0]
+        return (in_type, [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under `op_type`.
+    reference: mx.operator.register."""
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_entry(op_type):
+    prop_cls = _CUSTOM_REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise MXNetError(
+            "Custom op %r is not registered (mx.operator.register)" % op_type)
+    return prop_cls
+
+
+def invoke_custom(*inputs, op_type=None, **kwargs):
+    """Execute a registered custom op imperatively — the body of
+    `mx.nd.Custom` (reference: custom.cc Forward/Backward dispatch)."""
+    from .ndarray.ndarray import NDArray, zeros
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = get_entry(op_type)(**{k: str(v) for k, v in kwargs.items()}) \
+        if _prop_takes_kwargs(get_entry(op_type), kwargs) else \
+        get_entry(op_type)()
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    if len(inputs) != n_args + n_aux:
+        raise MXNetError(
+            "Custom %r expects %d inputs (+%d aux), got %d"
+            % (op_type, n_args, n_aux, len(inputs)))
+    in_data = list(inputs[:n_args])
+    aux = list(inputs[n_args:])
+    ctx = in_data[0].context if in_data else current_context()
+
+    in_shapes = [list(a.shape) for a in in_data]
+    ishapes, oshapes, ashapes = prop.infer_shape(in_shapes)
+    itypes, otypes, atypes = prop.infer_type(
+        [a.dtype for a in in_data])
+    op = prop.create_operator(ctx, ishapes, itypes)
+
+    out_data = [zeros(tuple(s), ctx=ctx, dtype=t)
+                for s, t in zip(oshapes, otypes)]
+    with autograd.pause():
+        op.forward(autograd.is_training(), ["write"] * len(out_data),
+                   in_data, out_data, aux)
+
+    if autograd.is_recording():
+        n_out = len(out_data)
+
+        def vjp_fn(cot):
+            cots = (cot,) if n_out == 1 else cot
+            out_grad = [NDArray(c, ctx=ctx) for c in cots]
+            in_grad = [zeros(a.shape, ctx=ctx, dtype=a.dtype)
+                       for a in in_data]
+            with autograd.pause():
+                op.backward(["write"] * len(in_grad), out_grad, in_data,
+                            out_data, in_grad, aux)
+            return [g._read() for g in in_grad]
+
+        autograd.record_op("Custom:%s" % op_type, in_data, out_data, vjp_fn)
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+def _prop_takes_kwargs(prop_cls, kwargs):
+    if not kwargs:
+        return False
+    import inspect
+    sig = inspect.signature(prop_cls.__init__)
+    return len(sig.parameters) > 1

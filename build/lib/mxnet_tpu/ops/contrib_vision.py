@@ -1,0 +1,609 @@
+"""Contrib vision / detection operator pack.
+
+reference: src/operator/contrib/ — `bilinear_resize-inl.h`
+(BilinearResize2D), `adaptive_avg_pooling-inl.h` (AdaptiveAvgPooling2D),
+`roi_align.cc` (ROIAlign), `bounding_box.cc` (box_nms / box_iou /
+box_encode / box_decode), `arange_like-inl.h`. These back the GluonCV
+detection/segmentation model family on the reference.
+
+TPU-first notes: everything is static-shape and branch-free so XLA can tile
+it — NMS runs a fixed-trip `lax.fori_loop` over score-sorted candidates
+with a suppression mask (no dynamic early-exit, which would block
+compilation); AdaptiveAvgPooling uses a summed-area table (two cumsums +
+four gathers per output cell) instead of data-dependent window loops;
+ROIAlign vmaps bilinear sampling over rois.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+from .nn import _pair
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# arange_like (reference: contrib/arange_like-inl.h)
+# ---------------------------------------------------------------------------
+@register("_contrib_arange_like", differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    repeat = max(1, int(repeat))
+    if axis is None:
+        n = 1
+        for d in data.shape:
+            n *= d
+        idx = jnp.arange(n) // repeat
+        return (start + step * idx.astype(data.dtype)).reshape(data.shape)
+    n = data.shape[axis]
+    idx = jnp.arange(n) // repeat
+    return start + step * idx.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# BilinearResize2D (reference: contrib/bilinear_resize-inl.h) — NCHW,
+# align_corners sampling like the reference's kernel
+# ---------------------------------------------------------------------------
+def _linear_coords(out_size, in_size, dtype):
+    if out_size == 1 or in_size == 1:
+        src = jnp.zeros((out_size,), dtype)
+    else:
+        scale = (in_size - 1.0) / (out_size - 1.0)
+        src = jnp.arange(out_size, dtype=dtype) * dtype.type(scale) \
+            if hasattr(dtype, "type") else jnp.arange(out_size) * scale
+        src = jnp.asarray(src, dtype)
+    lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    frac = src - lo.astype(src.dtype)
+    return lo, hi, frac
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, mode="size"):
+    if mode != "size":
+        raise NotImplementedError(
+            "BilinearResize2D: mode=%r not supported (only 'size'; the "
+            "reference's odd/even/like modes are size policies the caller "
+            "can compute and pass as height/width)" % (mode,))
+    n, c, h, w = data.shape
+    # reference defaults height/width to 1 when neither the size nor the
+    # per-axis scale is given
+    oh = (int(height) if height else
+          int(round(h * float(scale_height))) if scale_height else 1)
+    ow = (int(width) if width else
+          int(round(w * float(scale_width))) if scale_width else 1)
+    f32 = data.astype(jnp.float32)
+    ylo, yhi, yf = _linear_coords(oh, h, jnp.float32)
+    xlo, xhi, xf = _linear_coords(ow, w, jnp.float32)
+    top = f32[:, :, ylo, :] * (1 - yf)[None, None, :, None] + \
+        f32[:, :, yhi, :] * yf[None, None, :, None]
+    out = top[:, :, :, xlo] * (1 - xf)[None, None, None, :] + \
+        top[:, :, :, xhi] * xf[None, None, None, :]
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# AdaptiveAvgPooling2D (reference: contrib/adaptive_avg_pooling-inl.h)
+# ---------------------------------------------------------------------------
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool2d(data, output_size=None):
+    n, c, h, w = data.shape
+    if output_size is None:
+        oh = ow = 1
+    elif isinstance(output_size, (tuple, list)):
+        oh, ow = (int(output_size[0]),
+                  int(output_size[1] if len(output_size) > 1
+                      else output_size[0]))
+    else:
+        oh = ow = int(output_size)
+    # summed-area table: S[i, j] = sum(data[:i, :j]); window sums are four
+    # gathers — no data-dependent loop bounds, MXU-friendly
+    f32 = data.astype(jnp.float32)
+    sat = jnp.pad(jnp.cumsum(jnp.cumsum(f32, axis=2), axis=3),
+                  ((0, 0), (0, 0), (1, 0), (1, 0)))
+    h0 = (_np.arange(oh) * h) // oh
+    h1 = -(-(_np.arange(1, oh + 1) * h) // oh)      # ceil
+    w0 = (_np.arange(ow) * w) // ow
+    w1 = -(-(_np.arange(1, ow + 1) * w) // ow)
+    area = ((h1 - h0)[:, None] * (w1 - w0)[None, :]).astype(_np.float32)
+    out = (sat[:, :, h1][:, :, :, w1] - sat[:, :, h0][:, :, :, w1]
+           - sat[:, :, h1][:, :, :, w0] + sat[:, :, h0][:, :, :, w0])
+    return (out / area[None, None]).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign (reference: contrib/roi_align.cc) — NCHW features, rois
+# (R, 5) = [batch_idx, x1, y1, x2, y2] in image coords
+# ---------------------------------------------------------------------------
+@register("_contrib_ROIAlign", arity=2)
+def _roi_align(data, rois, pooled_size=None, spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, aligned=False):
+    if position_sensitive:
+        raise NotImplementedError("ROIAlign: position_sensitive=True")
+    ph, pw = (int(pooled_size[0]), int(pooled_size[1])) \
+        if isinstance(pooled_size, (tuple, list)) else \
+        (int(pooled_size), int(pooled_size))
+    s = 2 if sample_ratio is None or sample_ratio <= 0 else int(sample_ratio)
+    n, c, h, w = data.shape
+    f32 = data.astype(jnp.float32)
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bh, bw = rh / ph, rw / pw
+        # sample grid: (ph*s, pw*s) bilinear taps, mean-pooled s×s per cell
+        ys = y1 + (jnp.arange(ph * s, dtype=jnp.float32) + 0.5) * (bh / s)
+        xs = x1 + (jnp.arange(pw * s, dtype=jnp.float32) + 0.5) * (bw / s)
+        # reference roi_align.cc zeroes samples outside [-1, size]; inside
+        # that band coordinates clamp to the border for interpolation
+        yok = ((ys >= -1.0) & (ys <= h)).astype(jnp.float32)
+        xok = ((xs >= -1.0) & (xs <= w)).astype(jnp.float32)
+        ysc = jnp.clip(ys, 0, h - 1)
+        xsc = jnp.clip(xs, 0, w - 1)
+        y0 = jnp.floor(ysc).astype(jnp.int32)
+        x0 = jnp.floor(xsc).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        yf = ysc - y0
+        xf = xsc - x0
+        img = f32[bidx]                                   # (c, h, w)
+        top = img[:, y0, :] * (1 - yf)[None, :, None] + \
+            img[:, y1i, :] * yf[None, :, None]
+        val = top[:, :, x0] * (1 - xf)[None, None, :] + \
+            top[:, :, x1i] * xf[None, None, :]            # (c, ph*s, pw*s)
+        val = val * (yok[:, None] * xok[None, :])[None]
+        val = val.reshape(c, ph, s, pw, s).mean(axis=(2, 4))
+        # rois with y2<y1 (empty) produce zeros like the reference
+        return val
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32))
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# bounding boxes (reference: contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+def _pair_iou(a, b):
+    """a: (..., N, 4), b: (..., M, 4) corner boxes -> IoU (..., N, M)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(x):
+    xc, yc, w, h = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+    return jnp.stack([xc - w / 2, yc - h / 2, xc + w / 2, yc + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_box_iou", arity=2, differentiable=False)
+def _box_iou(lhs, rhs, format="corner"):
+    a = lhs.astype(jnp.float32)
+    b = rhs.astype(jnp.float32)
+    if format == "center":
+        a, b = _to_corner(a), _to_corner(b)
+    return _pair_iou(a, b)
+
+
+@register("_contrib_box_nms", differentiable=False)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+             in_format="corner", out_format="corner", background_id=-1):
+    """Score-sorted greedy NMS; suppressed/invalid entries get score -1
+    (the reference's convention). Fixed trip count keeps it compilable."""
+    if out_format != in_format:
+        raise NotImplementedError("box_nms: in/out format conversion")
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    b, n, width = data.shape
+    f32 = data.astype(jnp.float32)
+    scores = f32[:, :, score_index]
+    boxes = lax.dynamic_slice_in_dim(f32, coord_start, 4, axis=2)
+    if in_format == "center":
+        boxes = _to_corner(boxes)
+    ids = (f32[:, :, id_index] if id_index is not None and id_index >= 0
+           else jnp.zeros((b, n), jnp.float32))
+
+    valid = scores > valid_thresh
+    if id_index is not None and id_index >= 0 and background_id >= 0:
+        valid &= ids != background_id
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=1)
+    k = n if topk is None or topk <= 0 else min(int(topk), n)
+
+    sb = jnp.take_along_axis(boxes, order[:, :, None], axis=1)
+    sv = jnp.take_along_axis(valid, order, axis=1)
+    sid = jnp.take_along_axis(ids, order, axis=1)
+    iou = _pair_iou(sb, sb)                                # (b, n, n)
+    same_cls = (sid[:, :, None] == sid[:, None, :]) | force_suppress
+
+    def body(i, keep):
+        # candidate i suppresses every later j overlapping it — only if i
+        # itself is still kept
+        act = keep[:, i] & sv[:, i]
+        sup = (iou[:, i, :] > overlap_thresh) & same_cls[:, i, :] & \
+            (jnp.arange(n)[None, :] > i)
+        return keep & ~(sup & act[:, None])
+
+    keep = lax.fori_loop(0, k, body, jnp.ones((b, n), bool)) & sv
+    keep &= jnp.arange(n)[None, :] < k
+
+    # scatter back to sorted order, score -1 where dropped
+    out_sorted = jnp.take_along_axis(f32, order[:, :, None], axis=1)
+    new_scores = jnp.where(keep, out_sorted[:, :, score_index], -1.0)
+    out_sorted = out_sorted.at[:, :, score_index].set(new_scores)
+    out = out_sorted.astype(data.dtype)
+    return out[0] if squeeze else out
+
+
+@register("_contrib_box_encode", arity=6, differentiable=False)
+def _box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+                stds=(0.1, 0.1, 0.2, 0.2)):
+    """SSD target encoding (reference: bounding_box.cc BoxEncode):
+    corner anchors/refs -> normalized center-form offsets."""
+    f = jnp.float32
+    a = _to_center(anchors.astype(f))
+    g = _to_center(jnp.take_along_axis(
+        refs.astype(f), matches[..., None].astype(jnp.int32), axis=1))
+    t0 = (g[..., 0] - a[..., 0]) / a[..., 2]
+    t1 = (g[..., 1] - a[..., 1]) / a[..., 3]
+    t2 = jnp.log(jnp.maximum(g[..., 2] / a[..., 2], 1e-12))
+    t3 = jnp.log(jnp.maximum(g[..., 3] / a[..., 3], 1e-12))
+    t = jnp.stack([t0, t1, t2, t3], axis=-1)
+    t = (t - jnp.asarray(means, f)) / jnp.asarray(stds, f)
+    mask = (samples[..., None] > 0.5).astype(f)
+    return t * mask, mask
+
+
+def _to_center(x):
+    x1, y1, x2, y2 = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2,
+                      jnp.maximum(x2 - x1, 0.0),
+                      jnp.maximum(y2 - y1, 0.0)], axis=-1)
+
+
+@register("_contrib_box_decode", arity=2, differentiable=False)
+def _box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+                clip=-1.0, format="corner"):
+    """Inverse of box_encode (reference: bounding_box.cc BoxDecode)."""
+    f = jnp.float32
+    a = anchors.astype(f)
+    if format == "corner":
+        a = _to_center(a)
+    d = data.astype(f)
+    x = d[..., 0] * std0 * a[..., 2] + a[..., 0]
+    y = d[..., 1] * std1 * a[..., 3] + a[..., 1]
+    dw = d[..., 2] * std2
+    dh = d[..., 3] * std3
+    if clip is not None and clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    w = jnp.exp(dw) * a[..., 2] / 2
+    h = jnp.exp(dh) * a[..., 3] / 2
+    return jnp.stack([x - w, y - h, x + w, y + h],
+                     axis=-1).astype(data.dtype)
+
+
+alias("_contrib_BilinearResize2D", "_contrib_bilinear_resize2d")
+alias("_contrib_AdaptiveAvgPooling2D", "_contrib_adaptive_avg_pooling2d")
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox ops (reference: contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc) — the reference's in-tree SSD
+# training graph: anchor generation, target matching, decode+NMS.
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchors for one feature map: (1, H*W*A, 4) corner boxes in [0, 1],
+    A = len(sizes) + len(ratios) - 1, ordered exactly like the reference
+    kernel (multibox_prior-inl.h): every size at the FIRST ratio first,
+    then ratios[1:] at sizes[0]. Widths carry the reference's
+    in_height/in_width aspect correction so anchors stay square in pixel
+    space on non-square feature maps."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    step_y = 1.0 / h if steps is None or steps[0] <= 0 else float(steps[0])
+    step_x = 1.0 / w if steps is None or steps[1] <= 0 else float(steps[1])
+    cy = (jnp.arange(h, dtype=jnp.float32) + float(offsets[0])) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + float(offsets[1])) * step_x
+    aspect = float(h) / float(w)
+    wh = []
+    for s in sizes:                      # all sizes at ratios[0]
+        sr = _np.sqrt(ratios[0])
+        wh.append((s * aspect * sr / 2.0, s / sr / 2.0))
+    for r in ratios[1:]:                 # remaining ratios at sizes[0]
+        sr = _np.sqrt(r)
+        wh.append((sizes[0] * aspect * sr / 2.0, sizes[0] / sr / 2.0))
+    wh = jnp.asarray(wh, jnp.float32)                     # (A, 2)
+    ctr = jnp.stack(jnp.meshgrid(cx, cy), axis=-1)        # (h, w, 2) [x, y]
+    ctr = ctr.reshape(h * w, 1, 2)
+    boxes = jnp.concatenate([ctr - wh[None], ctr + wh[None]], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+@register("_contrib_MultiBoxTarget", arity=3, differentiable=False,
+          num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth and emit SSD training targets
+    (reference: multibox_target.cc). anchor (1, N, 4) corner; label
+    (B, M, 5) [cls, x1, y1, x2, y2] padded with cls=-1; cls_pred
+    (B, C+1, N) (used only for negative mining). Returns
+    (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N))."""
+    f = jnp.float32
+    a = anchor.astype(f).reshape(-1, 4)                   # (N, 4)
+    n = a.shape[0]
+    lab = label.astype(f)
+    if lab.ndim == 2:
+        lab = lab[None]
+    b, m, _ = lab.shape
+    gt_cls = lab[..., 0]                                  # (B, M), -1 = pad
+    gt_box = lab[..., 1:5]
+    gt_valid = gt_cls >= 0
+
+    iou = _pair_iou(jnp.broadcast_to(a, (b, n, 4)), gt_box)   # (B, N, M)
+    iou = jnp.where(gt_valid[:, None, :], iou, -1.0)
+
+    # stage 1 (bipartite-greedy in the reference; argmax approximation):
+    # each valid GT claims its best anchor unconditionally
+    best_anchor = jnp.argmax(iou, axis=1)                 # (B, M)
+    claimed = jnp.zeros((b, n), bool)
+    claimed_gt = jnp.full((b, n), -1, jnp.int32)
+
+    def claim(j, st):
+        claimed, claimed_gt = st
+        idx = best_anchor[:, j]
+        # a GT with zero IoU against every anchor (degenerate box) must not
+        # claim one — the reference skips unmatched GTs
+        has_overlap = jnp.max(iou[:, :, j], axis=1) > 0
+        ok = gt_valid[:, j] & has_overlap & ~jnp.take_along_axis(
+            claimed, idx[:, None], axis=1)[:, 0]
+        claimed = claimed.at[jnp.arange(b), idx].set(
+            claimed[jnp.arange(b), idx] | ok)
+        claimed_gt = claimed_gt.at[jnp.arange(b), idx].set(
+            jnp.where(ok, j, claimed_gt[jnp.arange(b), idx]))
+        return claimed, claimed_gt
+
+    claimed, claimed_gt = lax.fori_loop(0, m, claim, (claimed, claimed_gt))
+
+    # stage 2: remaining anchors match their best GT if IoU > threshold
+    best_gt = jnp.argmax(iou, axis=2)                     # (B, N)
+    best_iou = jnp.max(iou, axis=2)
+    thresh_ok = best_iou >= overlap_threshold
+    match = jnp.where(claimed, claimed_gt,
+                      jnp.where(thresh_ok, best_gt, -1))  # (B, N)
+    pos = match >= 0
+
+    mg = jnp.clip(match, 0, m - 1)
+    g = jnp.take_along_axis(gt_box, mg[..., None], axis=1)    # (B, N, 4)
+    gc = _to_center(g)
+    ac = _to_center(a)[None]
+    v = variances
+    t = jnp.stack([
+        (gc[..., 0] - ac[..., 0]) / jnp.maximum(ac[..., 2], 1e-12) / v[0],
+        (gc[..., 1] - ac[..., 1]) / jnp.maximum(ac[..., 3], 1e-12) / v[1],
+        jnp.log(jnp.maximum(gc[..., 2] / jnp.maximum(ac[..., 2], 1e-12),
+                            1e-12)) / v[2],
+        jnp.log(jnp.maximum(gc[..., 3] / jnp.maximum(ac[..., 3], 1e-12),
+                            1e-12)) / v[3]], axis=-1)
+    box_target = jnp.where(pos[..., None], t, 0.0).reshape(b, n * 4)
+    box_mask = jnp.where(pos[..., None],
+                         jnp.ones((), f), 0.0)
+    box_mask = jnp.broadcast_to(box_mask, (b, n, 4)).reshape(b, n * 4)
+
+    cls_matched = jnp.take_along_axis(gt_cls, mg, axis=1)     # (B, N)
+    cls_target = jnp.where(pos, cls_matched + 1.0, 0.0)       # 0 = background
+
+    if negative_mining_ratio is not None and negative_mining_ratio > 0:
+        # hard-negative mining: keep the ratio*num_pos highest-loss
+        # negatives (proxied by background confidence deficit), rest ignored
+        bg_prob = cls_pred.astype(f)[:, 0, :]                 # (B, N)
+        neg_score = -bg_prob                                  # harder = higher
+        neg = ~pos & (best_iou < negative_mining_thresh)
+        num_pos = jnp.sum(pos, axis=1, keepdims=True).astype(f)
+        quota = jnp.maximum(num_pos * float(negative_mining_ratio),
+                            float(minimum_negative_samples))
+        rank = jnp.argsort(jnp.argsort(
+            jnp.where(neg, neg_score, -jnp.inf), axis=1, descending=True),
+            axis=1).astype(f)
+        keep_neg = neg & (rank < quota)
+        cls_target = jnp.where(pos | keep_neg, cls_target,
+                               float(ignore_label))
+    return box_target, box_mask, cls_target
+
+
+@register("_contrib_MultiBoxDetection", arity=3, differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions against anchors and NMS (reference:
+    multibox_detection.cc). cls_prob (B, C+1, N), loc_pred (B, N*4),
+    anchor (1, N, 4) -> (B, N, 6) rows [cls_id, score, x1, y1, x2, y2],
+    suppressed rows get cls_id -1."""
+    f = jnp.float32
+    p = cls_prob.astype(f)
+    b, _, n = p.shape
+    loc = loc_pred.astype(f).reshape(b, n, 4)
+    v = variances
+    boxes = _box_decode(loc, anchor.astype(f).reshape(1, -1, 4),
+                        std0=v[0], std1=v[1], std2=v[2], std3=v[3],
+                        format="corner")
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+
+    # per-anchor best foreground class
+    fg = jnp.concatenate([p[:, :background_id], p[:, background_id + 1:]],
+                         axis=1)                              # (B, C, N)
+    cls_id = jnp.argmax(fg, axis=1).astype(f)                 # (B, N)
+    score = jnp.max(fg, axis=1)
+    valid = score > threshold
+    rows = jnp.concatenate([
+        jnp.where(valid, cls_id, -1.0)[..., None],
+        jnp.where(valid, score, -1.0)[..., None], boxes], axis=-1)
+    out = _box_nms(rows, overlap_thresh=nms_threshold,
+                   valid_thresh=threshold, topk=nms_topk,
+                   coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+    # reference convention: suppressed rows flagged via cls_id -1
+    sup = out[..., 1] <= 0
+    out = out.at[..., 0].set(jnp.where(sup, -1.0, out[..., 0]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (reference: contrib/deformable_convolution.cc,
+# Dai et al. 2017) and PSROIPooling (contrib/psroi_pooling.cc, R-FCN).
+# TPU-first: the deformable sampling is a static unroll over kernel taps —
+# each tap is one vectorized bilinear gather over the whole batch, and the
+# channel contraction stays a single einsum on the MXU per tap group.
+# ---------------------------------------------------------------------------
+def _bilinear_gather(img, ys, xs):
+    """img (C, H, W); ys/xs (Ho, Wo) fractional coords -> (C, Ho, Wo).
+    Corner taps outside the image contribute zero — the value decays
+    bilinearly to zero across the border instead of clamping to the edge
+    pixel, exactly the reference's dmcn_im2col_bilinear behavior (also
+    what keeps the offset gradient alive at image edges)."""
+    h, w = img.shape[1], img.shape[2]
+    y0f = jnp.floor(ys)
+    x0f = jnp.floor(xs)
+    yf = (ys - y0f)[None]
+    xf = (xs - x0f)[None]
+    y0 = y0f.astype(jnp.int32)
+    x0 = x0f.astype(jnp.int32)
+
+    def corner(yi, xi):
+        ok = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)) \
+            .astype(jnp.float32)
+        v = img[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+        return v * ok[None]
+
+    return (corner(y0, x0) * (1 - yf) * (1 - xf) +
+            corner(y0, x0 + 1) * (1 - yf) * xf +
+            corner(y0 + 1, x0) * yf * (1 - xf) +
+            corner(y0 + 1, x0 + 1) * yf * xf)
+
+
+@register("_contrib_DeformableConvolution", arity=3)
+def _deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                            stride=None, dilate=None, pad=None,
+                            num_filter=None, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            layout=None, workspace=None):
+    """data (N, C, H, W); offset (N, 2*dg*kh*kw, Ho, Wo) ordered
+    [y, x] per tap per deformable group; weight (O, C/g, kh, kw)."""
+    if num_group != 1:
+        raise NotImplementedError("DeformableConvolution: num_group > 1")
+    from .nn import layout_info
+    _, last = layout_info(layout, 2, "DeformableConvolution")
+    if last:
+        raise NotImplementedError(
+            "DeformableConvolution: channels-last layouts not implemented")
+    kh, kw = kernel
+    stride = _pair(stride if stride else 1, 2)
+    dilate = _pair(dilate if dilate else 1, 2)
+    pad = _pair(pad if pad else 0, 2)
+    n, c, h, w = data.shape
+    ho = (h + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
+    wo = (w + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
+    dg = num_deformable_group
+    if c % dg != 0:
+        raise ValueError(
+            "DeformableConvolution: channels (%d) must divide evenly into "
+            "num_deformable_group (%d)" % (c, dg))
+    cg = c // dg
+    f32 = data.astype(jnp.float32)
+    off = offset.astype(jnp.float32).reshape(n, dg, kh * kw, 2, ho, wo)
+
+    base_y = (jnp.arange(ho) * stride[0] - pad[0])[:, None]      # (Ho, 1)
+    base_x = (jnp.arange(wo) * stride[1] - pad[1])[None, :]      # (1, Wo)
+
+    out = jnp.zeros((n, num_filter, ho, wo), jnp.float32)
+    wgt = weight.astype(jnp.float32)
+    for k in range(kh * kw):
+        ky, kx = k // kw, k % kw
+        for g in range(dg):
+            ys = base_y + ky * dilate[0] + off[:, g, k, 0]       # (N, Ho, Wo)
+            xs = base_x + kx * dilate[1] + off[:, g, k, 1]
+            sampled = jax.vmap(_bilinear_gather)(
+                f32[:, g * cg:(g + 1) * cg], ys, xs)             # (N,cg,Ho,Wo)
+            out = out + jnp.einsum("nchw,oc->nohw", sampled,
+                                   wgt[:, g * cg:(g + 1) * cg, ky, kx])
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32).reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_PSROIPooling", arity=2)
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                   pooled_size=None, group_size=None):
+    """Position-sensitive ROI pooling (reference: psroi_pooling.cc).
+    data (N, output_dim*ps*ps, H, W); rois (R, 5) [b, x1, y1, x2, y2];
+    output (R, output_dim, ps, ps) — bin (i, j) averages its OWN channel
+    slice over its sub-window. Masked means keep every shape static."""
+    ps = int(pooled_size)
+    if group_size is not None and int(group_size) != ps:
+        raise NotImplementedError("PSROIPooling: group_size != pooled_size")
+    n, ctot, h, w = data.shape
+    od = int(output_dim)
+    f32 = data.astype(jnp.float32).reshape(n, od, ps, ps, h, w)
+
+    hh = jnp.arange(h, dtype=jnp.float32)
+    ww = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        # reference psroi_pooling.cc: start = round(coord)*scale,
+        # end = (round(coord)+1)*scale — the window includes the end
+        # pixel. C round() is half-away-from-zero: floor(x+0.5) for the
+        # non-negative coords here (jnp.round is half-to-even).
+        x1 = jnp.floor(roi[1] + 0.5) * spatial_scale
+        y1 = jnp.floor(roi[2] + 0.5) * spatial_scale
+        x2 = (jnp.floor(roi[3] + 0.5) + 1.0) * spatial_scale
+        y2 = (jnp.floor(roi[4] + 0.5) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ps, rw / ps
+        # bin windows [floor(start), ceil(end)) as row/col masks
+        i = jnp.arange(ps, dtype=jnp.float32)
+        hs = jnp.floor(y1 + i * bh)            # (ps,)
+        he = jnp.ceil(y1 + (i + 1) * bh)
+        ws_ = jnp.floor(x1 + i * bw)
+        we = jnp.ceil(x1 + (i + 1) * bw)
+        rmask = ((hh[None, :] >= hs[:, None]) &
+                 (hh[None, :] < he[:, None])).astype(jnp.float32)  # (ps, H)
+        cmask = ((ww[None, :] >= ws_[:, None]) &
+                 (ww[None, :] < we[:, None])).astype(jnp.float32)  # (ps, W)
+        img = f32[bidx]                                  # (od, ps, ps, H, W)
+        num = jnp.einsum("dijhw,ih,jw->dij", img, rmask, cmask)
+        cnt = jnp.einsum("ih,jw->ij", rmask, cmask)
+        return num / jnp.maximum(cnt, 1.0)[None]
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32))
+    return out.astype(data.dtype)

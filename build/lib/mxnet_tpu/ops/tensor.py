@@ -1,0 +1,726 @@
+"""Tensor ops: elementwise, broadcast, reductions, shape manipulation, indexing.
+
+TPU-native analog of the reference's src/operator/tensor/* op families
+(reference: elemwise_unary_op_basic.cc, elemwise_binary_broadcast_op_basic.cc,
+broadcast_reduce_op_value.cc, matrix_op.cc, indexing_op.cc, dot.cc,
+control_flow_op.cc, ordering_op.cc). Implementations are jax.numpy/lax
+compositions — XLA fuses elementwise chains natively, which is what the
+reference's mshadow expression templates + NVRTC pointwise fusion existed to do
+(SURVEY.md §2.1), so there is no per-op kernel code here by design.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+from ..base import get_env, np_dtype
+
+_f32 = jnp.float32
+
+
+def _safe_acc_dtype(x):
+    """reference: MXNET_SAFE_ACCUMULATION — accumulate small floats in fp32."""
+    if get_env("MXNET_SAFE_ACCUMULATION") and x.dtype in (jnp.float16, jnp.bfloat16):
+        return _f32
+    return None
+
+
+def _norm_axis(axis, exclude=False, ndim=None):
+    if axis is None:
+        ax = None
+    elif isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(axis)
+    if exclude and ax is not None:
+        ax = tuple(i for i in range(ndim) if i not in
+                   tuple(a % ndim for a in ax))
+    return ax
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise (reference: src/operator/tensor/elemwise_unary_op_basic.cc)
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal, "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt, "cbrt": jnp.cbrt, "exp": jnp.exp, "log": jnp.log,
+    "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "expm1": jnp.expm1, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfinv": lax.erf_inv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "sigmoid": jax.nn.sigmoid, "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+}
+for _n, _f in _UNARY.items():
+    register(_n)(lambda x, _f=_f: _f(x))
+
+_UNARY_NONDIFF = {
+    "round": jnp.round, "rint": jnp.rint, "ceil": jnp.ceil,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "isnan": lambda x: jnp.isnan(x).astype(_f32),
+    "isinf": lambda x: jnp.isinf(x).astype(_f32),
+    "isfinite": lambda x: jnp.isfinite(x).astype(_f32),
+}
+for _n, _f in _UNARY_NONDIFF.items():
+    register(_n, differentiable=False)(lambda x, _f=_f: _f(x))
+
+alias("negative", "_np_negative")
+alias("log", "_np_log")
+
+
+@register("cast")
+def _cast(x, dtype=None):
+    """reference: src/operator/tensor/elemwise_unary_op_basic.cc (Cast)."""
+    return x.astype(np_dtype(dtype))
+
+
+alias("cast", "Cast", "amp_cast")
+
+
+@register("clip")
+def _clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("identity")
+def _identity(x):
+    return x
+
+
+alias("identity", "_copy", "stop_gradient_passthrough", "BlockGrad_inner")
+
+
+@register("BlockGrad")
+def _block_grad(x):
+    """reference: src/operator/tensor/elemwise_unary_op_basic.cc (BlockGrad)."""
+    return lax.stop_gradient(x)
+
+
+alias("BlockGrad", "stop_gradient")
+
+
+@register("zeros_like")
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def _size_array(x):
+    return jnp.asarray([x.size], dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# binary broadcast (reference: elemwise_binary_broadcast_op_basic.cc etc.)
+# scalars are accepted directly, covering the reference's *_scalar variants.
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "broadcast_add": jnp.add, "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply, "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod, "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum, "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+}
+for _n, _f in _BINARY.items():
+    register(_n)(lambda a, b, _f=_f: _f(a, b))
+
+alias("broadcast_add", "elemwise_add", "_plus", "_add", "_plus_scalar")
+alias("broadcast_sub", "elemwise_sub", "_minus", "_sub", "_minus_scalar")
+alias("broadcast_mul", "elemwise_mul", "_mul", "_mul_scalar")
+alias("broadcast_div", "elemwise_div", "_div", "_div_scalar")
+alias("broadcast_maximum", "maximum", "_maximum")
+alias("broadcast_minimum", "minimum", "_minimum")
+alias("broadcast_power", "_power", "_power_scalar", "pow")
+
+_CMP = {
+    "broadcast_equal": jnp.equal, "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less, "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+for _n, _f in _CMP.items():
+    register(_n, differentiable=False)(
+        lambda a, b, _f=_f: _f(a, b).astype(
+            a.dtype if hasattr(a, "dtype") and jnp.issubdtype(
+                jnp.asarray(a).dtype, jnp.floating) else _f32))
+
+alias("broadcast_equal", "_equal", "_equal_scalar")
+alias("broadcast_not_equal", "_not_equal")
+alias("broadcast_greater", "_greater", "_greater_scalar")
+alias("broadcast_lesser", "_lesser", "_lesser_scalar")
+
+
+@register("where")
+def _where(condition, x, y):
+    """reference: src/operator/tensor/control_flow_op.cc (where)."""
+    return jnp.where(condition.astype(bool) if hasattr(condition, "astype")
+                     else condition, x, y)
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+def _reduce(fn):
+    def impl(x, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, exclude, x.ndim)
+        acc = _safe_acc_dtype(x)
+        if acc is not None and fn in (jnp.sum, jnp.mean, jnp.prod):
+            return fn(x.astype(acc), axis=ax, keepdims=keepdims).astype(x.dtype)
+        return fn(x, axis=ax, keepdims=keepdims)
+    return impl
+
+
+register("sum")(_reduce(jnp.sum))
+register("mean")(_reduce(jnp.mean))
+register("prod")(_reduce(jnp.prod))
+register("max")(_reduce(jnp.max))
+register("min")(_reduce(jnp.min))
+alias("sum", "sum_axis")
+# _np_sum/_np_mean are NOT aliased to the legacy reduce ops: the numpy
+# namespace registers them over jnp directly (dtype=, tuple-axis, numpy
+# promotion), see mxnet_tpu/numpy/__init__.py
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@register("nansum")
+def _nansum(x, axis=None, keepdims=False, exclude=False):
+    return jnp.nansum(x, axis=_norm_axis(axis, exclude, x.ndim), keepdims=keepdims)
+
+
+@register("nanprod")
+def _nanprod(x, axis=None, keepdims=False, exclude=False):
+    return jnp.nanprod(x, axis=_norm_axis(axis, exclude, x.ndim), keepdims=keepdims)
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    ax = axis if axis is None or isinstance(axis, tuple) else (axis,)
+    acc = _safe_acc_dtype(x)
+    xa = x.astype(acc) if acc is not None else x
+    if ord == 1:
+        r = jnp.sum(jnp.abs(xa), axis=ax, keepdims=keepdims)
+    else:
+        r = jnp.sqrt(jnp.sum(jnp.square(xa), axis=ax, keepdims=keepdims))
+    return r.astype(x.dtype)
+
+
+def _index_float():
+    """Float dtype for mxnet's float-index convention. float32 is exact
+    only to 2^24; inside mx.util.large_tensor_scope() positions can
+    exceed 2^31, so the wide scope reports float64 (exact to 2^53)."""
+    from ..base import x64_enabled
+    return jnp.float64 if x64_enabled() else _f32
+
+
+@register("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdims=False):
+    r = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return r.astype(_index_float())  # reference returns float indices
+
+
+@register("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(
+        _index_float())
+
+
+@register("argsort", differentiable=False)
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    idx = jnp.argsort(x if is_ascend else -x, axis=axis, stable=True)
+    return idx.astype(np_dtype(dtype))
+
+
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True):
+    s = jnp.sort(x, axis=axis)
+    return s if is_ascend else jnp.flip(s, axis=axis)
+
+
+@register("topk", differentiable=False)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """reference: src/operator/tensor/ordering_op.cc (topk)."""
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(np_dtype(dtype))
+    return idx.astype(np_dtype(dtype))
+
+
+@register("cumsum")
+def _cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    r = jnp.cumsum(x, axis=axis)
+    return r.astype(np_dtype(dtype)) if dtype is not None else r
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation (reference: matrix_op.cc)
+# ---------------------------------------------------------------------------
+@register("reshape")
+def _reshape(x, shape=None, reverse=False):
+    """reference: matrix_op.cc (Reshape) — supports the 0/-1/-2/-3/-4 codes."""
+    shape = tuple(shape)
+    if any(s in (0, -2, -3, -4) for s in shape):
+        shape = _mx_reshape(x.shape, shape, reverse)
+    return jnp.reshape(x, shape)
+
+
+def _mx_reshape(ishape, target, reverse=False):
+    """Implement MXNet's special reshape codes:
+    0 copy dim, -1 infer, -2 copy rest, -3 merge two, -4 split."""
+    ishape = list(ishape[::-1]) if reverse else list(ishape)
+    tgt = list(target[::-1]) if reverse else list(target)
+    out = []
+    i = 0
+    j = 0
+    while j < len(tgt):
+        t = tgt[j]
+        if t == 0:
+            out.append(ishape[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(ishape[i:]); i = len(ishape)
+        elif t == -3:
+            out.append(ishape[i] * ishape[i + 1]); i += 2
+        elif t == -4:
+            d1, d2 = tgt[j + 1], tgt[j + 2]
+            if d1 == -1:
+                d1 = ishape[i] // d2
+            if d2 == -1:
+                d2 = ishape[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(t); i += 1
+        j += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in ishape:
+            total *= d
+        out[out.index(-1)] = total // known
+    return tuple(out[::-1]) if reverse else tuple(out)
+
+
+alias("reshape", "Reshape")
+
+
+@register("flatten")
+def _flatten(x):
+    """reference: matrix_op.cc (Flatten) — keeps dim0, flattens the rest."""
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+alias("flatten", "Flatten")
+
+
+@register("transpose")
+def _transpose(x, axes=None):
+    if not axes:
+        axes = None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("swapaxes")
+def _swapaxes(x, dim1=0, dim2=1):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+alias("swapaxes", "SwapAxis")
+
+
+@register("moveaxis")
+def _moveaxis(x, source=0, destination=0):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None):
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_axis")
+def _broadcast_axis(x, axis=None, size=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("tile")
+def _tile(x, reps=None):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad")
+def _pad(x, mode="constant", pad_width=None, constant_value=0.0):
+    """reference: src/operator/pad.cc — pad_width in flattened begin/end pairs."""
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(x.ndim)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(x, pw, mode="edge" if mode == "edge" else "reflect")
+
+
+alias("pad", "Pad")
+
+
+@register("slice")
+def _slice(x, begin=None, end=None, step=None):
+    """reference: matrix_op.cc (slice)."""
+    nd = x.ndim
+    begin = list(begin) + [None] * (nd - len(begin))
+    end = list(end) + [None] * (nd - len(end))
+    step = (list(step) + [None] * (nd - len(step))) if step else [None] * nd
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(x, shape_like, axes=None):
+    tgt = list(x.shape)
+    axes = axes if axes else range(x.ndim)
+    for a in axes:
+        tgt[a] = shape_like.shape[a]
+    return x[tuple(slice(0, t) for t in tgt)]
+
+
+@register("reverse")
+def _reverse(x, axis=0):
+    return jnp.flip(x, axis=axis)
+
+
+alias("reverse", "flip")
+
+
+@register("concat")
+def _concat(*xs, dim=1, num_args=None):
+    """reference: src/operator/nn/concat.cc."""
+    return jnp.concatenate(xs, axis=dim)
+
+
+alias("concat", "Concat")
+
+
+@register("stack")
+def _stack(*xs, axis=0, num_args=None):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split", num_outputs=0)
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    """reference: src/operator/slice_channel.cc (SliceChannel)."""
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+alias("split", "SliceChannel")
+
+
+@register("_split_v2", num_outputs=0)
+def _split_v2_op(x, indices_or_sections=1, axis=0, squeeze_axis=False):
+    """reference: matrix_op.cc (_split_v2) — split by count or indices."""
+    parts = jnp.split(x, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (reference: src/operator/tensor/dot.cc, la_op.cc)
+# ---------------------------------------------------------------------------
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    """reference: dot.cc — contracts last axis of a with first of b.
+    On TPU this is the MXU path; keep operands large and let XLA tile."""
+    if transpose_a:
+        a = jnp.transpose(a)
+    if transpose_b:
+        b = jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    """reference: dot.cc (batch_dot) — leading dims are batch."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def _linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_syrk")
+def _linalg_syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# indexing (reference: src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+def _as_index(i):
+    """Index normalization: float indices (the mxnet convention) cast to
+    the platform index width — int64 inside mx.util.large_tensor_scope()
+    (x64 on), int32 otherwise. Integer inputs keep their width so int64
+    indices survive for >2^31-element gathers."""
+    from ..base import x64_enabled
+    i = jnp.asarray(i)
+    if jnp.issubdtype(i.dtype, jnp.integer):
+        return i
+    return i.astype(jnp.int64 if x64_enabled() else jnp.int32)
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    idx = _as_index(indices)
+    return jnp.take(a, idx, axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@register("pick")
+def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(_as_index(index), 0, x.shape[axis] - 1)
+    r = jnp.take_along_axis(x, jnp.expand_dims(idx, axis=axis), axis=axis)
+    return r if keepdims else jnp.squeeze(r, axis=axis)
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    """reference: indexing_op.cc (gather_nd) — indices shape (M, ...)."""
+    idx = tuple(_as_index(indices))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(_as_index(indices))
+    return out.at[idx].set(data)
+
+
+@register("one_hot")
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=np_dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+               sparse_grad=False):
+    """reference: indexing_op.cc (Embedding). On TPU an embedding lookup is a
+    gather; sparse_grad maps to the rowsparse path in ops/sparse.py."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("take_along_axis")
+def _take_along_axis(a, indices, axis=0):
+    return jnp.take_along_axis(a, _as_index(indices), axis=axis)
+
+
+@register("where_index", differentiable=False)
+def _where_index(x):
+    # dynamic-shape op: only usable eagerly (documented XLA constraint)
+    return jnp.asarray(_np.nonzero(_np.asarray(x))[0], dtype=jnp.int32)
+
+
+@register("boolean_mask", differentiable=False)
+def _boolean_mask(data, index, axis=0):
+    mask = _np.asarray(index).astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+@register("SequenceMask")
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0):
+    """reference: src/operator/sequence_mask.cc — mask time axis by length."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = -1 if axis == 0 else -1
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+        ).squeeze(0)
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1).squeeze(1)
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(steps < L, L - 1 - steps, steps)
+    return jnp.take_along_axis(
+        data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+@register("diag")
+def _diag(x, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("eye", creation=True)
+def _eye(N=1, M=0, k=0, ctx=None, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=np_dtype(dtype))
+
+
+@register("logsumexp")
+def _logsumexp(x, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels (reference: src/operator/tensor/dot.cc csr FComputeEx).
+# Raw-array ops so the autograd tape records them: cotangents flow to the
+# dense rhs (and to sp_data) through gather/segment_sum transposes — the
+# backward the reference hand-writes in dot_backward_csr.
+# ---------------------------------------------------------------------------
+@register("_sparse_dot_csr_dense", arity=4)
+def _sparse_dot_csr_dense(sp_data, sp_indices, rows, rhs, m=0, k=0,
+                          transpose_a=False):
+    """csr(m,k) · dense(k,n) (or csrᵀ · dense → (k,n)): per-nnz gather +
+    segment-sum, the TPU-friendly formulation (MXU-free but fuses well)."""
+    rows = rows.astype(jnp.int32)
+    cols = sp_indices.astype(jnp.int32)
+    if transpose_a:
+        contrib = sp_data[:, None] * rhs[rows]
+        out = jnp.zeros((int(k), rhs.shape[1]), dtype=contrib.dtype)
+        return out.at[cols].add(contrib)
+    gathered = rhs[cols]
+    contrib = sp_data[:, None] * gathered
+    return jax.ops.segment_sum(contrib, rows, num_segments=int(m))

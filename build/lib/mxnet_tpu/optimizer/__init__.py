@@ -1,0 +1,6 @@
+"""Optimizer API. reference: python/mxnet/optimizer/__init__.py."""
+from . import optimizer
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import Optimizer, create, register, get_updater, Updater
+
+__all__ = optimizer.__all__

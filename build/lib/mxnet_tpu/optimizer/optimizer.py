@@ -1,0 +1,1069 @@
+"""Optimizer registry + implementations.
+
+TPU-native analog of reference python/mxnet/optimizer/optimizer.py. Same
+registry (`@Optimizer.register`, `create_optimizer`), same state protocol
+(`create_state` / `update` / multi-precision fp32 master weights), same
+`lr_mult`/`wd_mult` resolution order, and the same serializable `Updater`
+(the object the reference pickles and ships to parameter servers via
+`kvstore.set_optimizer`).
+
+Update rules execute through the optimizer ops registered in
+mxnet_tpu/ops/optimizer_ops.py (reference: src/operator/optimizer_op.cc), so
+eager calls are one fused XLA computation each, and a jitted trainer step
+fuses them into the whole-step graph.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+import warnings
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from ..ops import registry as _reg
+
+__all__ = ["Optimizer", "create", "register", "get_updater", "Updater",
+           "SGD", "Signum", "SignSGD", "FTML", "LARS", "DCASGD", "NAG",
+           "SGLD", "Adam", "AdaGrad", "AdaDelta", "Adamax", "Nadam",
+           "RMSProp", "Ftrl", "LAMB", "AdamW", "LBSGD", "Test"]
+
+
+def _run_op(name, *arrays, **kwargs):
+    """Execute an optimizer op on NDArray payloads, writing results back
+    in-place — the reference's out=weight convention. Every optimizer op
+    takes (weight, grad, *states) and returns (weight, *states): the grad
+    input is read-only and produces no output.
+
+    row_sparse grads with lazy_update=True take the lazy path (reference:
+    optimizer_op.cc rowsparse kernels): only rows present in grad.indices
+    are touched — momentum/history of absent rows is NOT decayed."""
+    from ..ndarray.sparse import RowSparseNDArray
+    op = _reg.get(name)
+    grad = arrays[1] if len(arrays) > 1 else None
+    if isinstance(grad, RowSparseNDArray) and kwargs.get("lazy_update") \
+            and grad._indices.shape[0] < grad.shape[0]:
+        idx = grad._indices
+        w_full = arrays[0]._read()
+        state_fulls = [a._read() for a in arrays[2:]]
+        row_args = [w_full[idx], grad._values] + [s[idx] for s in state_fulls]
+        out = op.fn(*row_args, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+        targets = [arrays[0]] + list(arrays[2:])
+        fulls = [w_full] + state_fulls
+        assert len(targets) == len(out)
+        for target, full, new in zip(targets, fulls, out):
+            target._write(full.at[idx].set(new.astype(full.dtype)))
+        return
+    raws = [a._read() for a in arrays]
+    out = op.fn(*raws, **kwargs)
+    if not isinstance(out, tuple):
+        out = (out,)
+    targets = [arrays[0]] + list(arrays[2:])
+    assert len(targets) == len(out), \
+        "optimizer op %s returned %d outputs for %d targets" % (
+            name, len(out), len(targets))
+    for target, new in zip(targets, out):
+        target._write(new.astype(target._read().dtype))
+
+
+# ---------------------------------------------------------------------------
+# fused multi-parameter updates (reference: src/operator/optimizer_op.cc
+# multi_sgd_update / multi_sgd_mom_update / multi_mp_sgd_*, surfaced by
+# Optimizer.aggregate_num). One jitted call updates every parameter of a
+# step — the dominant eager-trainer cost is per-op dispatch, and XLA fuses
+# the whole bundle. jit caches on the list-of-shapes structure.
+# ---------------------------------------------------------------------------
+_FUSED_CACHE = {}
+
+
+def _fused_fn(kind, momentum_on, clip_on):
+    import jax as _jax
+    key = (kind, momentum_on, clip_on)
+    fn = _FUSED_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def prep(g, w, rescale, clip, wd):
+        g = g.astype(jnp.float32) * rescale
+        if clip_on:
+            g = jnp.clip(g, -clip, clip)
+        return g + wd * w.astype(jnp.float32)
+
+    if kind == "sgd":
+        def impl(ws, gs, moms, lrs, wds, momentum, rescale, clip):
+            new_w, new_m = [], []
+            for i, (w, g) in enumerate(zip(ws, gs)):
+                g32 = prep(g, w, rescale, clip, wds[i])
+                if momentum_on:
+                    m = moms[i].astype(jnp.float32) * momentum - lrs[i] * g32
+                    new_m.append(m.astype(moms[i].dtype))
+                    new_w.append((w.astype(jnp.float32) + m).astype(w.dtype))
+                else:
+                    new_w.append((w.astype(jnp.float32) - lrs[i] * g32)
+                                 .astype(w.dtype))
+            return new_w, new_m
+    elif kind == "adam":
+        def impl(ws, gs, means, variances, lrs, wds, beta1, beta2, eps,
+                 rescale, clip):
+            new_w, new_m, new_v = [], [], []
+            for i, (w, g) in enumerate(zip(ws, gs)):
+                g32 = prep(g, w, rescale, clip, wds[i])
+                m = beta1 * means[i] + (1.0 - beta1) * g32
+                v = beta2 * variances[i] + (1.0 - beta2) * g32 * g32
+                new_m.append(m)
+                new_v.append(v)
+                new_w.append((w.astype(jnp.float32) -
+                              lrs[i] * m / (jnp.sqrt(v) + eps))
+                             .astype(w.dtype))
+            return new_w, new_m, new_v
+    else:
+        raise KeyError(kind)
+
+    fn = _FUSED_CACHE[key] = _jax.jit(impl)
+    return fn
+
+
+class Optimizer:
+    """Base optimizer. reference: python/mxnet/optimizer/optimizer.py."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1., param_idx2name=None, wd=0.,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not \
+            None else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def register(klass):
+        """reference: Optimizer.register — lowercased class name."""
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            warnings.warn("WARNING: New optimizer %s.%s is overriding "
+                          "existing optimizer %s" %
+                          (klass.__module__, klass.__name__, name))
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        """reference: Optimizer.create_optimizer."""
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def create_state(self, index, weight):
+        """Create auxiliary state for `weight`."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for fp16 weights. reference:
+        create_state_multi_precision."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = weight.astype(_np.float32)
+            return (weight_master_copy, self.create_state(index,
+                                                          weight_master_copy))
+        if weight.dtype == _np.float16 and not self.multi_precision:
+            warnings.warn("Accumulating with float16 in optimizer can lead "
+                          "to poor accuracy or slow convergence. Consider "
+                          "using multi_precision=True option of the optimizer")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """reference: update_multi_precision."""
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy, original_state = state
+            grad32 = grad.astype(_np.float32)
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight._write(weight_master_copy._read().astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        """reference: Optimizer.set_lr_mult (reads __lr_mult__ sym attrs)."""
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """reference: Optimizer.set_wd_mult — biases/gammas/betas default to
+        wd_mult 0."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        """reference: Optimizer._get_lrs — scheduler + per-param lr_mult."""
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        del ret["_all_index_update_counts"]
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+        self._all_index_update_counts = {0: self._index_update_count}
+
+
+register = Optimizer.register  # convenience, reference exports it
+
+
+def create(name, **kwargs):
+    """reference: mx.optimizer.create."""
+    if isinstance(name, Optimizer):
+        return name
+    return Optimizer.create_optimizer(name, **kwargs)
+
+
+def _clip(v):
+    return -1.0 if v is None else v
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + multi-precision. reference: optimizer.py (SGD).
+
+    state = momentum buffer (or None); update runs the sgd_update /
+    sgd_mom_update ops (reference: src/operator/optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state,
+                          multi_precision=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype == _np.float16
+        self._update_impl(index, weight, grad, state, multi_precision=use_mp)
+
+    def fused_update(self, indices, weights, grads, states):
+        """Aggregated multi-param step in one jitted call (reference:
+        multi_sgd_update / multi_sgd_mom_update)."""
+        for i in indices:
+            self._update_count(i)
+        lrs = [jnp.float32(self._get_lr(i)) for i in indices]
+        wds = [jnp.float32(self._get_wd(i)) for i in indices]
+        clip = self.clip_gradient
+        fn = _fused_fn("sgd", self.momentum != 0.0, clip is not None)
+        ws = [w._read() for w in weights]
+        gs = [g._read() for g in grads]
+        moms = [s._read() for s in states] if self.momentum else []
+        new_w, new_m = fn(ws, gs, moms, lrs, wds,
+                          jnp.float32(self.momentum),
+                          jnp.float32(self.rescale_grad),
+                          jnp.float32(clip if clip is not None else 0.0))
+        for w, nw in zip(weights, new_w):
+            w._write(nw)
+        for s, nm in zip(states, new_m):
+            s._write(nm)
+
+    def _update_impl(self, index, weight, grad, state, multi_precision=False):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip(self.clip_gradient),
+                      lazy_update=self.lazy_update)
+        if not multi_precision:
+            if state is not None:
+                _run_op("sgd_mom_update", weight, grad, state,
+                        momentum=self.momentum, **kwargs)
+            else:
+                _run_op("sgd_update", weight, grad, **kwargs)
+        else:
+            w32, mom = state
+            if mom is not None:
+                _run_op("mp_sgd_mom_update", weight, grad, mom, w32,
+                        momentum=self.momentum, **kwargs)
+            else:
+                _run_op("mp_sgd_update", weight, grad, w32, **kwargs)
+
+
+@register
+class Signum(Optimizer):
+    """reference: optimizer.py (Signum) — sign of momentum step."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            _run_op("signum_update", weight, grad, state,
+                    momentum=self.momentum, wd_lh=self.wd_lh, **kwargs)
+        else:
+            _run_op("signsgd_update", weight, grad, **kwargs)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class FTML(Optimizer):
+    """reference: optimizer.py (FTML)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        _run_op("ftml_update", weight, grad, d, v, z, lr=lr, wd=wd,
+                beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                rescale_grad=self.rescale_grad,
+                clip_grad=_clip(self.clip_gradient), t=t)
+
+
+@register
+class LARS(Optimizer):
+    """LARS: layer-wise rate scaling on top of SGD-momentum.
+    reference: optimizer.py (LARS)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, eta=0.001, eps=1e-8,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+        self.eta = eta
+        self.eps = eps
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def _l2norm(self, v):
+        return float(v.norm().asscalar())
+
+    def _get_lars(self, i, weight, g, lr, wd):
+        name = self.idx2name.get(i, str(i))
+        if name.endswith("gamma") or name.endswith("beta") or \
+                name.endswith("bias"):
+            return lr
+        w_norm = self._l2norm(weight)
+        g_norm = self._l2norm(g)
+        if w_norm > 0.0 and g_norm > 0.0:
+            lars = self.eta * w_norm / (g_norm + wd * w_norm + self.eps)
+        else:
+            lars = 1.0
+        return lars * lr
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        lr = self._get_lars(index, weight, grad, lr, wd)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            _run_op("sgd_mom_update", weight, grad, state,
+                    momentum=self.momentum, **kwargs)
+        else:
+            _run_op("sgd_update", weight, grad, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD. reference: optimizer.py (DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._read() * self.rescale_grad
+        if self.clip_gradient is not None:
+            import jax.numpy as jnp
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        w = weight._read()
+        pw = previous_weight._read()
+        step = -lr * (g + wd * w + self.lamda * g * g * (w - pw))
+        if mom is not None:
+            m = self.momentum * mom._read() + step
+            mom._write(m)
+            step = m
+        previous_weight._write(w)
+        weight._write(w + step)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD. reference: optimizer.py (NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi_precision=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype == _np.float16
+        self._update_impl(index, weight, grad, state, multi_precision=use_mp)
+
+    def _update_impl(self, index, weight, grad, state, multi_precision=False):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip(self.clip_gradient))
+        if not multi_precision:
+            if state is not None:
+                _run_op("nag_mom_update", weight, grad, state,
+                        momentum=self.momentum, **kwargs)
+            else:
+                _run_op("sgd_update", weight, grad, **kwargs)
+        else:
+            w32, mom = state
+            if mom is not None:
+                _run_op("mp_nag_mom_update", weight, grad, mom, w32,
+                        momentum=self.momentum, **kwargs)
+            else:
+                _run_op("mp_sgd_update", weight, grad, w32, **kwargs)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics. reference: optimizer.py (SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        import jax.numpy as jnp
+        g = grad._read() * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        from .. import random as _random
+        import jax
+        noise = jax.random.normal(_random.take_key(weight.context),
+                                  weight.shape, dtype=weight._read().dtype) \
+            * math.sqrt(lr)
+        w = weight._read()
+        weight._write(w - lr / 2 * (g + wd * w) + noise)
+
+
+@register
+class Adam(Optimizer):
+    """reference: optimizer.py (Adam) — bias correction folded into lr."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        _run_op("adam_update", weight, grad, mean, var, lr=lr, wd=wd,
+                beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=_clip(self.clip_gradient),
+                lazy_update=self.lazy_update)
+
+    def fused_update(self, indices, weights, grads, states):
+        """Aggregated adam step, bias correction folded into per-param lr
+        (same trick as the reference's multi-tensor adam)."""
+        lrs, wds = [], []
+        for i in indices:
+            self._update_count(i)
+            t = self._index_update_count[i]
+            lr = self._get_lr(i) * math.sqrt(1. - self.beta2 ** t) / \
+                (1. - self.beta1 ** t)
+            lrs.append(jnp.float32(lr))
+            wds.append(jnp.float32(self._get_wd(i)))
+        clip = self.clip_gradient
+        fn = _fused_fn("adam", True, clip is not None)
+        ws = [w._read() for w in weights]
+        gs = [g._read() for g in grads]
+        means = [s[0]._read() for s in states]
+        variances = [s[1]._read() for s in states]
+        new_w, new_m, new_v = fn(
+            ws, gs, means, variances, lrs, wds, jnp.float32(self.beta1),
+            jnp.float32(self.beta2), jnp.float32(self.epsilon),
+            jnp.float32(self.rescale_grad),
+            jnp.float32(clip if clip is not None else 0.0))
+        for w, nw in zip(weights, new_w):
+            w._write(nw)
+        # keep state dtype as created (eager _run_op casts the same way)
+        for s, nm, nv, m0, v0 in zip(states, new_m, new_v, means, variances):
+            s[0]._write(nm.astype(m0.dtype))
+            s[1]._write(nv.astype(v0.dtype))
+
+
+@register
+class AdaGrad(Optimizer):
+    """reference: optimizer.py (AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        _run_op("adagrad_update", weight, grad, state, lr=lr, wd=wd,
+                epsilon=self.float_stable_eps,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class AdaDelta(Optimizer):
+    """reference: optimizer.py (AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        _run_op("adadelta_update", weight, grad, acc_g, acc_delta,
+                rho=self.rho, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class Adamax(Optimizer):
+    """reference: optimizer.py (Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        mean, u = state
+        _run_op("adamax_update", weight, grad, mean, u, lr=lr, wd=wd,
+                beta1=self.beta1, beta2=self.beta2,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class Nadam(Optimizer):
+    """reference: optimizer.py (Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
+        _run_op("nadam_update", weight, grad, mean, var, lr=lr, wd=wd,
+                beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                schedule_decay=self.schedule_decay,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=_clip(self.clip_gradient), t=t,
+                m_schedule=self.m_schedule)
+        self.m_schedule = self.m_schedule * momentum_t
+
+
+@register
+class RMSProp(Optimizer):
+    """reference: optimizer.py (RMSProp) — centered=True uses Graves 2013."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, gamma1=self.gamma1, epsilon=self.epsilon,
+                      rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip(self.clip_gradient),
+                      clip_weights=_clip(self.clip_weights))
+        if not self.centered:
+            _run_op("rmsprop_update", weight, grad, state, **kwargs)
+        else:
+            n, g, delta = state
+            _run_op("rmspropalex_update", weight, grad, n, g, delta,
+                    gamma2=self.gamma2, **kwargs)
+
+
+@register
+class Ftrl(Optimizer):
+    """reference: optimizer.py (Ftrl)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        _run_op("ftrl_update", weight, grad, z, n, lr=lr, wd=wd,
+                lamda1=self.lamda1, beta=self.beta,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class LAMB(Optimizer):
+    """reference: optimizer.py (LAMB) — layer-wise adaptive large-batch."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        op1 = _reg.get("lamb_update_phase1")
+        g_raw, mean_new, var_new = op1.fn(
+            weight._read(), grad._read(), mean._read(), var._read(),
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, t=t,
+            bias_correction=self.bias_correction, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=_clip(self.clip_gradient))
+        mean._write(mean_new)
+        var._write(var_new)
+        import jax.numpy as jnp
+        r1 = jnp.linalg.norm(weight._read())
+        r2 = jnp.linalg.norm(g_raw)
+        op2 = _reg.get("lamb_update_phase2")
+        weight._write(op2.fn(weight._read(), g_raw, r1, r2, lr=lr,
+                             lower_bound=_clip(self.lower_bound),
+                             upper_bound=_clip(self.upper_bound)))
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay Adam. reference:
+    python/mxnet/contrib/optimizer (adamw) / src/operator/contrib/adamw.cc."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.eta = eta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        _run_op("adamw_update", weight, grad, mean, var, lr=lr, wd=wd,
+                beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                eta=self.eta, rescale_grad=self.rescale_grad,
+                clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS-style scaling + warmup.
+    reference: optimizer.py (LBSGD). Implemented on the sgd-mom kernels with
+    the reference's lars scaling formula."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def _get_lbmult(self, nup):
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        strategy = self.warmup_strategy
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            mult = maxmult
+        elif nwup <= 1:
+            mult = 1.0
+        else:
+            if strategy == "linear":
+                mult = 1.0 + (maxmult - 1) * nup / nwup
+            elif strategy == "power2":
+                mult = 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+            elif strategy == "sqrt":
+                mult = 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+            else:
+                mult = 1.0
+        return mult
+
+    def _get_lars(self, weight, g, wd):
+        """LARS layer rate for warmup_strategy='lars'
+        (reference: LBSGD._get_lars)."""
+        weight2 = float((weight * weight).sum().asscalar())
+        grad2 = float((g * g).sum().asscalar())
+        lars = math.sqrt(weight2 / (grad2 + wd * weight2 + 1e-18))
+        return min(max(lars, 0.01), 100.0)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if self.warmup_strategy == "lars":
+            self.lbmult = self._get_lars(weight, grad, wd)
+        else:
+            num_update = self.num_update + self.init_updates
+            self.lbmult = self._get_lbmult(num_update)
+        lr = lr * self.lbmult
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            _run_op("sgd_mom_update", weight, grad, state,
+                    momentum=self.momentum, **kwargs)
+        else:
+            _run_op("sgd_update", weight, grad, **kwargs)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by reference unit tests."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._write(weight._read() - grad._read() * self.rescale_grad)
+
+
+class Updater:
+    """The function-object applied per (key, grad, weight) — serializable so
+    it can be shipped to parameter-server processes.
+    reference: optimizer.py (Updater, get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        indices = index if isinstance(index, (list, tuple)) else [index]
+        grads = grad if isinstance(grad, (list, tuple)) else [grad]
+        weights = weight if isinstance(weight, (list, tuple)) else [weight]
+        for idx, w in zip(indices, weights):
+            if idx not in self.states:
+                self.states[idx] = \
+                    self.optimizer.create_state_multi_precision(idx, w)
+                self.states_synced[idx] = True
+            elif not self.states_synced[idx]:
+                self.states[idx] = self.sync_state_context(self.states[idx],
+                                                           w.context)
+                self.states_synced[idx] = True
+        if len(indices) > 1 and self.aggregate_updates and \
+                self._can_fuse(weights, grads):
+            self.optimizer.fused_update(
+                indices, weights, grads,
+                [self.states[i] for i in indices])
+            return
+        for idx, g, w in zip(indices, grads, weights):
+            self.optimizer.update_multi_precision(idx, w, g, self.states[idx])
+
+    def _can_fuse(self, weights, grads):
+        """Aggregated update only for exactly SGD/Adam (subclasses override
+        update semantics), dense grads, non-fp16 weights (fp16 goes the
+        multi-precision path). Gated by optimizer.aggregate_num (reference:
+        MXNET_OPTIMIZER_AGGREGATION_SIZE)."""
+        from ..ndarray.sparse import BaseSparseNDArray
+        if type(self.optimizer) not in (SGD, Adam):
+            return False
+        if any(isinstance(g, BaseSparseNDArray) for g in grads):
+            return False
+        return all(w.dtype != _np.float16 for w in weights)
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            synced = [self.sync_state_context(i, context) for i in state]
+            return tuple(synced) if isinstance(state, tuple) else synced
+        return state
+
+    def set_states(self, states):
+        """Deserialize states (reference: Updater.set_states)."""
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        """Serialize states (+ optionally the optimizer itself)."""
+        return pickle.dumps((self.states, self.optimizer) if dump_optimizer
+                            else self.states)
+
+
+def get_updater(optimizer):
+    """reference: optimizer.py (get_updater)."""
+    return Updater(optimizer)
+
+
+# NDArray needs pickling support for Updater serialization
+def _ndarray_reduce(arr):
+    return (_ndarray_rebuild, (arr.asnumpy(), str(arr.context.device_type),
+                               arr.context.device_id))
+
+
+def _ndarray_rebuild(data, dev_type, dev_id):
+    from ..context import Context
+    return nd.array(data, ctx=Context(dev_type, dev_id), dtype=data.dtype)
+
+
+import copyreg  # noqa: E402
+copyreg.pickle(NDArray, _ndarray_reduce)

@@ -1,0 +1,96 @@
+"""Sharded (pod-scale) checkpointing for functional param trees.
+
+The reference checkpoints are single-host files (.params dmlc framing,
+SURVEY.md §5.4 — implemented in io/params_serde.py for compatibility).
+Those cannot hold a Llama-8B sharded across a v5e-64 mesh: each host must
+write only its addressable shards and restore must re-lay arrays onto the
+mesh. This module provides that native format over orbax (OCDBT), the
+jax-ecosystem standard:
+
+  save_sharded(path, tree, step)        — async-capable multi-host save
+  restore_sharded(path, mesh, rules)    — restore with target shardings
+  latest_step(path)
+
+Checkpoint/resume policy matches the reference (§5.3): periodic epoch/step
+saves + explicit resume; no elastic membership.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.sharding import NamedSharding
+
+from .sharding import ShardingRules
+
+__all__ = ["save_sharded", "restore_sharded", "latest_step",
+           "save_train_state", "restore_train_state"]
+
+
+def _mgr(path):
+    import orbax.checkpoint as ocp
+    return ocp.CheckpointManager(os.path.abspath(path))
+
+
+def save_sharded(path, tree, step=0, wait=True):
+    """Write one step of a (possibly sharded) pytree. Every process must
+    call this (multi-host collective); single-process works as-is."""
+    import orbax.checkpoint as ocp
+    mgr = _mgr(path)
+    mgr.save(int(step), args=ocp.args.StandardSave(tree))
+    if wait:
+        mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(path):
+    mgr = _mgr(path)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_sharded(path, step=None, mesh=None, rules=None, template=None):
+    """Restore a step. With mesh+rules (or an explicit template tree of
+    jax.ShapeDtypeStruct/arrays), arrays come back with the target
+    NamedShardings — each host reads only its shards."""
+    import orbax.checkpoint as ocp
+    mgr = _mgr(path)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            mgr.close()
+            raise FileNotFoundError("no checkpoint under %s" % path)
+    if template is None and mesh is not None:
+        meta = mgr.item_metadata(int(step))
+        tree_meta = getattr(meta, "item_metadata", meta)
+        rules = rules or ShardingRules([])
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree_meta)
+        outs = []
+        for keypath, leaf in flat:
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in keypath)
+            spec = rules.spec_for(name, tuple(leaf.shape), mesh)
+            outs.append(jax.ShapeDtypeStruct(
+                tuple(leaf.shape), leaf.dtype,
+                sharding=NamedSharding(mesh, spec)))
+        template = jax.tree_util.tree_unflatten(treedef, outs)
+    if template is not None:
+        restored = mgr.restore(
+            int(step), args=ocp.args.StandardRestore(template))
+    else:
+        restored = mgr.restore(int(step))
+    mgr.close()
+    return restored
+
+
+def save_train_state(path, params, opt_state, step):
+    """Params + optimizer state in one step dir (the Trainer.save_states
+    analog for the fused ShardedTrainStep path)."""
+    save_sharded(path, {"params": params, "opt_state": opt_state,
+                        "step": int(step)}, step=step)
+
+
+def restore_train_state(path, mesh=None, rules=None, step=None):
+    tree = restore_sharded(path, step=step, mesh=mesh, rules=rules)
+    return tree["params"], tree["opt_state"], tree["step"]

@@ -1,0 +1,94 @@
+"""Collective-communication wrappers + bandwidth benchmark.
+
+The reference's comm layer is three backends behind KVStore (SURVEY.md §5.8):
+CommDevice P2P reduce (src/kvstore/comm.h), NCCL ring allreduce
+(src/kvstore/kvstore_nccl.h), ps-lite ZMQ push/pull. On TPU there is one
+backend: XLA collectives over ICI/DCN. These wrappers are usable both inside
+shard_map'd code (they lower to `lax.psum` etc.) and eagerly on sharded
+arrays (they jit a tiny shard_map around the collective).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
+           "barrier", "allreduce_bench"]
+
+
+def all_reduce(x, axis_name):
+    """Sum over a mesh axis (inside shard_map/jit). reference semantics:
+    KVStore push+pull of a dense key == allreduce."""
+    return lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    """Neighbor exchange — the ring primitive under ring attention and
+    pipeline micro-batch handoff."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def barrier(mesh=None):
+    """Device-sync barrier: a trivial psum everyone must join. Analog of the
+    reference's engine WaitForAll + ps-lite Barrier (ps::Postoffice)."""
+    if mesh is None:
+        from .mesh import current_mesh, local_mesh
+        mesh = current_mesh() or local_mesh()
+    axis = mesh.axis_names[0]
+    ones = jnp.ones((mesh.devices.size,), jnp.int32)
+    f = jax.jit(shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
+                          in_specs=P(axis), out_specs=P()),
+                out_shardings=NamedSharding(mesh, P()))
+    f(ones).block_until_ready()
+
+
+def _eager_allreduce(arr, mesh, axis):
+    spec = P(axis)
+    f = shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
+                  in_specs=spec, out_specs=P())
+    return jax.jit(f)(arr)
+
+
+def allreduce_bench(size_mb=64, iters=20, mesh=None, dtype=jnp.float32):
+    """Measure allreduce algorithmic bandwidth (GB/s) over the mesh's first
+    axis — the KVStore-allreduce metric from BASELINE.json. Returns
+    (gbps, seconds_per_op)."""
+    if mesh is None:
+        from .mesh import current_mesh, local_mesh
+        mesh = current_mesh() or local_mesh()
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    itemsize = jnp.dtype(dtype).itemsize
+    per_dev = max(1, int(size_mb * 1e6 / itemsize / n))
+    x = jnp.ones((n * per_dev,), dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    f = jax.jit(shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
+                          in_specs=P(axis), out_specs=P(axis)))
+    f(x).block_until_ready()  # warm compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    # ring allreduce moves 2*(n-1)/n of the buffer per device
+    nbytes = x.size * itemsize
+    algo_bytes = 2 * (n - 1) / n * nbytes
+    return algo_bytes / dt / 1e9, dt

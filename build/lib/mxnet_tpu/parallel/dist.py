@@ -1,0 +1,85 @@
+"""Multi-controller runtime initialization.
+
+The reference's multi-node rendezvous is the ps-lite scheduler: tools/launch.py
+exports DMLC_ROLE / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT / DMLC_NUM_WORKER and
+every process dials the scheduler over ZMQ (3rdparty/ps-lite/src/van.cc,
+ps::Postoffice::Start). The TPU-native equivalent is JAX's multi-controller
+runtime: every host runs the same SPMD program and
+`jax.distributed.initialize(coordinator, num_processes, process_id)` replaces
+the scheduler. This module maps the reference's env protocol onto it, so
+`tools/launch.py`-style launchers keep working.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["initialize", "is_initialized", "rank", "num_workers",
+           "env_spec_from_dmlc"]
+
+_STATE = {"initialized": False, "rank": 0, "num": 1}
+
+
+def env_spec_from_dmlc(env=None):
+    """Translate the reference's DMLC_* rendezvous env vars to jax.distributed
+    kwargs. DMLC_PS_ROOT_URI:PORT → coordinator_address; DMLC_NUM_WORKER →
+    num_processes; DMLC_WORKER_ID (our launcher sets it) → process_id.
+    Server/scheduler roles don't exist under SPMD — every process is a worker.
+    """
+    env = env or os.environ
+    uri = env.get("DMLC_PS_ROOT_URI")
+    if not uri:
+        return None
+    port = env.get("DMLC_PS_ROOT_PORT", "9091")
+    spec = {
+        "coordinator_address": "%s:%s" % (uri, port),
+        "num_processes": int(env.get("DMLC_NUM_WORKER", "1")),
+        "process_id": int(env.get("DMLC_WORKER_ID", env.get("DMLC_RANK", "0"))),
+    }
+    return spec
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               local_device_ids=None):
+    """Start (or no-op re-enter) the multi-controller runtime.
+
+    With no args, tries (a) JAX's own cluster auto-detect, then (b) the
+    DMLC_* env protocol, then (c) single-process mode.
+    """
+    if _STATE["initialized"]:
+        return
+    if coordinator_address is None and num_processes is None:
+        spec = env_spec_from_dmlc()
+        if spec is not None:
+            coordinator_address = spec["coordinator_address"]
+            num_processes = spec["num_processes"]
+            process_id = spec["process_id"]
+    if coordinator_address is None and num_processes in (None, 1):
+        # single-process: nothing to rendezvous
+        _STATE.update(initialized=True, rank=0, num=1)
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+    _STATE.update(initialized=True, rank=jax.process_index(),
+                  num=jax.process_count())
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+def rank():
+    """This worker's rank (reference: KVStore.rank)."""
+    if _STATE["initialized"]:
+        return _STATE["rank"]
+    return jax.process_index()
+
+
+def num_workers():
+    """World size (reference: KVStore.num_workers)."""
+    if _STATE["initialized"]:
+        return _STATE["num"]
+    return jax.process_count()

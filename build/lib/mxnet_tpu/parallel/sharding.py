@@ -1,0 +1,149 @@
+"""Parameter/activation sharding rules.
+
+The reference's only model-parallel primitive is manual per-layer device
+placement (`group2ctx`, src/executor/graph_executor.cc; symbol attr
+`__ctx_group__`). Here placement is declarative: a `ShardingRules` maps
+parameter names (regex) to `PartitionSpec`s; GSPMD inserts the collectives.
+This one mechanism subsumes group2ctx (manual MP), Megatron TP (column/row
+splits), and FSDP/ZeRO (shard params over 'fsdp', all-gather on use).
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ShardingRules", "LLAMA_RULES", "BERT_RULES", "named_sharding",
+           "shard_pytree", "replicate_pytree", "logical_to_spec"]
+
+P = PartitionSpec
+
+
+def _valid_axes(mesh):
+    return set(mesh.axis_names)
+
+
+def _prune_spec(spec, mesh, shape=None):
+    """Drop mesh axes the mesh doesn't have (or that don't divide the dim) so
+    one rule set works on any mesh shape — e.g. TP rules on a pure-DP mesh
+    degrade to replication, exactly like running the reference on 1 GPU."""
+    axes = _valid_axes(mesh)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for d, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = [n for n in names if n in axes and sizes.get(n, 1) > 1]
+        if shape is not None and kept:
+            total = 1
+            for n in kept:
+                total *= sizes[n]
+            if d < len(shape) and shape[d] % total != 0:
+                kept = []
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(tuple(kept))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) table; first match wins. Unmatched
+    names are replicated. `spec_for(name, shape)` trims the spec to the
+    array's rank and prunes axes absent from the mesh."""
+
+    def __init__(self, rules, default=P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, name, shape=None, mesh=None):
+        spec = self.default
+        for pat, s in self.rules:
+            if pat.search(name):
+                spec = s
+                break
+        if shape is not None:
+            spec = P(*tuple(spec)[:len(shape)])
+        if mesh is not None:
+            spec = _prune_spec(spec, mesh, shape)
+        return spec
+
+    def tree_specs(self, params, mesh=None):
+        """Specs for a dict/pytree of params keyed by path-joined names."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        specs = []
+        for path, leaf in flat:
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+            specs.append(self.spec_for(name, getattr(leaf, "shape", None),
+                                       mesh))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+# Megatron-style rules for a Llama/GPT decoder. Naming convention matches
+# mxnet_tpu.models.llama param tree: layers/N/{attn,mlp,...}/w.
+# Column-parallel (output dim sharded over 'model'): q/k/v, gate/up.
+# Row-parallel (input dim sharded): o_proj, down. Embeddings: vocab over
+# 'model'. Everything also shards dim0 over 'fsdp' where divisible (ZeRO-3).
+LLAMA_RULES = ShardingRules([
+    (r"embed|tok_embeddings|lm_head", P(("model",), ("fsdp",))),
+    (r"attn/(wq|wk|wv)|q_proj|k_proj|v_proj", P(("fsdp",), ("model",))),
+    (r"attn/wo|o_proj", P(("model",), ("fsdp",))),
+    (r"mlp/(w1|w3)|gate_proj|up_proj", P(("fsdp",), ("model",))),
+    (r"mlp/w2|down_proj", P(("model",), ("fsdp",))),
+    (r"norm|scale|bias", P()),
+])
+
+# BERT encoder: same column/row pattern on attention + FFN.
+BERT_RULES = ShardingRules([
+    (r"word_embed|position_embed|token_type_embed", P(("model",), ("fsdp",))),
+    (r"attn/(wq|wk|wv)|query|key|value", P(("fsdp",), ("model",))),
+    (r"attn/wo|attention/output", P(("model",), ("fsdp",))),
+    (r"ffn/w1|intermediate", P(("fsdp",), ("model",))),
+    (r"ffn/w2|output/dense", P(("model",), ("fsdp",))),
+    (r"norm|beta|gamma|bias", P()),
+])
+
+
+def named_sharding(mesh, *spec):
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_pytree(params, rules, mesh):
+    """device_put a pytree of jax arrays according to rules — the analog of
+    the reference's per-device param replicas (Parameter.list_data) but
+    sharded instead of copied."""
+    specs = rules.tree_specs(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def replicate_pytree(params, mesh):
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), params)
+
+
+# flax-style logical axis mapping: model code annotates with logical names,
+# one table maps them to mesh axes.
+_DEFAULT_LOGICAL = {
+    "batch": ("data", "fsdp"),
+    "seq": "seq",
+    "heads": "model",
+    "kv_heads": "model",
+    "embed": None,
+    "mlp": "model",
+    "vocab": "model",
+    "head_dim": None,
+}
+
+
+def logical_to_spec(logical_axes, table=None):
+    table = table or _DEFAULT_LOGICAL
+    return P(*[table.get(a, None) for a in logical_axes])

@@ -1,0 +1,210 @@
+"""Whole-train-step compilation under shardings.
+
+The reference splits a training step across subsystems: GraphExecutor forward
+/backward, KVStore push/pull for gradient aggregation, and per-param
+optimizer ops (src/operator/optimizer_op.cc), relying on engine dependencies
+to overlap comm with backward (SURVEY.md §3.4). The TPU-native design fuses
+the whole step — forward, backward, gradient allreduce, optimizer update —
+into ONE jitted SPMD program; XLA then schedules the gradient collectives to
+overlap with the remaining backward, reproducing the reference's
+push-overlaps-backward property without an engine.
+
+Functional optimizers here mirror mxnet_tpu.optimizer registry semantics
+(sgd/momentum, adam, adamw, lamb) but operate on pytrees so optimizer state
+shards with the parameters (ZeRO: state inherits the param's sharding — the
+'server-side optimizer' of the PS path, §5.8).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .sharding import ShardingRules, shard_pytree
+
+__all__ = ["ShardedTrainStep", "sgd_init", "adam_init"]
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+# ---------------------------------------------------------------- optimizers
+def sgd_init(params, momentum=0.0):
+    if momentum:
+        return {"mom": _tmap(jnp.zeros_like, params)}
+    return {}
+
+
+def _sgd_update(params, grads, state, lr, momentum=0.0, wd=0.0):
+    if wd:
+        grads = _tmap(lambda g, p: g + wd * p, grads, params)
+    if momentum:
+        mom = _tmap(lambda m, g: momentum * m + g, state["mom"], grads)
+        new_p = _tmap(lambda p, m: p - lr * m, params, mom)
+        return new_p, {"mom": mom}
+    return _tmap(lambda p, g: p - lr * g, params, grads), state
+
+
+def adam_init(params):
+    return {"m": _tmap(jnp.zeros_like, params),
+            "v": _tmap(jnp.zeros_like, params),
+            "t": jnp.zeros((), jnp.int32)}
+
+
+def _adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
+                 eps=1e-8, wd=0.0, adamw=False):
+    t = state["t"] + 1
+    if wd and not adamw:
+        grads = _tmap(lambda g, p: g + wd * p, grads, params)
+    m = _tmap(lambda m_, g: beta1 * m_ + (1 - beta1) * g, state["m"], grads)
+    v = _tmap(lambda v_, g: beta2 * v_ + (1 - beta2) * g * g,
+              state["v"], grads)
+    tf = t.astype(jnp.float32)
+    bc1 = 1 - beta1 ** tf
+    bc2 = 1 - beta2 ** tf
+
+    def upd(p, m_, v_):
+        step = lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps)
+        if adamw and wd:
+            step = step + lr * wd * p
+        return p - step
+
+    new_p = _tmap(upd, params, m, v)
+    return new_p, {"m": m, "v": v, "t": t}
+
+
+_OPTS = {
+    "sgd": (lambda p, **kw: sgd_init(p, kw.get("momentum", 0.0)), _sgd_update),
+    "adam": (lambda p, **kw: adam_init(p), _adam_update),
+    "adamw": (lambda p, **kw: adam_init(p),
+              functools.partial(_adam_update, adamw=True)),
+}
+
+
+class ShardedTrainStep:
+    """Compile loss_fn + optimizer into one sharded SPMD step.
+
+    loss_fn(params, batch) -> scalar loss (batch is a pytree whose leading
+    dim is the global batch; it will be sharded over the 'data'+'fsdp' axes).
+
+    Usage::
+
+        step = ShardedTrainStep(loss_fn, params, mesh, rules=LLAMA_RULES,
+                                optimizer="adamw", lr=1e-3)
+        params, opt_state = step.init()      # shards params onto the mesh
+        for batch in data:
+            params, opt_state, loss = step(params, opt_state, batch)
+    """
+
+    def __init__(self, loss_fn, params, mesh, rules=None, optimizer="adamw",
+                 lr=1e-3, batch_spec=None, grad_accum=1, donate=True,
+                 remat=False, **opt_kwargs):
+        self.loss_fn = loss_fn
+        self._init_params = params
+        self.mesh = mesh
+        self.rules = rules or ShardingRules([])
+        if isinstance(optimizer, str):
+            self._opt_init, self._opt_update = _OPTS[optimizer]
+        else:
+            self._opt_init, self._opt_update = optimizer
+        self.lr = lr
+        self.opt_kwargs = opt_kwargs
+        self.grad_accum = grad_accum
+        data_axes = tuple(a for a in ("data", "fsdp")
+                          if a in mesh.axis_names and
+                          dict(zip(mesh.axis_names,
+                                   mesh.devices.shape)).get(a, 1) > 1)
+        self.batch_spec = batch_spec if batch_spec is not None else \
+            P(data_axes if data_axes else None)
+        self.donate = donate
+        self._remat = remat
+        self._compiled = None
+        self._param_specs = None
+
+    # ------------------------------------------------------------------
+    def init(self):
+        """Shard initial params onto the mesh and build optimizer state with
+        matching sharding (ZeRO: state lives where its param lives)."""
+        params = shard_pytree(self._init_params, self.rules, self.mesh)
+        self._param_specs = self.rules.tree_specs(params, self.mesh)
+        opt_state = self._opt_init(self._init_params, **self.opt_kwargs)
+        opt_specs = self._state_specs(opt_state)
+        opt_state = _tmap(
+            lambda x, s: jax.device_put(
+                x, NamedSharding(self.mesh, s)), opt_state, opt_specs)
+        return params, opt_state
+
+    def _state_specs(self, opt_state):
+        """Optimizer-state specs: per-param slots inherit the param's spec;
+        scalars replicate."""
+        out = {}
+        for key, val in opt_state.items():
+            if isinstance(val, jnp.ndarray) and val.ndim == 0:
+                out[key] = P()
+            else:
+                out[key] = self.rules.tree_specs(val, self.mesh)
+        return out
+
+    # ------------------------------------------------------------------
+    def _build(self, params, opt_state):
+        mesh = self.mesh
+        p_specs = self._param_specs or self.rules.tree_specs(params, mesh)
+        o_specs = self._state_specs(opt_state)
+        loss_fn = self.loss_fn
+        if self._remat:
+            loss_fn = jax.checkpoint(loss_fn)
+        lr = self.lr
+        opt_update = self._opt_update
+        opt_kwargs = self.opt_kwargs
+        accum = self.grad_accum
+
+        def step_fn(params, opt_state, batch, step_num):
+            if accum > 1:
+                def micro(carry, mb):
+                    l, g = jax.value_and_grad(loss_fn)(params, mb)
+                    return (carry[0] + l, _tmap(jnp.add, carry[1], g)), None
+                zero = _tmap(jnp.zeros_like, params)
+                mbatch = _tmap(
+                    lambda x: x.reshape((accum, x.shape[0] // accum) +
+                                        x.shape[1:]), batch)
+                (loss, grads), _ = jax.lax.scan(
+                    micro, (jnp.zeros(()), zero), mbatch)
+                loss = loss / accum
+                grads = _tmap(lambda g: g / accum, grads)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            cur_lr = lr(step_num) if callable(lr) else lr
+            new_params, new_state = opt_update(
+                params, grads, opt_state, cur_lr, **opt_kwargs)
+            return new_params, new_state, loss
+
+        in_shardings = (
+            _tmap(lambda s: NamedSharding(mesh, s), p_specs),
+            {k: (_tmap(lambda s: NamedSharding(mesh, s), v)
+                 if not isinstance(v, P) else NamedSharding(mesh, v))
+             for k, v in o_specs.items()},
+            _tmap(lambda _: NamedSharding(mesh, self.batch_spec), self._batch_proto),
+            NamedSharding(mesh, P()),
+        )
+        out_shardings = (in_shardings[0], in_shardings[1],
+                         NamedSharding(mesh, P()))
+        return jax.jit(step_fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1) if self.donate else ())
+
+    def __call__(self, params, opt_state, batch, step_num=0):
+        if self._compiled is None:
+            self._batch_proto = batch
+            self._compiled = self._build(params, opt_state)
+        return self._compiled(params, opt_state, batch,
+                              jnp.asarray(step_num, jnp.int32))
+
+    def lower_text(self, params, opt_state, batch):
+        """StableHLO text of the compiled step (for inspection/tests)."""
+        self._batch_proto = batch
+        fn = self._build(params, opt_state)
+        return fn.lower(params, opt_state, batch,
+                        jnp.zeros((), jnp.int32)).as_text()

@@ -1,0 +1,150 @@
+"""Profiler. reference: python/mxnet/profiler.py over src/profiler/ —
+per-op aggregate stats + trace dump, `set_config`/`set_state`/`dumps`.
+
+TPU-native design: two layers.
+  * Op-level aggregate table (the `profiler.dumps()` experience): the
+    imperative `invoke` and `CachedOp` wrap each call in a scope recording
+    host-side dispatch time and call counts. Dispatch is async (XLA owns
+    the device timeline), so these numbers mean "host time"; device-side
+    truth comes from the second layer.
+  * Device traces: `set_state('run')` with `profile_all` starts
+    `jax.profiler.start_trace` → TensorBoard XPlane dump (the
+    chrome://tracing analog of src/profiler/profiler.cc DumpProfile).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "state", "dumps", "dump", "reset",
+           "Scope", "scope", "pause", "resume"]
+
+_lock = threading.Lock()
+_config = {"profile_all": False, "profile_symbolic": True,
+           "profile_imperative": True, "profile_memory": False,
+           "profile_api": True, "filename": "profile.json",
+           "aggregate_stats": True}
+_state = "stop"
+_trace_active = False
+_agg = {}   # op name -> [count, total_s, min_s, max_s]
+
+
+def set_config(**kwargs):
+    """reference: profiler.py (set_config)."""
+    unknown = set(kwargs) - set(_config) - {"profile_process"}
+    if unknown:
+        raise ValueError("unknown profiler config keys: %s" % unknown)
+    _config.update({k: v for k, v in kwargs.items() if k in _config})
+
+
+def state():
+    return _state
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    """reference: profiler.py (set_state) — 'run' | 'stop'."""
+    global _state, _trace_active
+    if state_name not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    prev = _state
+    _state = state_name
+    from .ndarray import ndarray as _nd_mod
+    _nd_mod._PROFILE_IMPERATIVE = (state_name == "run"
+                                   and _config["profile_imperative"])
+    if state_name == "run" and prev != "run":
+        if _config["profile_all"]:
+            try:
+                import jax
+                jax.profiler.start_trace("/tmp/mxnet_tpu_trace")
+                _trace_active = True
+            except Exception:
+                _trace_active = False
+    elif state_name == "stop" and prev == "run":
+        if _trace_active:
+            import jax
+            jax.profiler.stop_trace()
+            _trace_active = False
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def is_running():
+    return _state == "run"
+
+
+def record_op(name, seconds):
+    """Called by the imperative invoke / CachedOp hooks."""
+    with _lock:
+        ent = _agg.get(name)
+        if ent is None:
+            _agg[name] = [1, seconds, seconds, seconds]
+        else:
+            ent[0] += 1
+            ent[1] += seconds
+            ent[2] = min(ent[2], seconds)
+            ent[3] = max(ent[3], seconds)
+
+
+def reset():
+    with _lock:
+        _agg.clear()
+
+
+def dumps(reset_stats=False, format="table"):
+    """Aggregate per-op stats table. reference: profiler.py (dumps) over
+    src/profiler/aggregate_stats.cc."""
+    with _lock:
+        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+        if format == "json":
+            out = json.dumps({k: {"count": v[0], "total_ms": v[1] * 1e3,
+                                  "min_ms": v[2] * 1e3, "max_ms": v[3] * 1e3,
+                                  "avg_ms": v[1] / v[0] * 1e3}
+                              for k, v in rows})
+        else:
+            lines = ["%-40s %10s %12s %12s %12s %12s" %
+                     ("Name", "Calls", "Total(ms)", "Avg(ms)", "Min(ms)",
+                      "Max(ms)")]
+            for k, v in rows:
+                lines.append("%-40s %10d %12.3f %12.3f %12.3f %12.3f" %
+                             (k, v[0], v[1] * 1e3, v[1] / v[0] * 1e3,
+                              v[2] * 1e3, v[3] * 1e3))
+            out = "\n".join(lines)
+        if reset_stats:
+            _agg.clear()
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the aggregate table to the configured filename."""
+    with open(_config["filename"], "w") as f:
+        f.write(dumps(format="json"))
+
+
+class Scope:
+    """Named profiling range usable from user code. reference: profiler.py
+    (Scope) / MXProfileCreateTask."""
+
+    def __init__(self, name="<unk>", append_mode=True):
+        # append_mode accepted for reference API parity; ranges always
+        # aggregate into the op table here
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            record_op("scope:" + self.name, time.perf_counter() - self._t0)
+        return False
+
+
+scope = Scope

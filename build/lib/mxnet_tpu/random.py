@@ -1,0 +1,117 @@
+"""Stateful RNG facade over JAX's functional threefry keys.
+
+TPU-native analog of the reference's per-device `RandGenerator<xpu>`
+(reference: src/common/random_generator.h, include/mxnet/random_generator.h,
+seeded via python/mxnet/random.py (seed)). The reference keeps mutable
+Philox/MT state per device; here a per-context key table holds a threefry key
+that is split on every draw, preserving `mx.random.seed(s[, ctx])` semantics
+while staying functional underneath (each op consumes a fresh subkey).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "take_key", "fold_in", "Generator"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _table():
+    if not hasattr(_state, "keys"):
+        _state.keys = {}
+    return _state.keys
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the RNG. reference: python/mxnet/random.py (seed) — seeds every
+    device generator, or one device when ctx is given."""
+    if ctx == "all":
+        _table().clear()
+        global _DEFAULT_SEED
+        _DEFAULT_SEED = int(seed_state)
+        _table()[None] = jax.random.key(int(seed_state))
+    else:
+        key = (ctx.device_type, ctx.device_id)
+        _table()[key] = jax.random.key(int(seed_state))
+
+
+def push_trace_key(key):
+    """Enter a functional-RNG scope: while active, `take_key` splits from
+    `key` (a traced jax key) instead of the global table. Used by CachedOp /
+    hybridize so random ops inside a jit trace consume a per-call key input
+    rather than baking a constant (reference analog: per-op kRandom resource
+    requests, src/resource.cc)."""
+    if not hasattr(_state, "trace_keys"):
+        _state.trace_keys = []
+    _state.trace_keys.append(key)
+
+
+def pop_trace_key():
+    return _state.trace_keys.pop()
+
+
+def take_key(ctx=None):
+    """Split the current key and return a fresh subkey (advances state)."""
+    if getattr(_state, "trace_keys", None):
+        k0, k1 = jax.random.split(_state.trace_keys[-1])
+        _state.trace_keys[-1] = k0
+        return k1
+    tbl = _table()
+    key = None if ctx is None else (ctx.device_type, ctx.device_id)
+    if key not in tbl:
+        if key is not None and None in tbl:
+            # derive device stream from the global seed, like the reference's
+            # per-device generators seeded from one seed + device id.
+            # NB: stable hash — python's hash() is salted per process and
+            # would break cross-process seed determinism
+            import zlib
+            stable = zlib.crc32(key[0].encode()) ^ (key[1] & 0xFFFF)
+            tbl[key] = jax.random.fold_in(tbl[None], stable & 0x7FFFFFFF)
+        else:
+            tbl[key] = jax.random.key(_DEFAULT_SEED)
+    k0, k1 = jax.random.split(tbl[key])
+    tbl[key] = k0
+    return k1
+
+
+def fold_in(data):
+    """Deterministically derive a key from current state + integer data."""
+    return jax.random.fold_in(take_key(), int(data))
+
+
+def _nd_random(op):
+    def fn(*args, **kwargs):
+        from . import ndarray as _nd
+        return _nd.invoke(op, *args, **kwargs)
+    fn.__name__ = op.lstrip("_")
+    return fn
+
+
+# sampling entry points (reference: python/mxnet/random.py delegates to
+# mx.nd.random.*)
+uniform = _nd_random("_random_uniform")
+normal = _nd_random("_random_normal")
+randn = _nd_random("_random_normal")
+randint = _nd_random("_random_randint")
+gamma = _nd_random("_random_gamma")
+exponential = _nd_random("_random_exponential")
+poisson = _nd_random("_random_poisson")
+negative_binomial = _nd_random("_random_negative_binomial")
+generalized_negative_binomial = _nd_random(
+    "_random_generalized_negative_binomial")
+multinomial = _nd_random("_sample_multinomial")
+shuffle = _nd_random("_shuffle")
+
+
+class Generator:
+    """Explicit generator object for code that wants owned RNG state."""
+
+    def __init__(self, seed_state=0):
+        self._key = jax.random.key(int(seed_state))
+
+    def take_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub

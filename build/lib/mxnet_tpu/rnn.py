@@ -1,0 +1,419 @@
+"""`mx.rnn` — the legacy symbolic RNN cell API.
+
+reference: python/mxnet/rnn/ (rnn_cell.py: BaseRNNCell, RNNCell, LSTMCell,
+GRUCell, FusedRNNCell, SequentialRNNCell, BidirectionalCell, DropoutCell,
+ResidualCell; io.py: BucketSentenceIter). Cells compose `mx.sym` graphs for
+use with Module/BucketingModule; the Gluon cells (gluon.rnn) are the
+imperative twins. On TPU every unrolled graph compiles to one XLA program,
+so per-step symbol composition costs nothing at runtime.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import symbol as sym
+from .base import MXNetError
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "BucketSentenceIter"]
+
+
+class BaseRNNCell:
+    """reference: rnn_cell.py (BaseRNNCell)."""
+
+    def __init__(self, prefix="", params=None):
+        self._prefix = prefix
+        self._own_params = params is None
+        self._modified = False
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [s["shape"] for s in self.state_info]
+
+    def begin_state(self, func=None, init_sym=None, **kwargs):
+        """Symbols for the initial states."""
+        states = []
+        for i, info in enumerate(self.state_info):
+            self._init_counter += 1
+            name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
+            states.append(sym.Variable(name, **kwargs))
+        return states
+
+    def reset(self):
+        self._counter = -1
+        self._init_counter = -1
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        """Unroll into a symbol graph (reference: BaseRNNCell.unroll)."""
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [sym.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, sym.Symbol):
+            inputs = list(sym.split(inputs, num_outputs=length, axis=axis,
+                                    squeeze_axis=True))
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(inputs[i], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = sym.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def _get_param(self, name):
+        return sym.Variable(self._prefix + name)
+
+
+class RNNCell(BaseRNNCell):
+    """tanh/relu Elman cell. reference: rnn_cell.py (RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self._get_param("i2h_weight")
+        self._iB = self._get_param("i2h_bias")
+        self._hW = self._get_param("h2h_weight")
+        self._hB = self._get_param("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=self._num_hidden,
+                                 name=name + "i2h")
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=self._num_hidden,
+                                 name=name + "h2h")
+        out = sym.Activation(i2h + h2h, act_type=self._activation,
+                             name=name + "out")
+        return out, [out]
+
+
+class LSTMCell(BaseRNNCell):
+    """reference: rnn_cell.py (LSTMCell)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._forget_bias = forget_bias
+        self._iW = self._get_param("i2h_weight")
+        self._iB = self._get_param("i2h_bias")
+        self._hW = self._get_param("h2h_weight")
+        self._hB = self._get_param("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=4 * self._num_hidden,
+                                 name=name + "i2h")
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=4 * self._num_hidden,
+                                 name=name + "h2h")
+        gates = i2h + h2h
+        slices = list(sym.split(gates, num_outputs=4, axis=1))
+        in_gate = sym.Activation(slices[0], act_type="sigmoid")
+        forget_gate = sym.Activation(slices[1] + self._forget_bias,
+                                     act_type="sigmoid")
+        in_trans = sym.Activation(slices[2], act_type="tanh")
+        out_gate = sym.Activation(slices[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """reference: rnn_cell.py (GRUCell)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._iW = self._get_param("i2h_weight")
+        self._iB = self._get_param("i2h_bias")
+        self._hW = self._get_param("h2h_weight")
+        self._hB = self._get_param("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=3 * self._num_hidden)
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=3 * self._num_hidden)
+        i_r, i_z, i_n = list(sym.split(i2h, num_outputs=3, axis=1))
+        h_r, h_z, h_n = list(sym.split(h2h, num_outputs=3, axis=1))
+        reset = sym.Activation(i_r + h_r, act_type="sigmoid")
+        update = sym.Activation(i_z + h_z, act_type="sigmoid")
+        newmem = sym.Activation(i_n + reset * h_n, act_type="tanh")
+        next_h = update * states[0] + (1 - update) * newmem
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-sequence fused kernel (reference: FusedRNNCell over sym.RNN —
+    cuDNN there, lax.scan-backed RNN op here)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, prefix=None, params=None):
+        prefix = prefix or ("%s_" % mode)
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._param = sym.Variable(self._prefix + "parameters")
+
+    @property
+    def state_info(self):
+        b = 2 if self._bidirectional else 1
+        info = [{"shape": (b * self._num_layers, 0, self._num_hidden),
+                 "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append(dict(info[0]))
+        return info
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        if inputs is None:
+            inputs = sym.Variable("%sdata" % input_prefix)
+        if isinstance(inputs, (list, tuple)):
+            inputs = sym.stack(*inputs, axis=1)
+        if layout == "NTC":  # RNN op takes TNC
+            inputs = sym.swapaxes(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        args = [inputs, self._param] + list(begin_state)
+        out = sym.RNN(*args, state_size=self._num_hidden,
+                      num_layers=self._num_layers, mode=self._mode,
+                      bidirectional=self._bidirectional, p=self._dropout,
+                      state_outputs=False)
+        if layout == "NTC":
+            out = sym.swapaxes(out, dim1=0, dim2=1)
+        return out, begin_state
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (reference: unfuse)."""
+        stack = SequentialRNNCell()
+        cls = {"rnn_tanh": RNNCell, "rnn_relu": RNNCell, "lstm": LSTMCell,
+               "gru": GRUCell}[self._mode]
+        for i in range(self._num_layers):
+            stack.add(cls(self._num_hidden,
+                          prefix="%sl%d_" % (self._prefix, i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """reference: SequentialRNNCell."""
+
+    def __init__(self, params=None):
+        super().__init__("", params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def __call__(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            inputs, st = cell(inputs, states[pos:pos + n])
+            pos += n
+            next_states.extend(st)
+        return inputs, next_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """reference: BidirectionalCell — l2r + r2l cells, outputs concat."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params)
+        self._l, self._r = l_cell, r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l.state_info + self._r.state_info
+
+    def begin_state(self, **kwargs):
+        return self._l.begin_state(**kwargs) + self._r.begin_state(**kwargs)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [sym.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, sym.Symbol):
+            inputs = list(sym.split(inputs, num_outputs=length, axis=axis,
+                                    squeeze_axis=True))
+        if begin_state is None:
+            begin_state = self.begin_state()
+        nl = len(self._l.state_info)
+        lo, ls = self._l.unroll(length, inputs, begin_state[:nl], layout="TNC")
+        ro, rs = self._r.unroll(length, list(reversed(inputs)),
+                                begin_state[nl:], layout="TNC")
+        outs = [sym.concat(l, r, dim=1)
+                for l, r in zip(lo, reversed(ro))]
+        if merge_outputs:
+            outs = sym.stack(*outs, axis=axis)
+        return outs, ls + rs
+
+
+class DropoutCell(BaseRNNCell):
+    """reference: DropoutCell."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self._dropout > 0:
+            inputs = sym.Dropout(inputs, p=self._dropout)
+        return inputs, states
+
+
+class ResidualCell(BaseRNNCell):
+    """reference: ResidualCell — output = base(x) + x."""
+
+    def __init__(self, base_cell):
+        super().__init__("", None)
+        self._base = base_cell
+
+    @property
+    def state_info(self):
+        return self._base.state_info
+
+    def begin_state(self, **kwargs):
+        return self._base.begin_state(**kwargs)
+
+    def __call__(self, inputs, states):
+        out, states = self._base(inputs, states)
+        return out + inputs, states
+
+
+class BucketSentenceIter:
+    """Bucketed sequence batches for BucketingModule.
+    reference: python/mxnet/rnn/io.py (BucketSentenceIter)."""
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        from .io import DataBatch, DataDesc
+        if buckets is None:
+            lens = _np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(lens)
+                       if n >= batch_size]
+        buckets.sort()
+        self._DataBatch, self._DataDesc = DataBatch, DataDesc
+        ndiscard = 0
+        self.data = [[] for _ in buckets]
+        for s in sentences:
+            buck = _np.searchsorted(buckets, len(s))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = _np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(s)] = s
+            self.data[buck].append(buff)
+        self.data = [_np.asarray(x, dtype=dtype) for x in self.data]
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.invalid_label = invalid_label
+        self.data_name, self.label_name = data_name, label_name
+        self.dtype = dtype
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [self._DataDesc(self.data_name,
+                               (self.batch_size, self.default_bucket_key),
+                               self.dtype)]
+
+    @property
+    def provide_label(self):
+        return [self._DataDesc(self.label_name,
+                               (self.batch_size, self.default_bucket_key),
+                               self.dtype)]
+
+    def reset(self):
+        self._idx = [(b, i) for b, d in enumerate(self.data)
+                     for i in range(0, len(d) - self.batch_size + 1,
+                                    self.batch_size)]
+        _np.random.shuffle(self._idx)
+        self._cur = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._cur >= len(self._idx):
+            raise StopIteration
+        b, start = self._idx[self._cur]
+        self._cur += 1
+        d = self.data[b][start:start + self.batch_size]
+        label = _np.full_like(d, self.invalid_label)
+        label[:, :-1] = d[:, 1:]
+        return self._make_batch(d, label, self.buckets[b])
+
+    next = __next__
+
+    def _make_batch(self, d, label, bucket_key):
+        from .ndarray import array
+        batch = self._DataBatch(
+            data=[array(d)], label=[array(label)], pad=0,
+            provide_data=[self._DataDesc(self.data_name, d.shape,
+                                         self.dtype)],
+            provide_label=[self._DataDesc(self.label_name, label.shape,
+                                          self.dtype)])
+        batch.bucket_key = bucket_key
+        return batch

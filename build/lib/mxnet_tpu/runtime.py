@@ -1,0 +1,98 @@
+"""Runtime feature detection. reference: python/mxnet/runtime.py
+(`Features`, `feature_list`) over src/libinfo.cc (MXLibInfoFeatures) —
+build-time flags surfaced at runtime. Here features are discovered live
+from the JAX/PjRt environment.
+"""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list", "is_enabled"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "[%s: %s]" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    import jax
+
+    feats = {}
+    platforms = set()
+    try:
+        for d in jax.devices():
+            platforms.add(d.platform)
+    except RuntimeError:
+        pass
+    feats["TPU"] = bool(platforms & {"tpu", "axon"})
+    feats["CPU"] = True
+    feats["CUDA"] = "gpu" in platforms or "cuda" in platforms
+    # the reference's vendor-kernel flags map to the XLA stack
+    feats["CUDNN"] = False
+    feats["MKLDNN"] = False
+    feats["XLA"] = True
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        feats["PALLAS"] = True
+    except ImportError:
+        feats["PALLAS"] = False
+    feats["BF16"] = True
+    feats["INT64_TENSOR_SIZE"] = True
+    feats["SIGNAL_HANDLER"] = False
+    feats["PROFILER"] = True
+    # multi-controller distributed (the dist_kvstore analog)
+    feats["DIST_KVSTORE"] = True
+    feats["OPENMP"] = False
+    feats["SSE"] = False
+    feats["F16C"] = False
+    feats["JEMALLOC"] = False
+    feats["OPENCV"] = False
+    return feats
+
+
+class Features(dict):
+    """reference: runtime.py (Features) — dict of name → Feature."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__([(k, Feature(k, v)) for k, v in _detect().items()])
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature '%s' is unknown, known features are: "
+                               "%s" % (feature_name, list(self.keys())))
+        return self[feature_name].enabled
+
+
+def feature_list():
+    """reference: runtime.py (feature_list)."""
+    if Features.instance is None:
+        Features.instance = Features()
+    return list(Features.instance.values())
+
+
+def is_enabled(feature_name):
+    if Features.instance is None:
+        Features.instance = Features()
+    return Features.instance.is_enabled(feature_name)
+
+
+def honor_jax_platforms_env():
+    """Force jax back onto the platform named by JAX_PLATFORMS.
+
+    The axon sitecustomize re-registers its TPU backend and resets
+    jax_platforms AFTER env vars are read, so scripts documented as
+    `JAX_PLATFORMS=cpu ... python script.py` would silently ignore the env
+    var. Call this before any jax use (examples/ and tools/ do)."""
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -1,0 +1,41 @@
+"""`mx.sym` — the symbolic namespace, codegen'd from the shared op registry.
+reference: python/mxnet/symbol/__init__.py."""
+import sys as _sys
+import types as _types
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json, populate,
+                     zeros, ones, arange)
+from .executor import Executor
+
+populate(globals())
+
+# mx.sym.random.* sub-namespace (reference: python/mxnet/symbol/random.py)
+from .symbol import _make_op as _mk  # noqa: E402
+random = _types.ModuleType(__name__ + ".random")
+for _pub, _src in [("uniform", "_random_uniform"),
+                   ("normal", "_random_normal"),
+                   ("randint", "_random_randint"),
+                   ("gamma", "_random_gamma"),
+                   ("exponential", "_random_exponential"),
+                   ("poisson", "_random_poisson"),
+                   ("multinomial", "_sample_multinomial"),
+                   ("shuffle", "_shuffle")]:
+    setattr(random, _pub, _mk(_src))
+_sys.modules[random.__name__] = random
+
+# mx.sym.contrib.* sub-namespace (reference: python/mxnet/symbol/contrib.py
+# — every `_contrib_*` registered op under its short name, composable into
+# graphs exactly like the core ops)
+from ..ops import registry as _reg_mod  # noqa: E402
+contrib = _types.ModuleType(__name__ + ".contrib")
+for _full in list(_reg_mod.list_ops()):
+    if _full.startswith("_contrib_"):
+        setattr(contrib, _full[len("_contrib_"):], _mk(_full))
+# control-flow contrib ops are F-generic python functions (tracing runs
+# through nd with tracer payloads), same objects as nd.contrib's
+from ..ndarray.contrib_flow import foreach as _cf_foreach, \
+    while_loop as _cf_while_loop, cond as _cf_cond  # noqa: E402
+contrib.foreach = _cf_foreach
+contrib.while_loop = _cf_while_loop
+contrib.cond = _cf_cond
+_sys.modules[contrib.__name__] = contrib

@@ -1,0 +1,159 @@
+"""Executor: a bound symbolic graph.
+
+TPU-native analog of reference src/executor/graph_executor.cc via
+python/mxnet/executor.py. `forward` evaluates the graph through NDArray ops
+under autograd (recording when is_train), `backward` replays the tape into
+the bound grad arrays. Memory planning / op fusion (PlanMemory, bulk exec)
+are XLA's job; a jitted fast path is available via `hybridize`-style caching
+in CachedOp, which Module uses for its hot loop.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import autograd
+from .. import ndarray as nd
+from ..base import MXNetError
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    """reference: python/mxnet/executor.py (Executor)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+
+        if isinstance(args, (list, tuple)):
+            if len(args) != len(self._arg_names):
+                raise MXNetError("bind: expected %d args, got %d" %
+                                 (len(self._arg_names), len(args)))
+            self.arg_dict = dict(zip(self._arg_names, args))
+        else:
+            self.arg_dict = dict(args)
+        self.arg_arrays = [self.arg_dict[n] for n in self._arg_names]
+
+        if args_grad is None:
+            self.grad_dict = {}
+        elif isinstance(args_grad, (list, tuple)):
+            self.grad_dict = dict(zip(self._arg_names, args_grad))
+        else:
+            self.grad_dict = dict(args_grad)
+        self.grad_arrays = [self.grad_dict.get(n) for n in self._arg_names]
+
+        if aux_states is None:
+            self.aux_dict = {}
+        elif isinstance(aux_states, (list, tuple)):
+            self.aux_dict = dict(zip(self._aux_names, aux_states))
+        else:
+            self.aux_dict = dict(aux_states)
+        self.aux_arrays = [self.aux_dict[n] for n in self._aux_names]
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req)
+
+        self.outputs = []
+        self._output_names = symbol.list_outputs()
+        self._recorded_heads = None
+
+    def forward(self, is_train=False, **kwargs):
+        """reference: Executor.forward — kwargs update bound args first."""
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError("Unknown argument %s" % name)
+            dst = self.arg_dict[name]
+            if isinstance(val, nd.NDArray):
+                val.copyto(dst)
+            else:
+                dst[:] = val
+
+        feed = dict(self.arg_dict)
+        feed.update(self.aux_dict)
+        if is_train:
+            # mark grads on inputs that want them
+            for name, arr in self.arg_dict.items():
+                req = self._grad_req.get(name, "null")
+                if req != "null" and self.grad_dict.get(name) is not None:
+                    arr._grad = self.grad_dict[name]
+                    arr._grad_req = req
+                    autograd.mark_variable(arr, req)
+            with autograd.record():
+                out = self._symbol.eval_with(feed, self._ctx)
+        else:
+            with autograd.pause():
+                out = self._symbol.eval_with(feed, self._ctx)
+        self.outputs = out if isinstance(out, list) else [out]
+        self._recorded_heads = self.outputs if is_train else None
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """reference: Executor.backward."""
+        if self._recorded_heads is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            head_grads = None
+        else:
+            if isinstance(out_grads, nd.NDArray):
+                out_grads = [out_grads]
+            head_grads = list(out_grads)
+        autograd.backward(self._recorded_heads, head_grads)
+        return
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """reference: Executor.copy_params_from."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name]) if isinstance(
+                    array, nd.NDArray) else self.arg_dict[name].__setitem__(
+                        slice(None), array)
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the "
+                                 "arguments" % name)
+        if aux_params is None:
+            return
+        for name, array in aux_params.items():
+            if name in self.aux_dict:
+                array.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise ValueError("Find name %s that is not in the auxiliary "
+                                 "states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes. reference: Executor.reshape."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, sh in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            if tuple(old.shape) == tuple(sh):
+                new_args[name] = old
+            else:
+                new_args[name] = nd.zeros(sh, ctx=self._ctx, dtype=old.dtype)
+        new_grads = None
+        if self.grad_dict:
+            new_grads = {}
+            for name, g in self.grad_dict.items():
+                if g is None:
+                    continue
+                sh = new_args[name].shape
+                new_grads[name] = g if tuple(g.shape) == tuple(sh) else \
+                    nd.zeros(sh, ctx=self._ctx, dtype=g.dtype)
+        new_aux = {}
+        for name, sh in zip(self._aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if tuple(old.shape) == tuple(sh) else \
+                nd.zeros(sh, ctx=self._ctx, dtype=old.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))

@@ -1,0 +1,888 @@
+"""Symbol: the declarative graph API.
+
+TPU-native analog of reference python/mxnet/symbol/symbol.py over the NNVM
+graph (reference: 3rdparty/tvm/nnvm include/nnvm/symbolic.h, Symbol::Compose,
+src/pass/saveload_json.cc). A Symbol is a lightweight DAG node referencing
+the SAME op registry as `mx.nd` — one definition per op, visible in both
+namespaces (reference: python/mxnet/symbol/register.py codegen).
+
+Execution maps to the imperative layer: `bind`/`simple_bind` build an
+Executor whose forward topologically evaluates the graph through NDArray
+ops (so autograd supplies backward), and whose jitted fast-path is exactly
+`hybridize` (CachedOp ≙ jax.jit). Graph passes of the reference (InferShape,
+PlanMemory, Gradient) collapse to jax.eval_shape / XLA buffer assignment /
+jax.vjp respectively.
+
+JSON format: `tojson()` emits the reference's NNVM layout {nodes, arg_nodes,
+node_row_ptr, heads, attrs} with per-node {"op","name","attrs","inputs"} so
+`-symbol.json` files round-trip with the reference ecosystem.
+"""
+from __future__ import annotations
+
+import ast
+import json
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..base import MXNetError, np_dtype
+from ..name import NameManager
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+_AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var")
+
+
+class Symbol:
+    """A node (or node-output slice) in the symbolic graph."""
+
+    def __init__(self, op=None, name=None, inputs=None, attrs=None,
+                 kwargs=None, num_outputs=1, out_index=None):
+        self._op = op                # None for variables
+        self._name = name
+        self._inputs = inputs or []  # list[Symbol]
+        self._attrs = dict(attrs or {})   # user attrs (__shape__, lr_mult...)
+        self._kwargs = dict(kwargs or {})  # op hyper-params
+        self._num_outputs = num_outputs
+        self._out_index = out_index  # int → this symbol is one output slice
+        self._outputs_cache = None
+
+    # ------------------------------------------------------------------
+    @property
+    def name(self):
+        if self._out_index is not None and self._num_outputs > 1:
+            return "%s_output%d" % (self._name, self._out_index)
+        return self._name
+
+    @property
+    def op(self):
+        return self._op
+
+    def __repr__(self):
+        if self._op is None:
+            return "<Symbol %s>" % self.name
+        return "<Symbol %s>" % self.name
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self.list_outputs())))
+
+    def __getitem__(self, index):
+        outputs = self.list_outputs()
+        if isinstance(index, str):
+            idx = outputs.index(index)
+            return self[idx]
+        if isinstance(index, slice):
+            return Group([self[i] for i in range(*index.indices(
+                len(outputs)))])
+        if self._op == "_group":
+            return self._inputs[index]
+        if index >= self._num_outputs:
+            raise IndexError("Index: %d exceeds the number of outputs: %d." %
+                             (index, self._num_outputs))
+        if self._num_outputs == 1:
+            return self
+        return Symbol(self._op, self._name, self._inputs, self._attrs,
+                      self._kwargs, self._num_outputs, out_index=index)
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    # ------------------------------------------------------------------
+    # graph traversal
+    # ------------------------------------------------------------------
+    def _topo(self):
+        """Post-order unique node list (node = Symbol with out_index=None)."""
+        seen = {}
+        order = []
+
+        def visit(s):
+            base = s._base_node()
+            if id(base) in seen:
+                return
+            seen[id(base)] = base
+            for i in base._inputs:
+                visit(i)
+            order.append(base)
+        visit(self)
+        return order
+
+    def _base_node(self):
+        if self._out_index is None:
+            return self
+        return Symbol(self._op, self._name, self._inputs, self._attrs,
+                      self._kwargs, self._num_outputs)
+
+    def _heads(self):
+        """Output symbols (for groups: members)."""
+        if self._op == "_group":
+            out = []
+            for s in self._inputs:
+                out.extend(s._heads())
+            return out
+        return [self]
+
+    def list_arguments(self):
+        """Free variables in topo order. reference: Symbol.list_arguments."""
+        return [s._name for s in self._topo()
+                if s._op is None and not s._is_aux() and not s._is_literal()]
+
+    def _is_literal(self):
+        return any(k.startswith("__literal") for k in self._attrs)
+
+    def list_auxiliary_states(self):
+        """reference: Symbol.list_auxiliary_states — aux states are
+        non-differentiable op states (moving stats)."""
+        return [s._name for s in self._topo() if s._op is None and
+                s._is_aux()]
+
+    def _is_aux(self):
+        if self._attrs.get("__aux__") == "True":
+            return True
+        return str(self._name or "").endswith(_AUX_SUFFIXES)
+
+    def list_inputs(self):
+        return [s._name for s in self._topo() if s._op is None]
+
+    def list_outputs(self):
+        """reference: Symbol.list_outputs."""
+        outs = []
+        for h in self._heads():
+            if h._num_outputs == 1 or h._out_index is not None:
+                outs.append(h.name if h._op else h._name)
+            else:
+                outs.extend("%s_output%d" % (h._name, i)
+                            for i in range(h._num_outputs))
+        return outs
+
+    def get_internals(self):
+        """reference: Symbol.get_internals — every node as an output."""
+        nodes = self._topo()
+        outs = []
+        for n in nodes:
+            if n._op is None:
+                outs.append(n)
+            else:
+                for i in range(n._num_outputs):
+                    outs.append(n[i] if n._num_outputs > 1 else n)
+        return Group(outs)
+
+    def get_children(self):
+        base = self._base_node()
+        if not base._inputs:
+            return None
+        return Group(list(base._inputs))
+
+    # ------------------------------------------------------------------
+    # attrs
+    # ------------------------------------------------------------------
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def list_attr(self):
+        return {k: str(v) for k, v in self._attrs.items()}
+
+    def attr_dict(self):
+        """{node_name: attrs} for all nodes. reference: Symbol.attr_dict."""
+        ret = {}
+        for n in self._topo():
+            d = {k: str(v) for k, v in n._attrs.items()}
+            d.update({k: str(v) for k, v in n._kwargs.items()})
+            if d:
+                ret[n._name] = d
+        return ret
+
+    def _set_attr(self, **kwargs):
+        self._attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    # ------------------------------------------------------------------
+    # composition
+    # ------------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: substitute this symbol's free variables.
+        reference: Symbol.__call__ → Symbol::Compose."""
+        s = self._compose_args(*args, **kwargs)
+        return s
+
+    def _compose_args(self, *args, **kwargs):
+        name = kwargs.pop("name", None)
+        if args and kwargs:
+            raise TypeError(
+                "compose only accept input Symbols either as positional or "
+                "keyword arguments, not both")
+        arg_names = self.list_arguments()
+        mapping = {}
+        if args:
+            if len(args) > len(arg_names):
+                raise ValueError("too many positional arguments")
+            mapping = dict(zip(arg_names, args))
+        else:
+            for k, v in kwargs.items():
+                if not isinstance(v, Symbol):
+                    raise TypeError("Compose expect `Symbol` as arguments")
+                mapping[k] = v
+        out = self._compose_with(mapping)
+        if name is not None:
+            out._name = name
+        return out
+
+    def _compose_with(self, mapping):
+        """Return a copy of the graph with variables substituted by name."""
+        memo = {}
+
+        def rebuild(s):
+            base = s._base_node()
+            key = id(base)
+            if key in memo:
+                new_base = memo[key]
+            else:
+                if base._op is None and base._name in mapping:
+                    new_base = mapping[base._name]._base_node()
+                else:
+                    new_base = Symbol(
+                        base._op, base._name,
+                        [rebuild(i) for i in base._inputs],
+                        base._attrs, base._kwargs, base._num_outputs)
+                memo[key] = new_base
+            if s._out_index is not None:
+                return new_base[s._out_index]
+            return new_base
+        return rebuild(self)
+
+    # ------------------------------------------------------------------
+    # shape / type inference (reference: MXSymbolInferShape via nnvm pass;
+    # here jax.eval_shape runs the same computation abstractly)
+    # ------------------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes).
+        reference: Symbol.infer_shape."""
+        try:
+            res = self._infer_shape_impl(False, *args, **kwargs)
+        except Exception as e:
+            raise MXNetError("infer_shape error: %s" % e) from e
+        return res
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        else:
+            for k, v in kwargs.items():
+                if v is not None:
+                    known[k] = tuple(v)
+
+        nodes = self._topo()
+        shapes = {}   # node name -> tuple or list of tuples
+        dtypes = {}
+
+        def node_out(s):
+            base_name = s._name
+            return shapes.get(base_name)
+
+        for n in nodes:
+            if n._op is None:
+                if n._is_literal():
+                    lit = n._literal_value()
+                    if isinstance(lit, float):
+                        shapes[n._name] = ()
+                    else:
+                        shapes[n._name] = tuple(lit.shape)
+                    dtypes[n._name] = _np.float32
+                elif n._name in known:
+                    shapes[n._name] = known[n._name]
+                    dtypes[n._name] = _np.float32
+                else:
+                    sh = n._attrs.get("__shape__")
+                    if sh is not None:
+                        sh = ast.literal_eval(sh) if isinstance(sh, str) else sh
+                        if sh and all(d for d in sh):
+                            shapes[n._name] = tuple(sh)
+                            dtypes[n._name] = np_dtype(
+                                n._attrs.get("__dtype__", "float32"))
+                            continue
+                    # defer: may be filled by a consumer op's shape hint
+                    shapes[n._name] = None
+            else:
+                in_shapes = []
+                ok = True
+                for i in n._inputs:
+                    s_in = shapes.get(i._name)
+                    if isinstance(s_in, list):
+                        s_in = s_in[i._out_index or 0]
+                    in_shapes.append((s_in, dtypes.get(i._name, _np.float32)))
+                if any(s is None for s, _ in in_shapes):
+                    # the forward half of the reference's bidirectional
+                    # FInferShape: fill parameter shapes from data shapes
+                    hint = _reg.get(n._op).shape_hint
+                    if hint is not None:
+                        filled = hint([s for s, _ in in_shapes], n._kwargs)
+                        for i, new_shape, (old, dt) in zip(
+                                n._inputs, filled, in_shapes):
+                            if old is None and new_shape is not None:
+                                shapes[i._name] = tuple(new_shape)
+                        in_shapes = [
+                            (shapes.get(i._name) if not isinstance(
+                                shapes.get(i._name), list) else
+                             shapes.get(i._name)[i._out_index or 0], dt)
+                            for i, (_, dt) in zip(n._inputs, in_shapes)]
+                    ok = all(s is not None for s, _ in in_shapes)
+                if not ok:
+                    if partial:
+                        shapes[n._name] = None
+                        continue
+                    missing = [i._name for i, (s, _) in
+                               zip(n._inputs, in_shapes) if s is None]
+                    raise MXNetError(
+                        "cannot infer shape: op %s (%s) has inputs with "
+                        "unknown shapes: %s" % (n._name, n._op, missing))
+                op = _reg.get(n._op)
+                abstract = [jax.ShapeDtypeStruct(s, d) for s, d in in_shapes]
+                kw = dict(n._kwargs)
+                if op.random:
+                    kw.setdefault("key", jax.random.key(0))
+
+                def f(*arrs):
+                    return op.fn(*arrs, **kw)
+                out = jax.eval_shape(f, *abstract)
+                if isinstance(out, (tuple, list)):
+                    shapes[n._name] = [tuple(o.shape) for o in out]
+                    dtypes[n._name] = out[0].dtype
+                else:
+                    shapes[n._name] = tuple(out.shape)
+                    dtypes[n._name] = out.dtype
+
+        def get_for(s):
+            sh = shapes.get(s._name)
+            if isinstance(sh, list):
+                return sh[s._out_index or 0]
+            return sh
+
+        arg_shapes = [shapes.get(n) if not isinstance(shapes.get(n), list)
+                      else shapes.get(n)[0] for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        out_shapes = []
+        for h in self._heads():
+            sh = shapes.get(h._name)
+            if isinstance(sh, list):
+                if h._out_index is not None:
+                    out_shapes.append(sh[h._out_index])
+                else:
+                    out_shapes.extend(sh)
+            else:
+                out_shapes.append(sh)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Simplified dtype propagation (float32 default)."""
+        arg_names = self.list_arguments()
+        dt = _np.float32
+        if args:
+            for a in args:
+                if a is not None:
+                    dt = np_dtype(a)
+                    break
+        elif kwargs:
+            dt = np_dtype(list(kwargs.values())[0])
+        return ([dt] * len(arg_names), [dt] * len(self.list_outputs()),
+                [_np.float32] * len(self.list_auxiliary_states()))
+
+    # ------------------------------------------------------------------
+    # evaluation / binding
+    # ------------------------------------------------------------------
+    def eval_with(self, feed, ctx=None):
+        """Evaluate through NDArray ops (autograd-aware). Returns one
+        NDArray or a list."""
+        node_vals = {}
+        for n in self._topo():
+            if n._op is None:
+                lit = n._literal_value(ctx)
+                if lit is not None:
+                    node_vals[n._ident()] = lit
+                elif n._name not in feed:
+                    raise MXNetError("eval is missing input %s" % n._name)
+                else:
+                    node_vals[n._ident()] = feed[n._name]
+            else:
+                ins = []
+                for i in n._inputs:
+                    v = node_vals[i._ident()]
+                    if isinstance(v, list) and i._out_index is not None:
+                        v = v[i._out_index]
+                    elif isinstance(v, list) and len(v) == 1:
+                        v = v[0]
+                    ins.append(v)
+                kw = {k: _parse_attr(v) for k, v in n._kwargs.items()}
+                node_vals[n._ident()] = nd.invoke(n._op, *ins, **kw)
+        outs = []
+        for h in self._heads():
+            v = node_vals[h._ident()]
+            if isinstance(v, list):
+                if h._out_index is not None:
+                    outs.append(v[h._out_index])
+                else:
+                    outs.extend(v)
+            else:
+                outs.append(v)
+        return outs[0] if len(outs) == 1 else outs
+
+    def _ident(self):
+        # identity key for a base node: (op, name) is unique per graph
+        return (self._op, self._name)
+
+    def _literal_value(self, ctx=None):
+        """Materialize literal-constant variables (scalars, sym.zeros...)."""
+        a = self._attrs
+        if "__literal__" in a:
+            return float(a["__literal__"])
+        if "__literal_zeros__" in a:
+            return nd.zeros(ast.literal_eval(a["__literal_zeros__"]), ctx=ctx)
+        if "__literal_ones__" in a:
+            return nd.ones(ast.literal_eval(a["__literal_ones__"]), ctx=ctx)
+        if "__literal_arange__" in a:
+            start, stop, step = ast.literal_eval(a["__literal_arange__"])
+            return nd.arange(start, stop, step, ctx=ctx)
+        return None
+
+    def eval(self, ctx=None, **kwargs):
+        """reference: Symbol.eval — returns list of NDArrays."""
+        out = self.eval_with(kwargs, ctx)
+        return out if isinstance(out, list) else [out]
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """reference: Symbol.bind → Executor."""
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arrays from inferred shapes and bind.
+        reference: Symbol.simple_bind → MXExecutorSimpleBindEx."""
+        from .executor import Executor
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if shape is None:
+                raise MXNetError("simple_bind could not infer shape for "
+                                 "argument %s" % name)
+            args[name] = nd.zeros(shape, ctx=ctx,
+                                  dtype=type_dict.get(name, _np.float32))
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            aux[name] = nd.zeros(shape, ctx=ctx,
+                                 dtype=type_dict.get(name, _np.float32))
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {name: nd.zeros(a.shape, ctx=ctx, dtype=a.dtype)
+                         for name, a in args.items()}
+        return Executor(self, ctx, args, args_grad, grad_req, aux)
+
+    # ------------------------------------------------------------------
+    # serialization (reference: nnvm src/pass/saveload_json.cc)
+    # ------------------------------------------------------------------
+    def tojson(self, remove_amp_cast=True):
+        nodes_list = self._topo()
+        index = {n._ident(): i for i, n in enumerate(nodes_list)}
+        nodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes_list):
+            if n._op is None:
+                arg_nodes.append(i)
+                entry = {"op": "null", "name": n._name, "inputs": []}
+                if n._attrs:
+                    entry["attrs"] = {k: str(v) for k, v in n._attrs.items()}
+            else:
+                entry = {
+                    "op": n._op, "name": n._name,
+                    "attrs": {k: str(v) for k, v in n._kwargs.items()},
+                    "inputs": [[index[i_._ident()], i_._out_index or 0, 0]
+                               for i_ in n._inputs]}
+            nodes.append(entry)
+        heads = []
+        for h in self._heads():
+            hi = index[h._ident()]
+            heads.append([hi, h._out_index or 0, 0])
+        graph = {
+            "nodes": nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10900]},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname, remove_amp_cast=True):
+        """reference: Symbol.save → `-symbol.json`."""
+        with open(fname, "w") as f:
+            f.write(self.tojson(remove_amp_cast=remove_amp_cast))
+
+    # ------------------------------------------------------------------
+    # operators — route through the shared op registry
+    # ------------------------------------------------------------------
+    def __add__(self, other):
+        return _make_op("broadcast_add")(self, other)
+
+    def __radd__(self, other):
+        return _make_op("broadcast_add")(self, other)
+
+    def __sub__(self, other):
+        return _make_op("broadcast_sub")(self, other)
+
+    def __rsub__(self, other):
+        return _make_op("broadcast_sub")(other, self)
+
+    def __mul__(self, other):
+        return _make_op("broadcast_mul")(self, other)
+
+    def __rmul__(self, other):
+        return _make_op("broadcast_mul")(self, other)
+
+    def __truediv__(self, other):
+        return _make_op("broadcast_div")(self, other)
+
+    def __rtruediv__(self, other):
+        return _make_op("broadcast_div")(other, self)
+
+    def __pow__(self, other):
+        return _make_op("broadcast_power")(self, other)
+
+    def __neg__(self):
+        return _make_op("negative")(self)
+
+    def __eq__(self, other):
+        return _make_op("broadcast_equal")(self, other)
+
+    def __ne__(self, other):
+        return _make_op("broadcast_not_equal")(self, other)
+
+    def __lt__(self, other):
+        return _make_op("broadcast_lesser")(self, other)
+
+    def __le__(self, other):
+        return _make_op("broadcast_lesser_equal")(self, other)
+
+    def __gt__(self, other):
+        return _make_op("broadcast_greater")(self, other)
+
+    def __ge__(self, other):
+        return _make_op("broadcast_greater_equal")(self, other)
+
+    __hash__ = object.__hash__
+
+    # method-style ops used by user code and layers
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape") is not None:
+            shape = tuple(kwargs["shape"])
+        return _make_op("reshape")(self, shape=shape)
+
+    def sum(self, axis=None, keepdims=False):
+        return _make_op("sum")(self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return _make_op("mean")(self, axis=axis, keepdims=keepdims)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return _make_op("transpose")(self, axes=axes if axes else None)
+
+    def swapaxes(self, dim1, dim2):
+        return _make_op("swapaxes")(self, dim1=dim1, dim2=dim2)
+
+    def astype(self, dtype):
+        return _make_op("cast")(self, dtype=np_dtype(dtype))
+
+    def slice_axis(self, axis, begin, end):
+        return _make_op("slice_axis")(self, axis=axis, begin=begin, end=end)
+
+    def expand_dims(self, axis):
+        return _make_op("expand_dims")(self, axis=axis)
+
+    def flatten(self):
+        return _make_op("flatten")(self)
+
+    def square(self):
+        return _make_op("square")(self)
+
+    def sqrt(self):
+        return _make_op("sqrt")(self)
+
+    def exp(self):
+        return _make_op("exp")(self)
+
+    def log(self):
+        return _make_op("log")(self)
+
+    def abs(self):
+        return _make_op("abs")(self)
+
+    def softmax(self, axis=-1):
+        return _make_op("softmax")(self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return _make_op("log_softmax")(self, axis=axis)
+
+    def dot(self, other, **kwargs):
+        return _make_op("dot")(self, other, **kwargs)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+
+def _parse_attr(v):
+    if isinstance(v, str):
+        try:
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable. reference: symbol.py (var/Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable `name`")
+    from ..attribute import current as _attr_current
+    attrs = _attr_current()  # active AttrScope attrs; explicit ones win
+    attrs.update(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype).name)
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        attrs["__init__"] = init
+    if stype is not None:
+        attrs["__storage_type__"] = str(stype)
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            attrs[k] = str(v)
+    return Symbol(op=None, name=name, attrs=attrs)
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol.
+    reference: symbol.py (Group)."""
+    if not symbols or any(not isinstance(sym, Symbol) for sym in symbols):
+        raise TypeError("Expected a list of symbols as input")
+    return Symbol(op="_group", name="_group",
+                  inputs=[s for s in symbols])
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from NNVM JSON. reference: sym.load_json."""
+    graph = json.loads(json_str)
+    raw_nodes = graph["nodes"]
+    built = []
+    for entry in raw_nodes:
+        if entry["op"] == "null":
+            built.append(Variable(entry["name"],
+                                  attr=entry.get("attrs", {})))
+        else:
+            ins = []
+            for (src, out_i, _) in entry["inputs"]:
+                s = built[src]
+                if out_i and s._num_outputs > 1:
+                    s = s[out_i]
+                ins.append(s)
+            kwargs = {k: _parse_attr(v)
+                      for k, v in entry.get("attrs", {}).items()}
+            op = _reg.get(entry["op"])
+            n_out = op.num_outputs or int(kwargs.get(
+                "num_outputs", kwargs.get("num_weights", 1)))
+            node = Symbol(entry["op"], entry["name"], ins,
+                          kwargs=kwargs, num_outputs=n_out)
+            built.append(node)
+    heads = []
+    for (idx, out_i, _) in graph["heads"]:
+        s = built[idx]
+        if out_i and s._num_outputs > 1:
+            s = s[out_i]
+        heads.append(s)
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def load(fname):
+    """reference: sym.load."""
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# op namespace codegen (reference: python/mxnet/symbol/register.py)
+# ---------------------------------------------------------------------------
+# Tensor-input parameter names recognized in op signatures. The reference
+# gets the tensor-argument list from NNVM op registration (ListArguments);
+# here it is derived from the registered fn's signature prefix.
+_TENSOR_PARAMS = frozenset([
+    "data", "weight", "bias", "gamma", "beta", "moving_mean", "moving_var",
+    "label", "lhs", "rhs", "parameters", "state", "state_cell", "grid",
+    "indices", "index", "condition", "x", "y", "a", "b", "positive",
+    "negative", "input1", "input2", "query", "key_arr", "value", "mean",
+    "var", "mom", "weight32", "grad", "loc", "rois", "anchors", "score"])
+
+
+def _op_tensor_slots(op):
+    """Ordered tensor-input slot names from the fn signature prefix; None
+    for variadic ops (*args)."""
+    import inspect
+    try:
+        sig = inspect.signature(op.fn)
+    except (ValueError, TypeError):
+        return None
+    slots = []
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return None
+        if p.name in _TENSOR_PARAMS:
+            slots.append(p.name)
+        else:
+            break
+    return slots
+
+
+def _auto_var_skip(op_name, slot, kwargs):
+    """Slots the reference's ListArguments omits conditionally."""
+    if slot == "bias" and kwargs.get("no_bias"):
+        return True
+    if op_name == "LeakyReLU" and slot == "gamma" and \
+            kwargs.get("act_type", "leaky") != "prelu":
+        return True
+    if op_name == "Deconvolution" and slot == "bias" and \
+            kwargs.get("no_bias", True):
+        return True
+    return False
+
+
+def _make_op(op_name):
+    op = _reg.get(op_name)
+    slots = _op_tensor_slots(op)
+
+    def sym_op(*args, name=None, attr=None, **kwargs):
+        sym_kwargs = {}
+        filled = {}
+        extras = []
+        pos_inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                pos_inputs.append(a)
+            elif a is None:
+                pos_inputs.append(None)
+            else:
+                pos_inputs.append(_scalar_const(a))
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                if slots and k in slots:
+                    filled[k] = v
+                else:
+                    extras.append(v)
+            elif v is not None:
+                sym_kwargs[k] = v
+        hint = op_name.lower().strip("_")
+        name = NameManager.current.get(name, hint)
+
+        if slots is None or not slots:
+            inputs = [i for i in pos_inputs if i is not None] + extras
+        else:
+            # positional args fill slots in order; then auto-create the
+            # reference's auto-variables (`{name}_weight` etc.) for any
+            # remaining slot (reference: Symbol::Compose auto-var creation)
+            for i, a in enumerate(pos_inputs):
+                if a is not None and i < len(slots):
+                    filled.setdefault(slots[i], a)
+                elif a is not None:
+                    extras.append(a)
+            inputs = []
+            for slot in slots:
+                if slot in filled:
+                    inputs.append(filled[slot])
+                elif _auto_var_skip(op_name, slot, sym_kwargs):
+                    continue
+                else:
+                    v = Variable("%s_%s" % (name, slot))
+                    if slot in ("moving_mean", "moving_var"):
+                        v._attrs["__aux__"] = "True"
+                    inputs.append(v)
+            inputs.extend(extras)
+        # ops with data-dependent output counts register num_outputs=0;
+        # the real count is their own kwarg (split: num_outputs, the
+        # multi_* fused optimizer updates: num_weights)
+        n_out = op.num_outputs or int(sym_kwargs.get(
+            "num_outputs", sym_kwargs.get("num_weights", 1)))
+        from ..attribute import current as _attr_current
+        merged_attr = _attr_current()
+        merged_attr.update(attr or {})
+        return Symbol(op_name, name, inputs, attrs=merged_attr,
+                      kwargs=sym_kwargs, num_outputs=n_out)
+
+    sym_op.__name__ = op_name.lstrip("_") or op_name
+    sym_op.__doc__ = op.doc or ("%s (symbolic, from shared op registry)"
+                                % op_name)
+    return sym_op
+
+
+_SCALAR_COUNT = [0]
+
+
+def _scalar_const(value):
+    """Embed a python scalar as a constant node (reference handles scalars
+    via *_scalar op variants; a constant node keeps the graph uniform)."""
+    name = "_scalarconst%d" % _SCALAR_COUNT[0]
+    _SCALAR_COUNT[0] += 1
+    s = Symbol("_full_like_scalar", name, [],
+               kwargs={"value": float(value)})
+    # simpler: treat as variable bound to a literal at eval time
+    v = Variable(name)
+    v._attrs["__literal__"] = str(float(value))
+    return v
+
+
+def populate(namespace, names=None):
+    for op_name in (names or _reg.list_ops()):
+        namespace.setdefault(op_name, _make_op(op_name))
+    return namespace
+
+
+def zeros(shape, dtype=None, **kwargs):
+    v = Variable(NameManager.current.get(None, "zeros"))
+    v._attrs["__literal_zeros__"] = str(tuple(shape) if not isinstance(
+        shape, int) else (shape,))
+    return v
+
+
+def ones(shape, dtype=None, **kwargs):
+    v = Variable(NameManager.current.get(None, "ones"))
+    v._attrs["__literal_ones__"] = str(tuple(shape) if not isinstance(
+        shape, int) else (shape,))
+    return v
+
+
+def arange(start, stop=None, step=1.0, ctx=None, dtype=None, **kwargs):
+    v = Variable(NameManager.current.get(None, "arange"))
+    v._attrs["__literal_arange__"] = str((start, stop, step))
+    return v

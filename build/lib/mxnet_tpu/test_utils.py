@@ -1,0 +1,308 @@
+"""Test utilities. reference: python/mxnet/test_utils.py — same core idioms
+(SURVEY.md §4): dtype-aware assert_almost_equal, finite-difference
+check_numeric_gradient, cross-context check_consistency, rand_ndarray,
+env-switchable default_context.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .context import Context, cpu, tpu, current_context
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_shape_nd", "rand_ndarray",
+           "random_arrays", "check_numeric_gradient", "numeric_grad",
+           "check_consistency", "simple_forward", "default_dtype",
+           "effective_dtype", "DummyIter"]
+
+_default_ctx = None
+
+# per-dtype default tolerances (reference: test_utils.py default_tols)
+_DEFAULT_RTOL = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-4,
+                 np.dtype(np.float64): 1e-5, np.dtype(np.int64): 0,
+                 np.dtype(np.int32): 0, np.dtype(np.uint8): 0}
+_DEFAULT_ATOL = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-5,
+                 np.dtype(np.float64): 1e-7, np.dtype(np.int64): 0,
+                 np.dtype(np.int32): 0, np.dtype(np.uint8): 0}
+
+
+def is_accel_test_device():
+    """True when the suite is an on-chip run (MXNET_TEST_DEVICE=tpu|gpu).
+    Single source of truth — tests/conftest.py re-derives it inline only
+    because it must run before any mxnet_tpu/jax import."""
+    return (os.environ.get("MXNET_TEST_DEVICE", "cpu").split("(")[0]
+            in ("tpu", "gpu"))
+
+
+def default_context():
+    """reference: test_utils.py (default_context) — env-switchable so one
+    suite runs on every device type (MXNET_TEST_DEVICE=cpu|tpu)."""
+    global _default_ctx
+    if _default_ctx is not None:
+        return _default_ctx
+    dev = os.environ.get("MXNET_TEST_DEVICE")
+    if dev:
+        name = dev.split("(")[0]
+        idx = int(dev.split("(")[1].rstrip(")")) if "(" in dev else 0
+        return Context(name, idx)
+    return current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def effective_dtype(arr):
+    dt = np.dtype(arr.dtype if hasattr(arr, "dtype") else np.float32)
+    # bf16 accumulates like fp16 for tolerance purposes
+    if dt.name == "bfloat16":
+        return np.dtype(np.float16)
+    return dt
+
+
+def _as_numpy(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def same(a, b):
+    return np.array_equal(_as_numpy(a), _as_numpy(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_numpy(a), _as_numpy(b)
+    rtol, atol = _resolve_tols(a, b, rtol, atol)
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def _resolve_tols(a, b, rtol, atol):
+    da, db = effective_dtype(a), effective_dtype(b)
+    # the coarser dtype decides (reference: get_tols)
+    key = da if _DEFAULT_RTOL.get(da, 0) > _DEFAULT_RTOL.get(db, 0) else db
+    if rtol is None:
+        rtol = _DEFAULT_RTOL.get(key, 1e-4)
+    if atol is None:
+        atol = _DEFAULT_ATOL.get(key, 1e-5)
+    return rtol, atol
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """reference: test_utils.py (assert_almost_equal) — dtype-aware default
+    tolerances, detailed max-error message."""
+    a_np, b_np = _as_numpy(a), _as_numpy(b)
+    rtol, atol = _resolve_tols(a_np, b_np, rtol, atol)
+    if np.allclose(a_np.astype(np.float64) if a_np.dtype.kind == "f" else a_np,
+                   b_np.astype(np.float64) if b_np.dtype.kind == "f" else b_np,
+                   rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    err = np.abs(a_np.astype(np.float64) - b_np.astype(np.float64))
+    denom = np.abs(b_np.astype(np.float64)) + atol
+    rel = err / denom
+    idx = np.unravel_index(np.argmax(rel), rel.shape)
+    raise AssertionError(
+        "Values of %s and %s differ beyond rtol=%g atol=%g: max abs err "
+        "%g, max rel err %g at index %s (%r vs %r)"
+        % (names[0], names[1], rtol, atol, err.max(), rel.max(), idx,
+           a_np[idx], b_np[idx]))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, distribution="uniform"):
+    """reference: test_utils.py (rand_ndarray) — dense or sparse random."""
+    ctx = ctx or default_context()
+    dtype = dtype or np.float32
+    if distribution == "normal":
+        arr = np.random.standard_normal(size=shape)
+    else:
+        arr = np.random.uniform(-1.0, 1.0, size=shape)
+    if stype in (None, "default"):
+        return nd.array(arr.astype(dtype), ctx=ctx)
+    density = 0.1 if density is None else density
+    mask = np.random.rand(shape[0]) < density if stype == "row_sparse" \
+        else np.random.rand(*shape) < density
+    if stype == "row_sparse":
+        arr = arr * mask.reshape((-1,) + (1,) * (len(shape) - 1))
+    elif stype == "csr":
+        arr = arr * mask
+    else:
+        raise ValueError("unknown storage type %s" % stype)
+    dense = nd.array(arr.astype(dtype), ctx=ctx)
+    return dense.tostype(stype)
+
+
+def random_arrays(*shapes):
+    arrays = [np.random.randn(*s).astype(np.float64) if s else
+              np.array(np.random.randn(), dtype=np.float64) for s in shapes]
+    return arrays if len(arrays) > 1 else arrays[0]
+
+
+# --------------------------------------------------------------------------
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central-difference gradients of executor's scalar-summed output wrt
+    every input. reference: test_utils.py (numeric_grad)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.copy()
+        grad = np.zeros_like(base, dtype=np.float64)
+        flat = base.ravel()
+        gflat = grad.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps / 2
+            executor.arg_dict[name][:] = nd.array(base.reshape(arr.shape))
+            executor.forward(is_train=use_forward_train)
+            f_plus = sum(o.asnumpy().astype(np.float64).sum()
+                         for o in executor.outputs)
+            flat[i] = orig - eps / 2
+            executor.arg_dict[name][:] = nd.array(base.reshape(arr.shape))
+            executor.forward(is_train=use_forward_train)
+            f_minus = sum(o.asnumpy().astype(np.float64).sum()
+                          for o in executor.outputs)
+            gflat[i] = (f_plus - f_minus) / eps
+            flat[i] = orig
+        executor.arg_dict[name][:] = nd.array(base.reshape(arr.shape))
+        grads[name] = grad.reshape(arr.shape)
+    return grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-4,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None, dtype=np.float64):
+    """Finite-difference Jacobian vs autograd for a Symbol. reference:
+    test_utils.py (check_numeric_gradient) — THE op-test harness
+    (SURVEY.md §4: port first)."""
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    location = {k: np.asarray(v, dtype=dtype) for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = list(location.keys())
+
+    args = {k: nd.array(v, ctx=ctx) for k, v in location.items()}
+    args_grad = {k: nd.zeros(v.shape, ctx=ctx)
+                 for k, v in location.items() if k in grad_nodes}
+    aux = {k: nd.array(np.asarray(v)) for k, v in (aux_states or {}).items()}
+    executor = sym.bind(ctx, args=args, args_grad=args_grad,
+                        aux_states=aux or None)
+    executor.forward(is_train=use_forward_train)
+    out = executor.outputs
+    out_grads = [nd.ones(o.shape, ctx=ctx) for o in out]
+    executor.backward(out_grads)
+    sym_grads = {k: v.asnumpy().astype(np.float64)
+                 for k, v in executor.grad_dict.items() if v is not None}
+
+    num_grads = numeric_grad(executor, {k: location[k] for k in grad_nodes},
+                             aux_states, eps=numeric_eps,
+                             use_forward_train=use_forward_train)
+    for name in grad_nodes:
+        assert_almost_equal(num_grads[name], sym_grads[name], rtol=rtol,
+                            atol=atol if atol is not None else 1e-4,
+                            names=("numeric_%s" % name, "autograd_%s" % name))
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, rtol=None, atol=None):
+    """Run the same symbol on several (ctx, dtype) combos and compare all
+    outputs/grads against the highest-precision run. reference:
+    test_utils.py (check_consistency)."""
+    assert len(ctx_list) > 1
+    results = []
+    base_loc = None
+    for spec in ctx_list:
+        ctx = spec["ctx"]
+        type_dict = spec.get("type_dict", {})
+        shapes = {k: v for k, v in spec.items()
+                  if k not in ("ctx", "type_dict")}
+        arg_names = sym.list_arguments()
+        if base_loc is None:
+            base_loc = {}
+            for name in arg_names:
+                shape = shapes.get(name)
+                if shape is None:
+                    continue
+                base_loc[name] = np.random.normal(size=shape) * scale
+        args = {}
+        for name in arg_names:
+            if name not in base_loc:
+                continue
+            dt = type_dict.get(name, np.float32)
+            args[name] = nd.array(base_loc[name].astype(dt), ctx=ctx)
+        args_grad = {k: nd.zeros_like(v) for k, v in args.items()} \
+            if grad_req != "null" else None
+        exe = sym.bind(ctx, args=args, args_grad=args_grad,
+                       grad_req=grad_req)
+        exe.forward(is_train=grad_req != "null")
+        if grad_req != "null":
+            exe.backward([nd.ones(o.shape, ctx=ctx) for o in exe.outputs])
+        results.append((spec, exe))
+    # the highest-precision run is ground truth (reference: check_consistency
+    # sorts ctx_list by dtype width)
+    def _prec(res):
+        spec = res[0]
+        dts = [np.dtype(t) for t in spec.get("type_dict", {}).values()]
+        return max((d.itemsize for d in dts), default=4)
+    ref_spec, ref_exe = max(results, key=_prec)
+    for spec, exe in results:
+        if exe is ref_exe:
+            continue
+        for i, (a, b) in enumerate(zip(ref_exe.outputs, exe.outputs)):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                                names=("out%d@%s" % (i, ref_spec["ctx"]),
+                                       "out%d@%s" % (i, spec["ctx"])))
+        if grad_req != "null":
+            for name in ref_exe.grad_dict:
+                assert_almost_equal(
+                    ref_exe.grad_dict[name], exe.grad_dict[name],
+                    rtol=rtol, atol=atol,
+                    names=("grad_%s@%s" % (name, ref_spec["ctx"]),
+                           "grad_%s@%s" % (name, spec["ctx"])))
+    return [exe.outputs[0].asnumpy() for _, exe in results]
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Bind + forward with numpy inputs; returns numpy outputs."""
+    ctx = ctx or default_context()
+    args = {k: nd.array(np.asarray(v), ctx=ctx) for k, v in inputs.items()}
+    exe = sym.bind(ctx, args=args, grad_req="null")
+    exe.forward(is_train=is_train)
+    outs = [o.asnumpy() for o in exe.outputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+class DummyIter:
+    """Repeat one batch forever. reference: test_utils.py (DummyIter)."""
+
+    def __init__(self, real_iter):
+        self._iter = real_iter
+        self.provide_data = real_iter.provide_data
+        self.provide_label = real_iter.provide_label
+        self.batch_size = real_iter.batch_size
+        self.the_batch = next(iter(real_iter))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.the_batch
+
+    def next(self):
+        return self.the_batch
+
+    def reset(self):
+        pass

@@ -48,12 +48,19 @@ def fold_bn_params(gamma, beta, moving_mean, moving_var, eps=1e-3):
     return scale, beta - moving_mean * scale
 
 
-def _xla_conv_bn_relu(x, w, scale, shift, residual=None):
-    """Reference XLA path: lax conv in NHWC + affine + relu."""
-    out = lax.conv_general_dilated(
+def _conv3x3_same(x, w):
+    """The one conv config this module fuses: 3x3, stride 1, SAME, NHWC,
+    f32 accumulation. Single definition — the training forward, its
+    backward, and the inference path must never desynchronize."""
+    return lax.conv_general_dilated(
         x, w, window_strides=(1, 1), padding="SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.float32)
+
+
+def _xla_conv_bn_relu(x, w, scale, shift, residual=None):
+    """Reference XLA path: lax conv in NHWC + affine + relu."""
+    out = _conv3x3_same(x, w)
     out = out * scale.astype(jnp.float32) + shift.astype(jnp.float32)
     if residual is not None:
         out = out + residual.astype(jnp.float32)
@@ -154,3 +161,184 @@ def _conv_bn_relu_tpu(x, w, scale, shift, *residual):
     if not _shapes_ok(x, w):
         return _xla_conv_bn_relu(x, w, scale, shift, res)
     return _pallas_conv_bn_relu(x, w, scale, shift, res)
+
+
+# ---------------------------------------------------------------------------
+# TRAINING-form fusion (round-4 VERDICT weak #3 / round-5 task 2): batch
+# statistics need the conv output, so training is a two-pass structure.
+# The composed XLA graph pays (at least) four HBM passes over the conv
+# output: write it, read it for the stats reduction, read it again for the
+# normalize, write the activation. The fused form computes the stats IN
+# THE CONV EPILOGUE from the f32 VMEM accumulator (pass 1 writes conv_out
+# once and emits per-grid-cell partial sums — the stats reduction never
+# re-reads conv_out from HBM), then one elementwise normalize pass.
+# Backward recomputes xhat from conv_out + saved stats (no xhat/mask
+# materialization in forward) and rides XLA's transposed convs for dx/dw.
+# reference contrast: cuDNN's fused conv-bias-act serves training in the
+# reference (SURVEY §2.1 cuDNN row); its BN backward fusions are
+# cudnnBatchNormalizationBackwardEx.
+# ---------------------------------------------------------------------------
+def _stats_block_co(Cout, cap=128):
+    """Largest multiple-of-8 divisor of Cout up to `cap` (partial-stat
+    slabs must tile Cout exactly)."""
+    best = 0
+    for b in range(8, min(cap, Cout) + 1, 8):
+        if Cout % b == 0:
+            best = b
+    return best
+
+
+def _kernel_train(x_ref, w_ref, o_ref, p_ref, *, block_co, H, W, C):
+    """Conv pass with stats epilogue: writes the conv output AND this grid
+    cell's per-channel (sum, sum-of-squares) computed from the f32
+    accumulator while it is still in VMEM."""
+    x = x_ref[0].astype(jnp.float32)            # (H, W, C)
+    acc = jnp.zeros((H * W, block_co), jnp.float32)
+    for dh in (-1, 0, 1):
+        for dw in (-1, 0, 1):
+            shifted = jnp.roll(x, (-dh, -dw), axis=(0, 1))
+            rows = lax.broadcasted_iota(jnp.int32, (H, W), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (H, W), 1)
+            valid = ((rows + dh >= 0) & (rows + dh < H) &
+                     (cols + dw >= 0) & (cols + dw < W))
+            shifted = jnp.where(valid[..., None], shifted, 0.0)
+            wk = w_ref[dh + 1, dw + 1].astype(jnp.float32)   # (C, bco)
+            acc += jax.lax.dot_general(
+                shifted.reshape(H * W, C), wk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    o_ref[0] = acc.reshape(H, W, block_co).astype(o_ref.dtype)
+    p_ref[0, 0, 0] = jnp.sum(acc, axis=0)
+    p_ref[0, 0, 1] = jnp.sum(acc * acc, axis=0)
+
+
+def _pallas_conv_stats(x, w):
+    """Pass 1: conv_out (x.dtype) + f32 per-channel (sum, sumsq)."""
+    N, H, W, C = x.shape
+    Cout = w.shape[-1]
+    block_co = _stats_block_co(Cout)
+    n_co = Cout // block_co
+
+    try:
+        cparams = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"))
+    except TypeError:
+        cparams = None
+
+    conv_out, partial = pl.pallas_call(
+        functools.partial(_kernel_train, block_co=block_co, H=H, W=W, C=C),
+        grid=(N, n_co),
+        in_specs=[
+            pl.BlockSpec((1, H, W, C), lambda n, c: (n, 0, 0, 0)),
+            pl.BlockSpec((3, 3, C, block_co), lambda n, c: (0, 0, 0, c)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, W, block_co), lambda n, c: (n, 0, 0, c)),
+            pl.BlockSpec((1, 1, 2, block_co), lambda n, c: (n, c, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, H, W, Cout), x.dtype),
+            jax.ShapeDtypeStruct((N, n_co, 2, block_co), jnp.float32),
+        ],
+        interpret=_interpret(),
+        **({"compiler_params": cparams} if cparams else {}),
+    )(x, w)
+    # (N, n_co, 2, bco) -> (2, Cout); tiny host-side reduction
+    sums = partial.transpose(2, 1, 3, 0).reshape(2, Cout, N).sum(axis=-1)
+    return conv_out, sums[0], sums[1]
+
+
+def _xla_conv_stats(x, w):
+    conv_out = _conv3x3_same(x, w)
+    s = jnp.sum(conv_out, axis=(0, 1, 2))
+    sq = jnp.sum(conv_out * conv_out, axis=(0, 1, 2))
+    return conv_out.astype(x.dtype), s, sq
+
+
+def _use_pallas_train(x, w):
+    if _interpret():
+        return _shapes_ok(x, w) and _stats_block_co(w.shape[-1])
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    if os.environ.get("MXNET_TPU_USE_PALLAS", "0") != "1":
+        return False
+    return _shapes_ok(x, w) and _stats_block_co(w.shape[-1])
+
+
+def _normalize_relu(conv_out, mean, invstd, gamma, beta, residual):
+    xhat = (conv_out.astype(jnp.float32) - mean) * invstd
+    y = xhat * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    return xhat, y
+
+
+def _cbr_train_compute(eps, x, w, gamma, beta, residual):
+    """Shared forward: pass-1 conv+stats, pass-2 normalize+relu."""
+    if _use_pallas_train(x, w):
+        conv_out, s, sq = _pallas_conv_stats(x, w)
+    else:
+        conv_out, s, sq = _xla_conv_stats(x, w)
+    M = x.shape[0] * x.shape[1] * x.shape[2]
+    mean = s / M
+    var = jnp.maximum(sq / M - mean * mean, 0.0)
+    invstd = lax.rsqrt(var + eps)
+    _, y = _normalize_relu(conv_out, mean, invstd, gamma, beta, residual)
+    out = jnp.maximum(y, 0.0).astype(x.dtype)
+    return out, mean, var, invstd, conv_out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _cbr_train(eps, has_res, x, w, gamma, beta, residual):
+    out, mean, var, _, _ = _cbr_train_compute(eps, x, w, gamma, beta,
+                                              residual)
+    return out, mean, var
+
+
+def _cbr_train_fwd_rule(eps, has_res, x, w, gamma, beta, residual):
+    out, mean, var, invstd, conv_out = _cbr_train_compute(
+        eps, x, w, gamma, beta, residual)
+    return (out, mean, var), (x, w, conv_out, mean, invstd, gamma, beta,
+                              residual)
+
+
+def _cbr_train_bwd_rule(eps, has_res, saved, cots):
+    x, w, conv_out, mean, invstd, gamma, beta, residual = saved
+    # mean/var cotangents are dropped: running-stat updates are stop-grad
+    # (reference BatchNorm semantics)
+    g_out = cots[0].astype(jnp.float32)
+    # recompute xhat and the pre-relu activation from conv_out + stats —
+    # nothing beyond conv_out was materialized by the forward
+    xhat, y = _normalize_relu(conv_out, mean, invstd, gamma, beta, residual)
+    g = jnp.where(y > 0, g_out, 0.0)
+    axes = (0, 1, 2)
+    dbeta = jnp.sum(g, axis=axes)
+    dgamma = jnp.sum(g * xhat, axis=axes)
+    dxhat = g * gamma.astype(jnp.float32)
+    mean_dxhat = jnp.mean(dxhat, axis=axes)
+    mean_dxhat_xhat = jnp.mean(dxhat * xhat, axis=axes)
+    dconv = invstd * (dxhat - mean_dxhat - xhat * mean_dxhat_xhat)
+
+    _, conv_vjp = jax.vjp(_conv3x3_same, x.astype(jnp.float32),
+                          w.astype(jnp.float32))
+    dx, dw = conv_vjp(dconv)
+    dres = g.astype(residual.dtype) if has_res else None
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype), dres)
+
+
+_cbr_train.defvjp(_cbr_train_fwd_rule, _cbr_train_bwd_rule)
+
+
+@register("_contrib_conv_bn_relu_train", arity=None, num_outputs=3)
+def _conv_bn_relu_train(x, w, gamma, beta, *residual, eps=1e-3):
+    """Training-form fused conv3x3 + BatchNorm + ReLU (+ residual).
+
+    x (N,H,W,C) NHWC; w (3,3,Cin,Cout) HWIO; gamma/beta (Cout,);
+    optional residual (N,H,W,Cout). Returns (out, batch_mean, batch_var)
+    — the caller updates running stats from mean/var exactly like
+    BatchNorm does; gradients flow to x/w/gamma/beta/residual through the
+    standard training-BN backward (mean/var outputs carry stop-grad,
+    reference BatchNorm semantics).
+    """
+    res = residual[0] if residual else None
+    return _cbr_train(eps, res is not None, x, w, gamma, beta, res)

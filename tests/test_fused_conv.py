@@ -127,3 +127,155 @@ def test_gluon_fused_block_matches_composed():
     got = fused(x)
     onp.testing.assert_allclose(got.asnumpy(), composed.asnumpy(),
                                 atol=2e-4, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# TRAINING-form fusion (round-5: conv + batch-stats epilogue + normalize,
+# backward included)
+# ---------------------------------------------------------------------------
+def _composed_train_ref(x, w, gamma, beta, residual=None, eps=1e-3):
+    """Plain-jax composed reference: conv -> batch stats -> norm -> relu."""
+    import jax
+    from jax import lax
+    conv = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    mean = jnp.mean(conv, axis=(0, 1, 2))
+    var = jnp.var(conv, axis=(0, 1, 2))
+    xhat = (conv - mean) / jnp.sqrt(var + eps)
+    y = xhat * gamma + beta
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    return jnp.maximum(y, 0.0).astype(x.dtype), mean, var
+
+
+@pytest.mark.parametrize("shape,res", [((2, 8, 8, 16, 16), False),
+                                       ((1, 14, 14, 32, 64), False),
+                                       ((2, 8, 8, 16, 16), True)])
+def test_train_forward_matches_composed(shape, res):
+    N, H, W, C, Cout = shape
+    x, w, g, b, _, _ = _mk(N, H, W, C, Cout, seed=7)
+    residual = (jnp.asarray(onp.random.RandomState(8)
+                            .randn(N, H, W, Cout).astype("float32") * 0.1)
+                if res else None)
+    out, mean, var = fc._cbr_train(1e-3, res, x, w, g, b, residual)
+    wout, wmean, wvar = _composed_train_ref(x, w, g, b, residual)
+    onp.testing.assert_allclose(mean, wmean, atol=1e-4, rtol=1e-4)
+    onp.testing.assert_allclose(var, wvar, atol=1e-4, rtol=1e-4)
+    onp.testing.assert_allclose(out, wout, atol=5e-4, rtol=1e-3)
+
+
+def test_train_pallas_stats_match_xla():
+    x, w, g, b, _, _ = _mk(2, 8, 8, 16, 32, seed=9)
+    co_p, s_p, sq_p = fc._pallas_conv_stats(x, w)
+    co_x, s_x, sq_x = fc._xla_conv_stats(x, w)
+    onp.testing.assert_allclose(co_p, co_x, atol=2e-4, rtol=1e-4)
+    onp.testing.assert_allclose(s_p, s_x, atol=2e-3, rtol=1e-4)
+    onp.testing.assert_allclose(sq_p, sq_x, atol=2e-3, rtol=1e-4)
+
+
+@pytest.mark.parametrize("res", [False, True])
+def test_train_backward_matches_composed(res):
+    import jax
+    N, H, W, C, Cout = 2, 8, 8, 16, 16
+    x, w, g, b, _, _ = _mk(N, H, W, C, Cout, seed=11)
+    residual = (jnp.asarray(onp.random.RandomState(12)
+                            .randn(N, H, W, Cout).astype("float32") * 0.1)
+                if res else None)
+    cot = jnp.asarray(onp.random.RandomState(13)
+                      .rand(N, H, W, Cout).astype("float32"))
+
+    def loss_fused(x_, w_, g_, b_, r_):
+        out, _, _ = fc._cbr_train(1e-3, res, x_, w_, g_, b_, r_)
+        return jnp.sum(out * cot)
+
+    def loss_ref(x_, w_, g_, b_, r_):
+        out, _, _ = _composed_train_ref(x_, w_, g_, b_, r_)
+        return jnp.sum(out * cot)
+
+    n = 5 if res else 4
+    argnums = tuple(range(n))
+    got = jax.grad(loss_fused, argnums=argnums)(x, w, g, b, residual)
+    want = jax.grad(loss_ref, argnums=argnums)(x, w, g, b, residual)
+    names = ["dx", "dw", "dgamma", "dbeta", "dres"]
+    for gg, ww, nm in zip(got, want, names):
+        onp.testing.assert_allclose(gg, ww, atol=2e-3, rtol=2e-3,
+                                    err_msg=nm)
+
+
+def test_train_op_through_registry_tape():
+    """The registered op through invoke + the imperative tape."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.ndarray.ndarray import invoke
+    rng = onp.random.RandomState(21)
+    x = nd.array(rng.randn(2, 8, 8, 16).astype("float32") * 0.5)
+    w = nd.array(rng.randn(3, 3, 16, 16).astype("float32") * 0.1)
+    g = nd.array(rng.rand(16).astype("float32") + 0.5)
+    b = nd.array(rng.randn(16).astype("float32") * 0.1)
+    for t in (x, w, g, b):
+        t.attach_grad()
+    with autograd.record():
+        out, mean, var = invoke("_contrib_conv_bn_relu_train", x, w, g, b)
+        loss = out.sum()
+    loss.backward()
+    for t, nm in ((x, "x"), (w, "w"), (g, "gamma"), (b, "beta")):
+        assert t.grad is not None, nm
+        arr = t.grad.asnumpy()
+        assert onp.isfinite(arr).all() and onp.abs(arr).max() > 0, nm
+    # batch stats are usable for running-stat updates
+    assert float(var.asnumpy().min()) >= 0.0
+
+
+def test_gluon_train_block_matches_composed_chain():
+    """FusedConvBNReLUTrain == Conv2D(NHWC) -> BatchNorm -> relu, both in
+    training mode (forward, grads, running-stat update) and in eval."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.cnn import FusedConvBNReLUTrain
+
+    rng = onp.random.RandomState(31)
+    C = 16
+    xb = nd.array(rng.randn(2, 8, 8, C).astype("float32") * 0.5)
+
+    mx.random.seed(5)
+    fused = FusedConvBNReLUTrain(C, in_channels=C, epsilon=1e-5)
+    fused.initialize(mx.init.Xavier())
+
+    conv = nn.Conv2D(C, 3, padding=1, layout="NHWC", use_bias=False,
+                     in_channels=C)
+    bn = nn.BatchNorm(axis=3, in_channels=C, epsilon=1e-5)
+    conv.initialize(mx.init.Xavier())
+    bn.initialize()
+    # share the conv weight: Conv2D NHWC keeps (Cout, kh, kw, Cin)
+    w_hwio = fused.weight.data().data_jax
+    conv.weight.set_data(nd.array(onp.transpose(
+        onp.asarray(w_hwio), (3, 0, 1, 2))))
+
+    with autograd.record():
+        y_f = fused(xb)
+        lf = y_f.sum()
+    lf.backward()
+    gw_f = fused.weight.grad().asnumpy()
+    rm_f = fused.running_mean.data().asnumpy()
+
+    with autograd.record():
+        y_c = nd.relu(bn(conv(xb)))
+        lc = y_c.sum()
+    lc.backward()
+    gw_c = conv.weight.grad().asnumpy()
+    rm_c = bn.running_mean.data().asnumpy()
+
+    onp.testing.assert_allclose(y_f.asnumpy(), y_c.asnumpy(), atol=5e-4,
+                                rtol=1e-3)
+    onp.testing.assert_allclose(gw_f, onp.transpose(gw_c, (1, 2, 3, 0)),
+                                atol=2e-3, rtol=2e-3)
+    onp.testing.assert_allclose(rm_f, rm_c, atol=1e-5, rtol=1e-4)
+
+    # eval mode: folded path vs composed eval path
+    y_fe = fused(xb)
+    y_ce = nd.relu(bn(conv(xb)))
+    onp.testing.assert_allclose(y_fe.asnumpy(), y_ce.asnumpy(), atol=5e-4,
+                                rtol=2e-3)

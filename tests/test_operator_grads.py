@@ -143,3 +143,54 @@ def test_take_pick_grad():
     idx = RNG.randint(0, 4, size=(3,)).astype(np.float64)
     check_numeric_gradient(s, {"x": _x((3, 4)), "idx": idx},
                            grad_nodes=["x"], rtol=2e-2, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# training-output regression heads: backward is the hand-coded loss gradient
+# (out - label) * grad_scale / num_output, NOT the forward vjp (reference:
+# src/operator/regression_output.cc). The silent-ones bug (identity forward,
+# pass-through vjp => gradient independent of the parameters) was caught by
+# the SVRG convergence tests.
+# ---------------------------------------------------------------------------
+def test_regression_output_training_gradients():
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.ndarray.ndarray import invoke
+    rng = np.random.RandomState(3)
+    d = rng.randn(4, 3).astype("float32")
+    lab = rng.randn(4, 3).astype("float32")
+    for name, want in (
+        ("LinearRegressionOutput", (d - lab) / 3.0),
+        ("MAERegressionOutput", np.sign(d - lab) / 3.0),
+        ("LogisticRegressionOutput",
+         (1 / (1 + np.exp(-d)) - lab) / 3.0),
+    ):
+        x = nd.array(d)
+        y = nd.array(lab)
+        x.attach_grad()
+        with autograd.record():
+            out = invoke(name, x, y)
+        out.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5,
+                                   atol=1e-6, err_msg=name)
+
+
+def test_regression_output_gradient_tracks_weights():
+    """The gradient MUST respond to a weight change (the regression bug)."""
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.ndarray.ndarray import invoke
+    rng = np.random.RandomState(5)
+    X = nd.array(rng.rand(8, 4).astype("float32"))
+    w = nd.array(rng.rand(1, 4).astype("float32"))
+    b = nd.array(np.zeros(1, "float32"))
+    y = nd.array(rng.rand(8,).astype("float32"))
+    w.attach_grad()
+    grads = []
+    for _ in range(2):
+        with autograd.record():
+            pred = invoke("FullyConnected", X, w, b, num_hidden=1)
+            out = invoke("LinearRegressionOutput", pred, y)
+        out.backward()
+        grads.append(w.grad.asnumpy().copy())
+        w[:] = w + 1.0
+    assert np.abs(grads[1] - grads[0]).max() > 0.1, (
+        "LinearRegressionOutput gradient did not track the weights")

@@ -1,0 +1,54 @@
+"""Packaging: `pip install -e .` from a clean venv (round-4 VERDICT
+missing #2 — reference ships python/setup.py; here pyproject.toml).
+
+The venv gets the baked environment's site-packages via a .pth file
+(jax/numpy are image-provided, never pip-installed — Environment rule),
+and the install runs --no-deps --no-build-isolation so it is fully
+offline.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_pip_install_editable_smoke(tmp_path):
+    venv = tmp_path / "venv"
+    subprocess.run([sys.executable, "-m", "venv", str(venv)], check=True,
+                   timeout=120)
+    # expose the baked site-packages (jax, numpy, setuptools) to the venv
+    baked = [p for p in sys.path if p.endswith("site-packages")]
+    assert baked, "no baked site-packages on sys.path"
+    sp = subprocess.run(
+        [str(venv / "bin" / "python"), "-c",
+         "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+        capture_output=True, text=True, check=True, timeout=60)
+    (tmp_path / "baked.pth").write_text("\n".join(baked))
+    import shutil
+    shutil.copy(str(tmp_path / "baked.pth"),
+                os.path.join(sp.stdout.strip(), "_baked.pth"))
+
+    proc = subprocess.run(
+        [str(venv / "bin" / "pip"), "install", "-e", REPO, "--no-deps",
+         "--no-build-isolation", "-q"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    check = subprocess.run(
+        [str(venv / "bin" / "python"), "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+         "import mxnet_tpu as mx\n"
+         "from mxnet_tpu import nd, gluon, numpy as mnp\n"
+         "import numpy as np\n"
+         "x = nd.array(np.ones((2, 3), np.float32))\n"
+         "assert float((x + x).asnumpy().sum()) == 12.0\n"
+         "print('ok')"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(tmp_path))  # NOT the repo root: the install must stand alone
+    assert check.returncode == 0, check.stderr[-2000:]
+    assert "ok" in check.stdout

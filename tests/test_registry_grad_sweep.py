@@ -90,13 +90,6 @@ SPECS = {
     "L2Normalization": dict(inputs=[_pos()], kwargs={}),
     "LRN": dict(inputs=[_pos((1, 4, 3, 3))], kwargs={"nsize": 3}),
     "LeakyReLU": dict(inputs=[_pos()], kwargs={"act_type": "leaky"}),
-    "LinearRegressionOutput": dict(inputs=[_pos(), _pos()], kwargs={},
-                                   n_diff=(0,)),
-    "LogisticRegressionOutput": dict(inputs=[_pos(), _pos()], kwargs={},
-                                     n_diff=(0,)),
-    "MAERegressionOutput": dict(
-        inputs=[_pos(lo=1.5, hi=2.5), _pos(lo=0.2, hi=0.9)], kwargs={},
-        n_diff=(0,)),
 
     "Pad": dict(inputs=[_pos((1, 2, 3, 3))],
                 kwargs={"mode": "constant",
@@ -263,6 +256,14 @@ SKIP = {
                "gradient (p - onehot), deliberately not the forward vjp "
                "(reference: softmax_output.cc); covered by "
                "tests/test_symbol_module.py",
+    "LinearRegressionOutput": "training-output op: backward is the "
+               "hand-coded loss gradient (out - label), not the forward "
+               "vjp (reference: regression_output.cc); semantics pinned "
+               "by tests/test_svrg.py + test_operator_grads.py",
+    "LogisticRegressionOutput": "training-output op (sigmoid fwd, "
+               "out - label bwd); see LinearRegressionOutput",
+    "MAERegressionOutput": "training-output op (identity fwd, "
+               "sign(out - label) bwd); see LinearRegressionOutput",
     "_np_linalg_qr": "jax QR derivative unimplemented for wide "
                      "matrices; square case covered in "
                      "tests/test_numpy_ns.py::test_np_linalg_multioutput",

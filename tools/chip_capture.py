@@ -58,6 +58,8 @@ QUEUE = [
     ("bench_functional", [sys.executable, "bench.py"],
      {"BENCH": "functional"}, 1800),
     ("bench_fused", [sys.executable, "bench.py"], {"BENCH": "fused"}, 1800),
+    ("bench_fused_train", [sys.executable, "bench.py"],
+     {"BENCH": "fused_train"}, 1800),
     ("longcontext", [sys.executable, "tools/longcontext_probe.py"], {},
      3900),
     ("tpu_suite", [sys.executable, "-m", "pytest", "tests/", "-q"],
