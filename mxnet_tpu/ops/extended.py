@@ -13,6 +13,8 @@ the reference's call signatures line up.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as _np
 
 import jax
@@ -104,13 +106,43 @@ def _cast_storage(data, stype=None):
     return data
 
 
+def _kl_sparse_reg_make():
+    """reference: identity_attach_KL_sparse_reg.cc — identity forward;
+    the backward ADDS the KL(rho || rho_hat) sparsity-penalty gradient
+    (rho_hat = batch-mean activation per unit) to the incoming cotangent.
+    Like the SoftmaxOutput family, this backward is deliberately NOT the
+    vjp of the forward. The reference's momentum running average of
+    rho_hat is an engine aux state; the pure-op form uses the batch mean
+    (momentum accepted for API parity)."""
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+    def reg(data, target, penalty):
+        return data
+
+    def fwd(data, target, penalty):
+        rho_hat = jnp.clip(jnp.mean(data.astype(jnp.float32), axis=0),
+                           1e-6, 1.0 - 1e-6)
+        return data, rho_hat
+
+    def bwd(target, penalty, rho_hat, g):
+        # batch size and dtype come off the cotangent (same shape/dtype
+        # as the identity output)
+        dkl = -target / rho_hat + (1.0 - target) / (1.0 - rho_hat)
+        grad = g.astype(jnp.float32) + penalty * dkl[None] / g.shape[0]
+        return (grad.astype(g.dtype),)
+
+    reg.defvjp(fwd, bwd)
+    return reg
+
+
+_kl_reg_core = _kl_sparse_reg_make()
+
+
 @register("IdentityAttachKLSparseReg")
 def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
                                    penalty=0.001, momentum=0.9):
-    """Identity forward; the reference attaches a KL sparsity penalty to
-    the backward pass (identity_attach_KL_sparse_reg.cc). The penalty
-    gradient is added via a custom VJP on the mean activation."""
-    return data
+    """Identity forward; backward attaches the KL sparsity-penalty
+    gradient (see _kl_sparse_reg_make)."""
+    return _kl_reg_core(data, float(sparseness_target), float(penalty))
 
 
 # ---------------------------------------------------------------------------

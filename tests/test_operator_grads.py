@@ -194,3 +194,26 @@ def test_regression_output_gradient_tracks_weights():
         w[:] = w + 1.0
     assert np.abs(grads[1] - grads[0]).max() > 0.1, (
         "LinearRegressionOutput gradient did not track the weights")
+
+
+def test_identity_attach_kl_sparse_reg_gradient():
+    """Backward = cotangent + penalty * dKL/drho_hat / batch (reference:
+    identity_attach_KL_sparse_reg.cc), NOT the identity vjp."""
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.ndarray.ndarray import invoke
+    rng = np.random.RandomState(9)
+    d = rng.uniform(0.1, 0.9, (4, 5)).astype("float32")
+    target, penalty = 0.2, 0.05
+    x = nd.array(d)
+    x.attach_grad()
+    with autograd.record():
+        out = invoke("IdentityAttachKLSparseReg", x,
+                     sparseness_target=target, penalty=penalty)
+        loss = out.sum()
+    loss.backward()
+    rho = np.clip(d.mean(axis=0), 1e-6, 1 - 1e-6)
+    dkl = -target / rho + (1 - target) / (1 - rho)
+    want = 1.0 + penalty * dkl[None] / d.shape[0]
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.broadcast_to(want, d.shape),
+                               rtol=1e-5, atol=1e-6)
