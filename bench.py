@@ -456,6 +456,15 @@ def main():
     elif which == "gluon_nhwc":
         img_s, path = bench_gluon(on_accel, layout="NHWC")
         path = "gluon_nhwc"
+    elif which == "gluon_fused":
+        # the full headline model with the TRAINING-form fused
+        # conv+BN+ReLU blocks in every bottleneck (ROOFLINE round-5)
+        os.environ["MXNET_TPU_FUSED_CONVBN"] = "1"
+        os.environ.setdefault("MXNET_TPU_USE_PALLAS", "1")
+        if not on_accel:
+            os.environ.setdefault("MXNET_FLASH_INTERPRET", "1")
+        img_s, path = bench_gluon(on_accel, layout="NHWC")
+        path = "gluon_fused"
     else:
         # the chip-capture watcher promotes NHWC to the headline default
         # once a live window showed it clears the bar AND beats NCHW

@@ -279,3 +279,58 @@ def test_gluon_train_block_matches_composed_chain():
     y_ce = nd.relu(bn(conv(xb)))
     onp.testing.assert_allclose(y_fe.asnumpy(), y_ce.asnumpy(), atol=5e-4,
                                 rtol=2e-3)
+
+
+def test_zoo_resnet50_fused_convbn_gate(monkeypatch):
+    """MXNET_TPU_FUSED_CONVBN=1 + layout=NHWC swaps every bottleneck's
+    interior conv3x3+BN+relu for FusedConvBNReLUTrain; the model still
+    builds, trains one step, and updates running stats."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.contrib.cnn import FusedConvBNReLUTrain
+
+    monkeypatch.setenv("MXNET_TPU_FUSED_CONVBN", "1")
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=10, layout="NHWC")
+    fused_blocks = [b for b in net.collect_params().keys()
+                    if "fusedconvbnrelutrain" in b.lower()]
+    # resnet50 has 16 bottlenecks -> 16 fused interior convs
+    blocks = []
+
+    def walk(blk):
+        for c in blk._children.values():
+            if isinstance(c, FusedConvBNReLUTrain):
+                blocks.append(c)
+            walk(c)
+    walk(net)
+    assert len(blocks) == 16, "expected 16 fused bottleneck interiors, " \
+        "found %d" % len(blocks)
+
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(1)
+    x = nd.array(rng.randn(2, 32, 32, 3).astype("float32"))
+    y = nd.array(rng.randint(0, 10, (2,)).astype("float32"))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = sce(net(x), y).mean()
+    loss.backward()
+    tr.step(2)
+    assert onp.isfinite(loss.asnumpy()).all()
+    rm = blocks[0].running_mean.data().asnumpy()
+    assert onp.abs(rm).max() > 0, "fused block never updated running stats"
+    # eval path (folded kernel) still runs
+    out = net(x)
+    assert out.shape == (2, 10)
+
+
+def test_zoo_resnet50_gate_off_unchanged(monkeypatch):
+    """Without the gate the zoo model keeps the composed triple (param
+    names stay checkpoint-compatible)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    monkeypatch.delenv("MXNET_TPU_FUSED_CONVBN", raising=False)
+    net = vision.resnet50_v1(classes=10, layout="NHWC")
+    names = " ".join(net.collect_params().keys())
+    assert "fusedconvbnrelutrain" not in names.lower()

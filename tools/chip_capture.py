@@ -60,6 +60,8 @@ QUEUE = [
     ("bench_fused", [sys.executable, "bench.py"], {"BENCH": "fused"}, 1800),
     ("bench_fused_train", [sys.executable, "bench.py"],
      {"BENCH": "fused_train"}, 1800),
+    ("bench_gluon_fused", [sys.executable, "bench.py"],
+     {"BENCH": "gluon_fused"}, 2400),
     ("longcontext", [sys.executable, "tools/longcontext_probe.py"], {},
      3900),
     ("tpu_suite", [sys.executable, "-m", "pytest", "tests/", "-q"],
