@@ -96,7 +96,11 @@ _moments_op.num_outputs = 2
 
 @register("make_loss")
 def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
-    return data
+    # same semantics as the capitalized op (nn.py MakeLoss), incl. the
+    # grad_scale/normalization backward
+    return get("MakeLoss").fn(data, grad_scale=grad_scale,
+                              valid_thresh=valid_thresh,
+                              normalization=normalization)
 
 
 @register("cast_storage", differentiable=False)

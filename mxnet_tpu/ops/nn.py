@@ -502,9 +502,47 @@ _regression_output_make("LogisticRegressionOutput", jax.nn.sigmoid,
                         lambda out, d, lab: out - lab)
 
 
+def _make_loss_core_make():
+    """Identity forward; backward scales the cotangent by grad_scale with
+    the reference's normalization modes (make_loss.cc): 'batch' divides
+    by the batch dim, 'valid' by the count of elements whose magnitude
+    exceeds valid_thresh."""
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+    def f(data, grad_scale, valid_thresh, normalization):
+        return data
+
+    def fwd(data, grad_scale, valid_thresh, normalization):
+        valid = None
+        if normalization == "valid":
+            valid = jnp.maximum(jnp.sum(
+                (jnp.abs(data.astype(jnp.float32)) > valid_thresh)
+                .astype(jnp.float32)), 1.0)
+        return data, valid
+
+    def bwd(grad_scale, valid_thresh, normalization, valid, g):
+        gs = grad_scale
+        if normalization == "batch":
+            gs = gs / g.shape[0]
+        grad = g * gs
+        if valid is not None:
+            grad = grad / valid.astype(g.dtype)
+        return (grad,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_make_loss_core = _make_loss_core_make()
+
+
 @register("MakeLoss")
 def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
-    return data
+    if float(grad_scale) == 1.0 and normalization == "null":
+        return data
+    return _make_loss_core(data, float(grad_scale), float(valid_thresh),
+                           str(normalization))
 
 
 # ---------------------------------------------------------------------------

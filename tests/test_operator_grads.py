@@ -217,3 +217,35 @@ def test_identity_attach_kl_sparse_reg_gradient():
     np.testing.assert_allclose(x.grad.asnumpy(),
                                np.broadcast_to(want, d.shape),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_make_loss_grad_scale_and_normalization():
+    """MakeLoss backward = grad_scale (per normalization mode), not the
+    plain identity vjp (reference: make_loss.cc)."""
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.ndarray.ndarray import invoke
+    d = np.array([[0.5, 0.0], [2.0, 0.0]], "float32")
+    for name in ("MakeLoss", "make_loss"):
+        x = nd.array(d)
+        x.attach_grad()
+        with autograd.record():
+            out = invoke(name, x, grad_scale=3.0)
+            out.sum().backward()
+        np.testing.assert_allclose(x.grad.asnumpy(),
+                                   np.full_like(d, 3.0), rtol=1e-6)
+        # batch normalization divides by N
+        x2 = nd.array(d)
+        x2.attach_grad()
+        with autograd.record():
+            invoke(name, x2, grad_scale=3.0,
+                   normalization="batch").sum().backward()
+        np.testing.assert_allclose(x2.grad.asnumpy(),
+                                   np.full_like(d, 1.5), rtol=1e-6)
+        # valid: 2 elements above thresh 0.1
+        x3 = nd.array(d)
+        x3.attach_grad()
+        with autograd.record():
+            invoke(name, x3, grad_scale=4.0, valid_thresh=0.1,
+                   normalization="valid").sum().backward()
+        np.testing.assert_allclose(x3.grad.asnumpy(),
+                                   np.full_like(d, 2.0), rtol=1e-6)
