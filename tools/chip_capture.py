@@ -111,11 +111,21 @@ def run_killable(cmd, env_extra, timeout, out_path, err_path):
     return rc, timed_out
 
 
-def probe(timeout=90):
-    """True if the accelerator backend answers within `timeout`."""
+def probe(timeout=150):
+    """True if the accelerator backend EXECUTES within `timeout`.
+
+    `jax.devices()` alone is not enough: the tunnel has a failure mode
+    where the control plane answers but the data plane hangs (observed
+    2026-07-31: devices() returned in 3s, then the first real dispatch
+    blocked >35 min with zero CPU).  The probe therefore runs a tiny
+    computation and forces a D2H readback — MXNet `.asnumpy()`
+    semantics, the same hard barrier bench.py syncs through."""
     proc = subprocess.Popen(
         [sys.executable, "-c",
-         "import jax; d = jax.devices()[0]; print('LIVE', d.platform)"],
+         "import jax, jax.numpy as jnp, numpy as np;"
+         " d = jax.devices()[0];"
+         " v = float(np.asarray(jnp.arange(8.0) + 1.0).sum());"
+         " print('LIVE', d.platform, v)"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         start_new_session=True, cwd=REPO)
     try:
