@@ -24,7 +24,63 @@ import jax.numpy as jnp
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variable", "record_op", "backward", "grad",
-           "set_recording", "set_training", "Function"]
+           "set_recording", "set_training", "Function", "RowSparseRows"]
+
+
+class RowSparseRows:
+    """A row-sparse cotangent: (indices, values) rows of a dense-shaped
+    gradient, carried through the tape WITHOUT densifying.
+
+    Produced by ops whose weight-gradient is naturally row-sparse —
+    `Embedding(sparse_grad=True)` (reference: indexing_op.cc
+    EmbeddingOpBackward rowsparse kernel). Indices may repeat (one entry
+    per lookup position); they are deduplicated/summed only at the leaf
+    (`_canonical_rows`), the analog of the reference's sorted-unique
+    row_sparse invariant being restored by the backward kernel."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices      # (n,) int32, possibly duplicated
+        self.values = values        # (n, *row_shape)
+        self.shape = tuple(shape)   # full dense shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def astype(self, dtype):
+        return RowSparseRows(self.indices, self.values.astype(dtype),
+                             self.shape)
+
+    def densify(self):
+        out = jnp.zeros(self.shape, dtype=self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+
+def _canonical_rows(cot, extra_indices=None, extra_values=None):
+    """Sorted-unique (indices, values) from a RowSparseRows cotangent,
+    optionally merged with an existing grad's rows (grad_req='add').
+
+    The unique runs on host (one small int32 D2H per sparse param per
+    backward). Deliberate tradeoff: every downstream consumer of a
+    row_sparse grad (optimizer lazy scatter, kvstore row-union) requires
+    sorted-unique IN-BOUNDS indices, and jnp.unique's static-size padding
+    can only pad with an in-range index — which those scatter consumers
+    would treat as a real (conflicting) row. The values never leave the
+    device; the reference's python row_sparse_pull path does the same
+    host-side unique on row ids."""
+    import numpy as _np
+    idx = cot.indices
+    vals = cot.values
+    if extra_indices is not None and extra_indices.shape[0]:
+        idx = jnp.concatenate([idx, extra_indices.astype(jnp.int32)])
+        vals = jnp.concatenate([vals, extra_values.astype(vals.dtype)])
+    idx_np = _np.asarray(jax.device_get(idx))
+    uniq, inv = _np.unique(idx_np, return_inverse=True)
+    summed = jnp.zeros((uniq.shape[0],) + vals.shape[1:],
+                       dtype=vals.dtype).at[jnp.asarray(inv)].add(vals)
+    return jnp.asarray(uniq, dtype=jnp.int32), summed
 
 _state = threading.local()
 
@@ -199,13 +255,22 @@ def _run_backward(heads, head_grads, retain_graph, want_ids=None):
 def _acc(acc, nd, cot):
     k = id(nd)
     if k in acc:
-        acc[k] = (nd, acc[k][1] + cot)
+        acc[k] = (nd, _add_maybe(acc[k][1], cot))
     else:
         acc[k] = (nd, cot)
 
 
 def _add_maybe(a, b):
-    return b if a is None else a + b
+    if a is None:
+        return b
+    if isinstance(a, RowSparseRows) or isinstance(b, RowSparseRows):
+        if isinstance(a, RowSparseRows) and isinstance(b, RowSparseRows):
+            return RowSparseRows(
+                jnp.concatenate([a.indices, b.indices]),
+                jnp.concatenate([a.values, b.values]), a.shape)
+        a = a.densify() if isinstance(a, RowSparseRows) else a
+        b = b.densify() if isinstance(b, RowSparseRows) else b
+    return a + b
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
@@ -217,6 +282,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         head_grads = [None] * len(heads)
     head_grads = [g._read() if hasattr(g, "_read") else g for g in head_grads]
     leaf_acc = _run_backward(list(heads), head_grads, retain_graph)
+    from .ndarray.sparse import RowSparseNDArray
     for _, (nd_var, cot) in leaf_acc.items():
         if nd_var._grad_req == "null":
             continue
@@ -224,10 +290,25 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             from .ndarray.ndarray import zeros
             nd_var._grad = zeros(nd_var.shape, ctx=nd_var._ctx,
                                  dtype=nd_var.dtype)
+        grad_buf = nd_var._grad
+        if isinstance(cot, RowSparseRows):
+            if isinstance(grad_buf, RowSparseNDArray):
+                # keep the gradient row-sparse end to end (reference:
+                # Embedding sparse_grad -> row_sparse grad NDArray)
+                if nd_var._grad_req == "add":
+                    idx, vals = _canonical_rows(
+                        cot.astype(nd_var.dtype),
+                        extra_indices=grad_buf._indices,
+                        extra_values=grad_buf._values)
+                else:
+                    idx, vals = _canonical_rows(cot.astype(nd_var.dtype))
+                grad_buf._set_rows(vals, idx)
+                continue
+            cot = cot.densify()  # dense grad buffer: collapse
         if nd_var._grad_req == "add":
-            nd_var._grad._write(nd_var._grad._read() + cot.astype(nd_var.dtype))
+            grad_buf._write(grad_buf._read() + cot.astype(nd_var.dtype))
         else:
-            nd_var._grad._write(cot.astype(nd_var.dtype))
+            grad_buf._write(cot.astype(nd_var.dtype))
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
@@ -258,7 +339,14 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     for v in variables:
         k = id(v)
         if k in acc:
-            outs.append(NDArray(acc[k][1].astype(v.dtype), ctx=v._ctx))
+            cot = acc[k][1]
+            if isinstance(cot, RowSparseRows):
+                from .ndarray.sparse import RowSparseNDArray
+                idx, vals = _canonical_rows(cot.astype(v.dtype))
+                outs.append(RowSparseNDArray(vals, idx, cot.shape,
+                                             ctx=v._ctx))
+            else:
+                outs.append(NDArray(cot.astype(v.dtype), ctx=v._ctx))
         else:
             outs.append(zeros(v.shape, ctx=v._ctx, dtype=v.dtype))
     return outs[0] if single else outs

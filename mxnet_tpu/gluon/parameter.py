@@ -106,6 +106,10 @@ class Parameter:
     def stype(self):
         return self._stype
 
+    @property
+    def grad_stype(self):
+        return self._grad_stype
+
     # ------------------------------------------------------------------
     def _check_and_get(self, arr_dict, ctx):
         if arr_dict is not None:
@@ -216,7 +220,14 @@ class Parameter:
             return
         self._grad = OrderedDict()
         for ctx, d in self._data.items():
-            g = nd.zeros(d.shape, dtype=d.dtype, ctx=ctx)
+            if self._grad_stype == "row_sparse":
+                # the grad buffer itself is row_sparse (reference:
+                # Parameter._init_grad allocates grad with grad_stype);
+                # backward() fills it with the touched rows only
+                from ..ndarray import sparse as _sp
+                g = _sp.zeros("row_sparse", d.shape, ctx=ctx, dtype=d.dtype)
+            else:
+                g = nd.zeros(d.shape, dtype=d.dtype, ctx=ctx)
             self._grad[ctx] = g
             d._grad = g
             d._grad_req = self.grad_req
@@ -369,8 +380,16 @@ class Parameter:
         """reference: Parameter.zero_grad."""
         if self._grad is None:
             return
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
         for g in self._grad.values():
-            g._write(g._read() * 0)
+            if isinstance(g, RowSparseNDArray):
+                # zero row_sparse = no rows (reference: rsp zeros)
+                g._set_rows(
+                    jnp.zeros((0,) + g.shape[1:], dtype=g._values.dtype),
+                    jnp.zeros((0,), dtype=jnp.int32))
+            else:
+                g._write(g._read() * 0)
 
     def var(self):
         """Symbolic variable for this parameter (used in hybridize traces).

@@ -243,9 +243,15 @@ class NDArray:
     # ------------------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         """Mark this array as requiring gradient (reference:
-        Imperative::MarkVariables)."""
+        Imperative::MarkVariables). `stype='row_sparse'` allocates a
+        row-sparse grad buffer (reference: attach_grad stype arg)."""
         from .. import autograd
-        self._grad = zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
+        if stype == "row_sparse":
+            from . import sparse as _sp
+            self._grad = _sp.zeros("row_sparse", self.shape, ctx=self._ctx,
+                                   dtype=self.dtype)
+        else:
+            self._grad = zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
         self._grad_req = grad_req
         autograd.mark_variable(self, grad_req)
 
@@ -622,18 +628,30 @@ def _invoke(op_name, *args, out=None, **kwargs):
 
     try:
         if recording:
-            def closed(*arrs):
-                full = list(raw_args)
-                for p, a in zip(nd_positions, arrs):
-                    full[p] = a
-                return fn(*full, **kwargs)
-            inputs_raw = [raw_args[p] for p in nd_positions]
-            out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
-            outputs = _wrap_out(out_raw, ctx)
-            autograd.record_op(op_name, [args[p] for p in nd_positions],
-                               outputs if isinstance(outputs, list)
-                               else [outputs],
-                               vjp_fn, primal_fn=closed)
+            nd_inputs = [args[p] for p in nd_positions]
+            override = None
+            if op.record_override is not None:
+                override = op.record_override(raw_args, kwargs, nd_inputs, fn)
+            if override is not None:
+                out_raw, vjp_fn, primal = override
+                outputs = _wrap_out(out_raw, ctx)
+                autograd.record_op(op_name, nd_inputs,
+                                   outputs if isinstance(outputs, list)
+                                   else [outputs],
+                                   vjp_fn, primal_fn=primal)
+            else:
+                def closed(*arrs):
+                    full = list(raw_args)
+                    for p, a in zip(nd_positions, arrs):
+                        full[p] = a
+                    return fn(*full, **kwargs)
+                inputs_raw = [raw_args[p] for p in nd_positions]
+                out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
+                outputs = _wrap_out(out_raw, ctx)
+                autograd.record_op(op_name, nd_inputs,
+                                   outputs if isinstance(outputs, list)
+                                   else [outputs],
+                                   vjp_fn, primal_fn=closed)
         else:
             out_raw = fn(*raw_args, **kwargs)
             outputs = _wrap_out(out_raw, ctx)

@@ -65,6 +65,12 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._indices = jnp.arange(self._shape_full[0], dtype=jnp.int32)
         self._values = value
 
+    def _set_rows(self, values, indices):
+        """Replace the stored rows (buffer swap — the sparse analog of the
+        dense NDArray's `_write`). Indices must be sorted unique."""
+        self._values = values
+        self._indices = indices
+
     def tostype(self, stype):
         return cast_storage(self, stype)
 

@@ -38,11 +38,24 @@ class Operator:
         self.shape_hint = None        # fn(in_shapes, kwargs) -> in_shapes
         #   fills unknown (None) input shapes from known ones — the forward
         #   half of the reference's bidirectional FInferShape
+        self.record_override = None   # optional custom tape recording:
+        #   f(raw_args, kwargs, nd_inputs, fn) -> (out_raw, vjp_fn,
+        #   primal_fn) or None to fall back to the generic jax.vjp path.
+        #   `fn` is the already-resolved forward (tpu_impl/AMP applied) —
+        #   overrides must compute the output through it so specialization
+        #   is never bypassed. The hook for ops whose gradient has
+        #   non-dense structure (the FGradient-with-FInferStorageType
+        #   analog: Embedding sparse_grad -> rowsparse).
 
     def tpu_impl(self, fn):
         """Register a TPU-specialized (Pallas) implementation.
         The FCompute<tpu> hook of the north star (BASELINE.json)."""
         self.tpu_fn = fn
+        return fn
+
+    def recorder(self, fn):
+        """Register a custom tape-recording path (see record_override)."""
+        self.record_override = fn
         return fn
 
     def best_fn(self, on_tpu):

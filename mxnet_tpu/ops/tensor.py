@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, alias
+from .registry import register, alias, get as _get_op
 from ..base import get_env, np_dtype
 
 _f32 = jnp.float32
@@ -620,8 +620,47 @@ def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
 def _embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
                sparse_grad=False):
     """reference: indexing_op.cc (Embedding). On TPU an embedding lookup is a
-    gather; sparse_grad maps to the rowsparse path in ops/sparse.py."""
+    gather; sparse_grad records a row-sparse cotangent (recorder below)."""
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@_get_op("Embedding").recorder
+def _embedding_recorder(raw_args, kwargs, nd_inputs, fn):
+    """sparse_grad=True: the weight-gradient is recorded as (indices, values)
+    rows and never densified on its way to the leaf (reference:
+    indexing_op.cc EmbeddingOpBackward rowsparse kernel; the grad NDArray the
+    user sees is kRowSparseStorage). Falls back to the generic dense vjp when
+    tracing (hybridize), when the weight is itself an op output, or when
+    data/weight are not plain NDArray inputs at positions 0/1."""
+    if not kwargs.get("sparse_grad"):
+        return None
+    if len(raw_args) < 2 or len(nd_inputs) != 2:
+        return None
+    data, weight = raw_args[0], raw_args[1]
+    # both inputs must be the NDArrays at positions 0/1 (a numpy `data`
+    # arg shifts nd_inputs and the tape would mis-route cotangents)
+    if nd_inputs[0]._read() is not data or nd_inputs[1]._read() is not weight:
+        return None
+    if isinstance(data, jax.core.Tracer) or isinstance(weight, jax.core.Tracer):
+        return None
+    if nd_inputs[1]._autograd_node is not None:
+        return None
+
+    def primal(d, w):
+        # the resolved forward (tpu_impl / AMP applied) — never bypass it
+        return fn(d, w, **kwargs)
+
+    out = primal(data, weight)
+    flat_idx = data.astype(jnp.int32).reshape(-1)
+    row_shape = weight.shape[1:]
+    w_shape = weight.shape
+
+    def vjp_fn(cot):
+        from .. import autograd as _ag
+        vals = cot.reshape((-1,) + row_shape).astype(weight.dtype)
+        return (None, _ag.RowSparseRows(flat_idx, vals, w_shape))
+
+    return out, vjp_fn, primal
 
 
 @register("take_along_axis")

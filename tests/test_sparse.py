@@ -212,3 +212,106 @@ def test_factorization_machine_end_to_end(tmp_path):
             count += 1
         losses.append(total / count)
     assert losses[-1] < 0.55 * losses[0], losses
+
+# ---------------------------------------------------------------------------
+# Embedding(sparse_grad=True): the gradient is row_sparse end to end
+# (reference: indexing_op.cc EmbeddingOpBackward rowsparse kernel;
+#  python/mxnet/gluon/nn/basic_layers.py Embedding(sparse_grad))
+# ---------------------------------------------------------------------------
+def _make_emb(sparse, rows=12, dim=3):
+    from mxnet_tpu.gluon import nn
+    emb = nn.Embedding(rows, dim, sparse_grad=sparse)
+    emb.initialize(mx.init.Constant(0.5))
+    return emb
+
+
+def test_embedding_sparse_grad_rows_and_values():
+    emb_s, emb_d = _make_emb(True), _make_emb(False)
+    idx = nd.array(np.array([3, 7, 3, 1]), dtype="int32")
+    head = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    for emb in (emb_s, emb_d):
+        with autograd.record():
+            out = (emb(idx) * head).sum()
+        out.backward()
+    gs, gd = emb_s.weight.grad(), emb_d.weight.grad()
+    assert gs.stype == "row_sparse" and gd.stype == "default"
+    # touched rows only, sorted unique; duplicate lookups (row 3) summed
+    np.testing.assert_array_equal(np.asarray(gs._indices), [1, 3, 7])
+    np.testing.assert_allclose(gs.asnumpy(), gd.asnumpy(), rtol=1e-6)
+
+
+def test_embedding_sparse_grad_add_accumulates_rows():
+    emb = _make_emb(True)
+    emb.weight.grad_req = "add"
+    for sel in ([0, 1], [1, 2]):
+        idx = nd.array(np.array(sel), dtype="int32")
+        with autograd.record():
+            loss = emb(idx).sum()
+        loss.backward()
+    g = emb.weight.grad()
+    assert g.stype == "row_sparse"
+    np.testing.assert_array_equal(np.asarray(g._indices), [0, 1, 2])
+    dense = g.asnumpy()
+    np.testing.assert_allclose(dense[1], 2.0 * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(dense[0], np.ones(3), rtol=1e-6)
+    emb.weight.zero_grad()
+    assert emb.weight.grad()._indices.shape[0] == 0
+
+
+def test_embedding_sparse_grad_autograd_grad():
+    emb = _make_emb(True)
+    idx = nd.array(np.array([2, 5]), dtype="int32")
+    w = emb.weight.data()
+    with autograd.record():
+        loss = emb(idx).sum()
+    g = autograd.grad([loss], [w])[0]
+    assert g.stype == "row_sparse"
+    np.testing.assert_array_equal(np.asarray(g._indices), [2, 5])
+
+
+def test_embedding_sparse_grad_lazy_momentum_untouched_rows():
+    """lazy_update: momentum/weight of rows ABSENT from a batch must not
+    move — including rows with nonzero momentum from an earlier step,
+    which a dense sgd_mom_update would keep decaying (reference:
+    rowsparse sgd_mom_update kernels)."""
+    from mxnet_tpu import gluon
+    emb = _make_emb(True)
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.9,
+                             "lazy_update": True})
+
+    def step(rows):
+        idx = nd.array(np.array(rows), dtype="int32")
+        with autograd.record():
+            loss = emb(idx).sum()
+        loss.backward()
+        trainer.step(1)
+
+    # step 1 builds nonzero momentum on rows {4, 9}
+    step([4, 9])
+    after1 = emb.weight.data().asnumpy().copy()
+    changed1 = np.where(np.abs(after1 - 0.5).sum(axis=1) > 0)[0]
+    np.testing.assert_array_equal(changed1, [4, 9])
+    # step 2 touches only row 1: rows 4/9 must NOT move even though their
+    # momentum is nonzero (the dense path would apply momentum decay)
+    step([1])
+    after2 = emb.weight.data().asnumpy()
+    moved = np.where(np.abs(after2 - after1).sum(axis=1) > 0)[0]
+    np.testing.assert_array_equal(moved, [1])
+    np.testing.assert_allclose(after2[4], after1[4])
+    np.testing.assert_allclose(after2[9], after1[9])
+
+
+def test_embedding_sparse_grad_hybridized_falls_back_dense_values():
+    """Under hybridize the whole block is one traced program; the grad
+    buffer stays row_sparse but is filled via the dense path — values must
+    still match the eager dense reference."""
+    emb_h, emb_d = _make_emb(True), _make_emb(False)
+    emb_h.hybridize()
+    idx = nd.array(np.array([0, 6, 6]), dtype="int32")
+    for emb in (emb_h, emb_d):
+        with autograd.record():
+            out = emb(idx).sum()
+        out.backward()
+    np.testing.assert_allclose(emb_h.weight.grad().asnumpy(),
+                               emb_d.weight.grad().asnumpy(), rtol=1e-6)
