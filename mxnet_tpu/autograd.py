@@ -62,25 +62,19 @@ def _canonical_rows(cot, extra_indices=None, extra_values=None):
     """Sorted-unique (indices, values) from a RowSparseRows cotangent,
     optionally merged with an existing grad's rows (grad_req='add').
 
-    The unique runs on host (one small int32 D2H per sparse param per
-    backward). Deliberate tradeoff: every downstream consumer of a
-    row_sparse grad (optimizer lazy scatter, kvstore row-union) requires
-    sorted-unique IN-BOUNDS indices, and jnp.unique's static-size padding
-    can only pad with an in-range index — which those scatter consumers
-    would treat as a real (conflicting) row. The values never leave the
-    device; the reference's python row_sparse_pull path does the same
-    host-side unique on row ids."""
-    import numpy as _np
+    Deliberate tradeoff inside `merge_rows`: the unique runs on host
+    because every downstream consumer of a row_sparse grad (optimizer
+    lazy scatter, kvstore row-union) requires sorted-unique IN-BOUNDS
+    indices, and jnp.unique's static-size padding can only pad with an
+    in-range index — which those scatter consumers would treat as a real
+    (conflicting) row."""
+    from .ndarray.sparse import merge_rows
     idx = cot.indices
     vals = cot.values
     if extra_indices is not None and extra_indices.shape[0]:
         idx = jnp.concatenate([idx, extra_indices.astype(jnp.int32)])
         vals = jnp.concatenate([vals, extra_values.astype(vals.dtype)])
-    idx_np = _np.asarray(jax.device_get(idx))
-    uniq, inv = _np.unique(idx_np, return_inverse=True)
-    summed = jnp.zeros((uniq.shape[0],) + vals.shape[1:],
-                       dtype=vals.dtype).at[jnp.asarray(inv)].add(vals)
-    return jnp.asarray(uniq, dtype=jnp.int32), summed
+    return merge_rows(idx, vals)
 
 _state = threading.local()
 

@@ -323,6 +323,10 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
             orig, probe = make_probe(path, child)
             originals[path] = orig
             child.forward = probe
+        if isinstance(calib_data, (nd.NDArray, _np.ndarray)):
+            # a bare array is ONE calibration batch — iterating it would
+            # feed per-sample (ndim-1) slices into the net
+            calib_data = [calib_data]
         try:
             seen = 0
             for batch in calib_data:

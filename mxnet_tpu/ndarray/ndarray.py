@@ -634,11 +634,6 @@ def _invoke(op_name, *args, out=None, **kwargs):
                 override = op.record_override(raw_args, kwargs, nd_inputs, fn)
             if override is not None:
                 out_raw, vjp_fn, primal = override
-                outputs = _wrap_out(out_raw, ctx)
-                autograd.record_op(op_name, nd_inputs,
-                                   outputs if isinstance(outputs, list)
-                                   else [outputs],
-                                   vjp_fn, primal_fn=primal)
             else:
                 def closed(*arrs):
                     full = list(raw_args)
@@ -647,11 +642,12 @@ def _invoke(op_name, *args, out=None, **kwargs):
                     return fn(*full, **kwargs)
                 inputs_raw = [raw_args[p] for p in nd_positions]
                 out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
-                outputs = _wrap_out(out_raw, ctx)
-                autograd.record_op(op_name, nd_inputs,
-                                   outputs if isinstance(outputs, list)
-                                   else [outputs],
-                                   vjp_fn, primal_fn=closed)
+                primal = closed
+            outputs = _wrap_out(out_raw, ctx)
+            autograd.record_op(op_name, nd_inputs,
+                               outputs if isinstance(outputs, list)
+                               else [outputs],
+                               vjp_fn, primal_fn=primal)
         else:
             out_raw = fn(*raw_args, **kwargs)
             outputs = _wrap_out(out_raw, ctx)

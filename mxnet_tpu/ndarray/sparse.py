@@ -258,17 +258,27 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
                   transpose_b=transpose_b)
 
 
+def merge_rows(indices, values):
+    """Canonicalize raw (indices, values) rows into the row_sparse
+    invariant: indices sorted unique, duplicate rows summed. The unique
+    runs on host (one small int32 D2H — the reference's python
+    row_sparse_pull does the same host-side unique on row ids); the
+    values never leave the device."""
+    uniq, inv = _np.unique(_np.asarray(jax.device_get(indices)),
+                           return_inverse=True)
+    summed = jax.ops.segment_sum(values, jnp.asarray(inv),
+                                 num_segments=len(uniq))
+    return jnp.asarray(uniq.astype(_np.int32)), summed
+
+
 def elemwise_add(a, b):
     if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
         if a.shape != b.shape:
             raise ValueError("shape mismatch")
-        idx = jnp.concatenate([a._indices, b._indices])
-        vals = jnp.concatenate([a._values, b._values])
-        uniq, inv = _np.unique(_np.asarray(idx), return_inverse=True)
-        summed = jax.ops.segment_sum(vals, jnp.asarray(inv),
-                                     num_segments=len(uniq))
-        return RowSparseNDArray(summed, jnp.asarray(uniq.astype(_np.int32)),
-                                a.shape, ctx=a._ctx)
+        idx, summed = merge_rows(
+            jnp.concatenate([a._indices, b._indices]),
+            jnp.concatenate([a._values, b._values]))
+        return RowSparseNDArray(summed, idx, a.shape, ctx=a._ctx)
     return NDArray(a._read() + b._read(), ctx=a._ctx)
 
 

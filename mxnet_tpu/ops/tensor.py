@@ -651,14 +651,24 @@ def _embedding_recorder(raw_args, kwargs, nd_inputs, fn):
         return fn(d, w, **kwargs)
 
     out = primal(data, weight)
-    flat_idx = data.astype(jnp.int32).reshape(-1)
+    # jnp.take wraps negative lookups python-style and DROPS the cotangent
+    # of still-out-of-range ones; the sparse rows must mirror both or the
+    # grad diverges from the dense path (and the in-bounds invariant
+    # downstream scatters rely on breaks)
+    rows = weight.shape[0]
+    raw_idx = data.astype(jnp.int32).reshape(-1)
+    raw_idx = jnp.where(raw_idx < 0, raw_idx + rows, raw_idx)
+    valid = (raw_idx >= 0) & (raw_idx < rows)
+    flat_idx = jnp.clip(raw_idx, 0, rows - 1)
     row_shape = weight.shape[1:]
     w_shape = weight.shape
+    vmask = valid.reshape((-1,) + (1,) * len(row_shape))
 
     def vjp_fn(cot):
         from .. import autograd as _ag
         vals = cot.reshape((-1,) + row_shape).astype(weight.dtype)
-        return (None, _ag.RowSparseRows(flat_idx, vals, w_shape))
+        return (None, _ag.RowSparseRows(flat_idx, jnp.where(vmask, vals, 0),
+                                        w_shape))
 
     return out, vjp_fn, primal
 

@@ -315,3 +315,21 @@ def test_embedding_sparse_grad_hybridized_falls_back_dense_values():
         out.backward()
     np.testing.assert_allclose(emb_h.weight.grad().asnumpy(),
                                emb_d.weight.grad().asnumpy(), rtol=1e-6)
+
+
+def test_embedding_sparse_grad_clips_oob_like_dense():
+    """Out-of-range / negative lookups: jnp.take wraps negatives
+    python-style and drops the cotangent of OOB-high ones — the sparse
+    grad must land on exactly the same rows as the dense path."""
+    emb_s, emb_d = _make_emb(True), _make_emb(False)
+    idx = nd.array(np.array([-1, 3, 99]), dtype="int32")
+    for emb in (emb_s, emb_d):
+        with autograd.record():
+            loss = emb(idx).sum()
+        loss.backward()
+    gs = emb_s.weight.grad()
+    assert gs.stype == "row_sparse"
+    assert int(np.asarray(gs._indices).min()) >= 0
+    assert int(np.asarray(gs._indices).max()) < 12
+    np.testing.assert_allclose(gs.asnumpy(), emb_d.weight.grad().asnumpy(),
+                               rtol=1e-6)
