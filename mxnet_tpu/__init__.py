@@ -57,3 +57,10 @@ from . import native
 from . import numpy as np  # noqa: F401 — mx.np numpy-compat namespace
 from . import numpy_extension as npx
 from . import lr_scheduler as _lrs_alias  # noqa: F401
+
+# reference contract: a process launched with DMLC_ROLE=server becomes a
+# parameter server at import and never runs user training code
+# (python/mxnet/__init__.py -> kvstore_server._init_kvstore_server_module)
+from .kvstore.kvstore_server import _init_kvstore_server_module as _ks_init
+_ks_init()
+del _ks_init

@@ -5,5 +5,7 @@ from . import quantization
 from . import text
 from . import onnx
 from . import svrg_optimization
+from . import tensorboard
 
-__all__ = ["amp", "quantization", "text", "onnx", "svrg_optimization"]
+__all__ = ["amp", "quantization", "text", "onnx", "svrg_optimization",
+           "tensorboard"]

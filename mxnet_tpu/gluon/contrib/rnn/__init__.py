@@ -4,8 +4,14 @@ the shared cell surface."""
 from ...rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
                     BidirectionalCell, DropoutCell, ResidualCell,
                     ZoneoutCell, ModifierCell)
-from .rnn_cell import VariationalDropoutCell
+from .rnn_cell import VariationalDropoutCell, LSTMPCell
+from .conv_rnn_cell import (Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+                            Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+                            Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell)
 
 __all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
            "BidirectionalCell", "DropoutCell", "ResidualCell",
-           "ZoneoutCell", "VariationalDropoutCell"]
+           "ZoneoutCell", "VariationalDropoutCell", "LSTMPCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]

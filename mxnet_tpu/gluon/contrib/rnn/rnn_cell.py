@@ -1,7 +1,7 @@
 """reference: python/mxnet/gluon/contrib/rnn/rnn_cell.py."""
 from __future__ import annotations
 
-from ...rnn.rnn_cell import ModifierCell
+from ...rnn.rnn_cell import ModifierCell, HybridRecurrentCell
 from .... import ndarray as nd
 
 
@@ -48,3 +48,66 @@ class VariationalDropoutCell(ModifierCell):
                 self._output_mask = self._mask(self._drop_outputs, out)
             out = out * self._output_mask
         return out, next_states
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a projected recurrent state (LSTMP, Sak et al. 2014;
+    reference: contrib/rnn/rnn_cell.py LSTMPCell). The cell state keeps
+    `hidden_size` channels, but the output/recurrent state is projected
+    down to `projection_size` — the h2h matmul shrinks from h*4h to
+    p*4h, the classic speech-model trick. State order [r, c]."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def _shape_from_input(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        gi, gf, gg, go = F.split(gates, num_outputs=4, axis=-1)
+        in_gate = F.sigmoid(gi)
+        forget_gate = F.sigmoid(gf)
+        in_transform = gg.tanh()
+        out_gate = F.sigmoid(go)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        hidden = out_gate * next_c.tanh()
+        next_r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

@@ -340,3 +340,80 @@ class Load:
             if self.default_init is None:
                 raise ValueError("Cannot Initialize parameter %s" % name)
             self.default_init(name, arr)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the packed `sym.RNN` parameter vector (reference:
+    python/mxnet/initializer.py FusedRNN — there it round-trips through
+    the cuDNN packed layout; here the layout is the one
+    `ops/rnn_ops.py::_slice_params` defines: per layer/direction i2h then
+    h2h weights, then all biases in the same order).
+
+    `init` (an Initializer, a registered name, or None) is applied to each
+    weight block; biases are zeroed except the LSTM forget gate, which
+    gets `forget_bias`."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = create(init)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn_ops import _gates, rnn_param_size
+        mode = {"rnn": "rnn_tanh"}.get(self._mode, self._mode)
+        ng = _gates(mode)
+        h = self._num_hidden
+        ndir = 2 if self._bidirectional else 1
+        L = self._num_layers
+        total = int(_np.prod(arr.shape))
+        # invert rnn_param_size for the input size, then validate with it
+        bias_total = L * ndir * 2 * ng * h
+        deeper = (L - 1) * ndir * ng * h * (h * ndir + h)
+        in_sz = (total - bias_total - deeper) // (ndir * ng * h) - h
+        if in_sz <= 0 or rnn_param_size(mode, in_sz, h, L,
+                                        self._bidirectional) != total:
+            raise ValueError(
+                "FusedRNN: cannot solve input size from a %d-element "
+                "parameter vector (mode=%s, %d hidden, %d layers)"
+                % (total, self._mode, h, L))
+        flat = _np.zeros((total,), dtype=_np.float32)
+        off = 0
+        name = str(desc)
+        for layer in range(L):
+            for d in range(ndir):
+                cur_in = in_sz if layer == 0 else h * ndir
+                for part, shape in (("i2h", (ng * h, cur_in)),
+                                    ("h2h", (ng * h, h))):
+                    n = int(_np.prod(shape))
+                    # init=None delegates each block to the net's global
+                    # initializer (reference: FusedRNN(None, ...) pattern)
+                    block_init = self._init or getattr(desc, "global_init",
+                                                       None)
+                    if block_init is not None:
+                        from . import ndarray as _nd
+                        block = _nd.zeros(shape, dtype="float32")
+                        block_init(
+                            InitDesc("%s_l%d%s_%s_weight"
+                                     % (name, layer, "_r" if d else "",
+                                        part),
+                                     getattr(desc, "attrs", None)), block)
+                        flat[off:off + n] = block.asnumpy().reshape(-1)
+                    off += n
+        # biases: zeros, except the LSTM forget gate (gate order [i,f,g,o])
+        if mode == "lstm" and self._forget_bias:
+            boff = off
+            for _ in range(L * ndir * 2):
+                flat[boff + h:boff + 2 * h] = self._forget_bias
+                boff += ng * h
+        arr[:] = flat.reshape(arr.shape)

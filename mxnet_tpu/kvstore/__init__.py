@@ -1,4 +1,5 @@
 """KVStore package. reference: python/mxnet/kvstore/__init__.py."""
 from .kvstore import KVStore, KVStoreLocal, create
+from . import kvstore_server  # noqa: F401 — server-role entry (reference: kvstore_server.py)
 
 __all__ = ["KVStore", "KVStoreLocal", "create"]

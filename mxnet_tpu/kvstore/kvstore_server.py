@@ -1,0 +1,58 @@
+"""Parameter-server process entry (reference: python/mxnet/
+kvstore_server.py — the server role's event loop; a process launched with
+DMLC_ROLE=server imports mxnet, enters `_init_kvstore_server_module()`,
+and never returns to user code).
+
+In this build the PS *semantics* (server-held state + server-side
+optimizer) live inside the SPMD program: `dist_sync` shards optimizer
+state over the worker mesh (kvstore/kvstore_dist.py), so there is no
+work for a dedicated server process to do. For launcher compatibility
+with scripts that still spawn `-s N` server roles, the entry mirrors the
+reference's contract — a server-role process does NOT run user training
+code — by exiting cleanly instead of looping forever.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """reference: kvstore_server.py (KVStoreServer). Holds the controller
+    callback surface; `run()` is the server event loop."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self):
+        def server_controller(cmd_id, cmd_body):
+            if not self.init_logging:
+                header = "%(asctime)-15s Server[" + str(
+                    self.kvstore.rank) + "]"
+                logging.basicConfig(level=logging.DEBUG,
+                                    format=header + " %(message)s")
+                self.init_logging = True
+        return server_controller
+
+    def run(self):
+        """The reference blocks here serving push/pull until shutdown.
+        PS state is SPMD-resident in this build — nothing to serve."""
+        logging.getLogger(__name__).info(
+            "kvstore server role: PS semantics are SPMD-resident on the "
+            "workers in this build; server process has nothing to serve "
+            "and exits cleanly")
+
+
+def _init_kvstore_server_module():
+    """Called at import when DMLC_ROLE=server (reference behavior: the
+    process becomes a server and never runs the training script)."""
+    is_worker = os.environ.get("DMLC_ROLE", "worker") == "worker"
+    if not is_worker:
+        KVStoreServer(None).run()
+        # mirror the reference's contract: a server process never falls
+        # through into user training code
+        sys.exit(0)

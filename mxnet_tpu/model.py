@@ -49,3 +49,162 @@ def load_checkpoint(prefix, epoch):
     symbol = sym.load("%s-symbol.json" % prefix)
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """The pre-Module training API (reference: model.py FeedForward —
+    deprecated there in favor of Module, kept for old scripts). This is a
+    thin veneer over `mx.mod.Module`: same constructor surface, `.fit`,
+    `.predict`, `.score`, `.save`/`.load`, `FeedForward.create`."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        import warnings
+        warnings.warn("FeedForward is deprecated. Use mx.mod.Module "
+                      "(reference deprecation carried over).",
+                      DeprecationWarning, stacklevel=2)
+        from . import initializer as _init
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else \
+            [ctx] if ctx is not None else None
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or _init.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _as_iter(self, X, y=None, shuffle=False):
+        from .io import NDArrayIter, DataIter
+        if isinstance(X, DataIter):
+            return X
+        return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                           shuffle=shuffle)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module import Module
+        # the reference shuffles numpy training input (_init_iter is_train)
+        train = self._as_iter(X, y, shuffle=True)
+        if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:
+            # reference _init_eval_iter: (X_val, y_val) pairs are wrapped
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        mod_kw = {"context": self.ctx}
+        if logger is not None:
+            mod_kw["logger"] = logger
+        if work_load_list is not None:
+            mod_kw["work_load_list"] = work_load_list
+        self._module = Module(self.symbol, **mod_kw)
+        self._module.fit(
+            train, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=self.kwargs or {},
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+            monitor=monitor,
+            initializer=self.initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            allow_missing=self.allow_extra_params,
+            begin_epoch=self.begin_epoch,
+            num_epoch=self.num_epoch or 1)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+        from .module import Module
+        data = self._as_iter(X)
+        if reset and hasattr(data, "reset"):
+            data.reset()
+        if self.arg_params is None:
+            raise RuntimeError("call fit() or load() before predict()")
+        if self._module is None or not self._module.binded:
+            self._module = Module(self.symbol, context=self.ctx,
+                                  label_names=None)
+            self._module.bind(data.provide_data, for_training=False)
+            self._module.set_params(self.arg_params or {},
+                                    self.aux_params or {},
+                                    allow_missing=True)
+        outs, datas, labels = [], [], []
+        for i, batch in enumerate(data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self._module.forward(batch, is_train=False)
+            out = self._module.get_outputs()[0].asnumpy()
+            pad = getattr(batch, "pad", 0) or 0
+            outs.append(out[:out.shape[0] - pad])
+            if return_data:
+                datas.append(batch.data[0].asnumpy()[:out.shape[0] - pad])
+                labels.append(batch.label[0].asnumpy()[:out.shape[0] - pad]
+                              if batch.label else None)
+        preds = _np.concatenate(outs, axis=0)
+        if return_data:
+            return preds, _np.concatenate(datas, axis=0), (
+                _np.concatenate(labels, axis=0)
+                if labels and labels[0] is not None else None)
+        return preds
+
+    def score(self, X, eval_metric="acc", num_batch=None, reset=True,
+              **kwargs):
+        from . import metric as _metric
+        from .module import Module
+        data = self._as_iter(X)
+        if reset and hasattr(data, "reset"):
+            data.reset()
+        m = _metric.create(eval_metric)
+        if self.arg_params is None:
+            raise RuntimeError("call fit() or load() before score()")
+        if self._module is None:
+            self._module = Module(self.symbol, context=self.ctx)
+        self._module.bind(data.provide_data, data.provide_label,
+                          for_training=False, force_rebind=True)
+        self._module.set_params(self.arg_params or {},
+                                self.aux_params or {}, allow_missing=True)
+        for i, batch in enumerate(data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self._module.forward(batch, is_train=False)
+            m.update(batch.label, self._module.get_outputs())
+        return m.get()[1]
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Build and fit in one call (reference: FeedForward.create)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        return model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, logger=logger,
+                         work_load_list=work_load_list,
+                         eval_end_callback=eval_end_callback,
+                         eval_batch_end_callback=eval_batch_end_callback)

@@ -281,8 +281,16 @@ class NDArray:
         return False
 
     def __getitem__(self, key):
+        from .. import autograd
         if isinstance(key, NDArray):
             key = key.data_jax
+        if autograd.is_recording():
+            # under record() slicing must live on the tape: a raw view (or
+            # a bare gather copy) would silently detach the gradient
+            # (reference: slicing lowers to slice/gather ops with
+            # FGradient). Mutation of recorded arrays is forbidden anyway,
+            # so losing view aliasing here changes nothing observable.
+            return _invoke("_internal_getitem", self, index=key)
         if NDArray._is_basic_index(key):
             # zero-copy view semantics (reference: NDArray::Slice/At)
             return NDArray(None, ctx=self._ctx, base=self, idx=key)

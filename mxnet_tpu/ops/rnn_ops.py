@@ -57,6 +57,20 @@ def _cell_step(mode):
     return step
 
 
+def rnn_param_size(mode, input_size, state_size, num_layers,
+                   bidirectional=False):
+    """Total length of the packed parameter vector for the layout
+    `_slice_params` defines — the single source of truth used by shape
+    inference (ops/shape_hints.py) and initializer.FusedRNN."""
+    ng = _gates(mode)
+    h = state_size
+    ndir = 2 if bidirectional else 1
+    n = ndir * ng * h * (input_size + h) \
+        + (num_layers - 1) * ndir * ng * h * (h * ndir + h) \
+        + num_layers * ndir * 2 * ng * h
+    return n
+
+
 def _slice_params(params, mode, input_size, state_size, num_layers,
                   bidirectional, projection_size=None):
     """Carve the flat parameter vector into per-layer weights, matching the

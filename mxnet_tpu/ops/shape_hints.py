@@ -136,3 +136,25 @@ def _leaky_hint(shapes, kw):
     if len(out) > 1 and out[1] is None:
         out[1] = (data[1],)
     return out
+
+
+@_hint("RNN")
+def _rnn_hint(shapes, kw):
+    """Fill the packed parameter vector and begin-state shapes from the
+    data shape (reference: rnn.cc RNNShape; layout per rnn_ops.py)."""
+    data = shapes[0]
+    if data is None:
+        return shapes
+    from .rnn_ops import rnn_param_size
+    mode = kw.get("mode", "lstm")
+    h = kw.get("state_size")
+    L = kw.get("num_layers", 1)
+    ndir = 2 if kw.get("bidirectional") else 1
+    n = rnn_param_size(mode, data[2], h, L, kw.get("bidirectional", False))
+    out = list(shapes)
+    if len(out) > 1 and out[1] is None:
+        out[1] = (n,)
+    for i in (2, 3):
+        if len(out) > i and out[i] is None:
+            out[i] = (L * ndir, data[1], h)
+    return out

@@ -673,6 +673,15 @@ def _embedding_recorder(raw_args, kwargs, nd_inputs, fn):
     return out, vjp_fn, primal
 
 
+@register("_internal_getitem")
+def _internal_getitem(data, index=None):
+    """Tape-recorded `x[key]` (reference: slicing is the `slice`/`gather`
+    op family with FGradient there; a raw view would silently detach the
+    autograd graph). `index` is the python indexing key, closed over —
+    its vjp scatters the cotangent back into the sliced positions."""
+    return data[index]
+
+
 @register("take_along_axis")
 def _take_along_axis(a, indices, axis=0):
     return jnp.take_along_axis(a, _as_index(indices), axis=axis)

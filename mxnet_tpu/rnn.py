@@ -218,7 +218,7 @@ class FusedRNNCell(BaseRNNCell):
         out = sym.RNN(*args, state_size=self._num_hidden,
                       num_layers=self._num_layers, mode=self._mode,
                       bidirectional=self._bidirectional, p=self._dropout,
-                      state_outputs=False)
+                      state_outputs=False)[0]
         if layout == "NTC":
             out = sym.swapaxes(out, dim1=0, dim2=1)
         return out, begin_state

@@ -13,7 +13,8 @@ from .context import Context, cpu, tpu, current_context
 from . import ndarray as nd
 from .ndarray import NDArray
 
-__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+__all__ = ["download",
+           "default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "same", "rand_shape_nd", "rand_ndarray",
            "random_arrays", "check_numeric_gradient", "numeric_grad",
            "check_consistency", "simple_forward", "default_dtype",
@@ -306,3 +307,18 @@ class DummyIter:
 
     def reset(self):
         pass
+
+
+def download(url, fname=None, dirname=None, overwrite=False, retries=5):
+    """reference: test_utils.py (download) — thin delegate to
+    gluon.utils.download (file:// / local paths only in this offline
+    build; a real URL raises with a clear message)."""
+    import os as _os
+    from .gluon.utils import download as _dl
+    path = None
+    if dirname is not None:
+        _os.makedirs(dirname, exist_ok=True)
+        path = _os.path.join(dirname, fname) if fname else dirname
+    elif fname is not None:
+        path = fname
+    return _dl(url, path=path, overwrite=overwrite, retries=retries)

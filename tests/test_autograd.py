@@ -377,3 +377,32 @@ def test_grad_create_graph_stops_at_variables():
     s.backward()
     # d2z/dy2 = 2
     np.testing.assert_allclose(y.grad.asnumpy(), [2.0, 2.0], rtol=1e-6)
+
+
+def test_getitem_on_tape_basic_and_advanced():
+    """Slicing under record() must flow gradients (round-5 find: raw views
+    silently detached the tape; reference: slice/gather ops have
+    FGradient)."""
+    x = nd.array(np.ones((3, 4), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = (x * 2.0)[:, :2].sum() + x[1].sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    np.testing.assert_allclose(g[0], [2, 2, 0, 0])
+    np.testing.assert_allclose(g[1], [3, 3, 1, 1])
+
+    # fancy indexing: duplicate rows accumulate
+    y = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    y.attach_grad()
+    idx = nd.array(np.array([0, 2, 2]), dtype="int32")
+    with autograd.record():
+        l2 = y[idx].sum()
+    l2.backward()
+    np.testing.assert_allclose(y.grad.asnumpy()[:, 0], [1, 0, 2, 0])
+
+    # views created OUTSIDE record still alias (unchanged semantics)
+    z = nd.zeros((4,))
+    v = z[1:3]
+    z[1:3] = 5
+    np.testing.assert_allclose(v.asnumpy(), [5, 5])

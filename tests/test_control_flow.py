@@ -50,8 +50,8 @@ def test_while_loop():
         cond_fn, func, (nd.zeros((1,)), nd.zeros((1,))),
         max_iterations=8)
     # steps: s accumulates 0+0,+1,+2,+3,+4 = 10
-    np.testing.assert_allclose(float(s_fin.asnumpy()), 10.0)
-    np.testing.assert_allclose(float(i_fin.asnumpy()), 5.0)
+    np.testing.assert_allclose(s_fin.asnumpy().item(), 10.0)
+    np.testing.assert_allclose(i_fin.asnumpy().item(), 5.0)
     assert outs.shape == (8, 1)          # max_iterations buffer
     np.testing.assert_allclose(outs.asnumpy().ravel()[:5],
                                [0, 1, 3, 6, 10])
