@@ -206,17 +206,35 @@ class NDArray:
     # movement / copies
     # ------------------------------------------------------------------
     def copy(self):
+        from .. import autograd
+        if autograd.is_recording():
+            # copy is a recorded op (reference: _copyto with FGradient);
+            # a raw buffer copy would silently detach the tape
+            return _invoke("_copyto", self)
         return NDArray(self._read(), ctx=self._ctx)
 
     def copyto(self, other):
         """reference: NDArray::CopyFromTo — cross-device async copy."""
+        from .. import autograd
         if isinstance(other, NDArray):
+            if autograd.is_recording():
+                _invoke("_copyto", self, out=other)
+                # _invoke's out= path handles dtype but not device; keep
+                # the non-recording branch's cross-device commitment
+                other._write(jax.device_put(other._read(),
+                                            other._ctx.jax_device))
+                return other
             val = self._read()
             if other.dtype != self.dtype:
                 val = val.astype(other.dtype)
             other._write(jax.device_put(val, other._ctx.jax_device))
             return other
         if isinstance(other, Context):
+            if autograd.is_recording():
+                out = _invoke("_copyto", self)
+                out._write(jax.device_put(out._read(), other.jax_device))
+                out._ctx = other
+                return out
             return NDArray(jax.device_put(self._read(), other.jax_device), ctx=other)
         raise TypeError("copyto does not support type " + str(type(other)))
 

@@ -673,6 +673,16 @@ def _embedding_recorder(raw_args, kwargs, nd_inputs, fn):
     return out, vjp_fn, primal
 
 
+@register("_copyto")
+def _copyto_op(data):
+    """Identity copy with gradient (reference: _copyto — NDArray.copy/
+    copyto are recorded ops there; a raw buffer copy would silently
+    detach the tape, the same failure class as unrecorded slicing).
+    Sharing the immutable buffer IS the copy semantics here (same as the
+    non-recording copy); `data + 0` would promote bool to int32."""
+    return data
+
+
 @register("_internal_getitem")
 def _internal_getitem(data, index=None):
     """Tape-recorded `x[key]` (reference: slicing is the `slice`/`gather`

@@ -406,3 +406,31 @@ def test_getitem_on_tape_basic_and_advanced():
     v = z[1:3]
     z[1:3] = 5
     np.testing.assert_allclose(v.asnumpy(), [5, 5])
+
+
+def test_copy_and_copyto_on_tape():
+    """copy()/copyto() under record() are recorded ops with identity
+    gradient (reference: _copyto), not silent tape detachments."""
+    x = nd.array(np.ones((2, 3), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = (x.copy() * 3.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3.0 * np.ones((2, 3)))
+
+    y = nd.array(np.ones((2, 3), np.float32))
+    y.attach_grad()
+    dst = nd.zeros((2, 3))
+    with autograd.record():
+        out = y.copyto(dst)
+        loss = (out * 2.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(y.grad.asnumpy(), 2.0 * np.ones((2, 3)))
+    np.testing.assert_allclose(dst.asnumpy(), 1.0 * np.ones((2, 3)))
+
+
+def test_copy_on_tape_preserves_dtype():
+    m = nd.array(np.array([True, False]))
+    with autograd.record():
+        c = m.copy()
+    assert c.dtype == m.dtype, (c.dtype, m.dtype)
