@@ -29,6 +29,22 @@ for _pub, _src in [("uniform", "_random_uniform"), ("normal", "_random_normal"),
     setattr(random, _pub, _register.make_op_func(_src))
 _sys.modules[random.__name__] = random
 
+# mx.nd.image / mx.nd.linalg sub-namespaces (reference:
+# python/mxnet/ndarray/image.py, linalg.py — short names over the
+# `_image_*` / `linalg_*` op families)
+image = _types.ModuleType(__name__ + ".image")
+from ..ops import registry as _opreg
+for _full in _opreg.list_ops():
+    if _full.startswith("_image_"):
+        setattr(image, _full[len("_image_"):], _register.make_op_func(_full))
+_sys.modules[image.__name__] = image
+
+linalg = _types.ModuleType(__name__ + ".linalg")
+for _full in _opreg.list_ops():
+    if _full.startswith("linalg_"):
+        setattr(linalg, _full[len("linalg_"):], _register.make_op_func(_full))
+_sys.modules[linalg.__name__] = linalg
+
 from . import sparse  # noqa: E402  (row_sparse / csr)
 
 

@@ -218,7 +218,12 @@ class NDArray:
         from .. import autograd
         if isinstance(other, NDArray):
             if autograd.is_recording():
-                _invoke("_copyto", self, out=other)
+                # writing into an array already in the recorded graph
+                # would silently reroute its consumers' gradients
+                other._check_inplace_ok()
+                # cast op (not identity) so the recorded vjp converts the
+                # cotangent back to the source dtype
+                _invoke("cast", self, dtype=other.dtype, out=other)
                 # _invoke's out= path handles dtype but not device; keep
                 # the non-recording branch's cross-device commitment
                 other._write(jax.device_put(other._read(),

@@ -1,0 +1,121 @@
+"""The `_image_*` op family → `mx.nd.image` / `mx.sym.image`.
+
+reference: src/operator/image/image_random-inl.h (ToTensor, Normalize,
+flips, random flips), resize-inl.h (Resize), crop-inl.h (Crop), exposed
+in python as mx.nd.image.* / mx.sym.image.*. On TPU resize lowers to
+jax.image.resize (XLA gather/dot programs); everything else is
+layout/elementwise work XLA fuses.
+
+Layout contract (same as the reference): images are HWC or NHWC for
+to_tensor/resize/crop/flips; to_tensor emits CHW/NCHW float32 in [0, 1];
+normalize consumes the CHW/NCHW tensor form.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _saturate_cast(out, dtype):
+    """Round+clip back into an integer input dtype (the reference's
+    cv::saturate_cast); float dtypes pass through astype."""
+    import numpy as _np
+    if _np.issubdtype(_np.dtype(dtype), _np.integer):
+        info = _np.iinfo(_np.dtype(dtype))
+        return jnp.clip(jnp.round(out), info.min, info.max).astype(dtype)
+    return out.astype(dtype)
+
+
+@register("_image_to_tensor")
+def _to_tensor(data):
+    """HWC [0,255] uint8/float → CHW float32 [0,1] (reference: ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def _normalize(data, mean=0.0, std=1.0):
+    """(x - mean) / std over the channel axis of CHW/NCHW float input
+    (reference: Normalize)."""
+    mean = jnp.asarray(mean, dtype=data.dtype).reshape(-1)
+    std = jnp.asarray(std, dtype=data.dtype).reshape(-1)
+    ax = data.ndim - 3  # channel axis: 0 for CHW, 1 for NCHW
+    shape = [1] * data.ndim
+    shape[ax] = -1
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+def _resize_hw(img, size, keep_ratio, interp):
+    h, w = img.shape[-3], img.shape[-2]
+    if isinstance(size, int):
+        if keep_ratio:
+            if h > w:
+                new_w, new_h = size, int(h * size / w)
+            else:
+                new_w, new_h = int(w * size / h), size
+        else:
+            new_w = new_h = size
+    else:
+        new_w, new_h = size
+    method = "nearest" if interp == 0 else "linear"
+    out_shape = img.shape[:-3] + (new_h, new_w, img.shape[-1])
+    out = jax.image.resize(img.astype(jnp.float32), out_shape, method=method)
+    return _saturate_cast(out, img.dtype)
+
+
+@register("_image_resize")
+def _resize(data, size=0, keep_ratio=False, interp=1):
+    """Resize HWC/NHWC (reference: Resize; size int or (w, h))."""
+    size = tuple(size) if isinstance(size, (tuple, list)) else int(size)
+    return _resize_hw(data, size, keep_ratio, interp)
+
+
+@register("_image_crop")
+def _crop(data, x=0, y=0, width=0, height=0):
+    """Spatial crop of HWC/NHWC (reference: Crop(x, y, width, height))."""
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]
+
+
+@register("_image_flip_left_right")
+def _flip_lr(data):
+    return jnp.flip(data, axis=data.ndim - 2)
+
+
+@register("_image_flip_top_bottom")
+def _flip_tb(data):
+    return jnp.flip(data, axis=data.ndim - 3)
+
+
+@register("_image_random_flip_left_right", random=True)
+def _random_flip_lr(data, key=None):
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=data.ndim - 2), data)
+
+
+@register("_image_random_flip_top_bottom", random=True)
+def _random_flip_tb(data, key=None):
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=data.ndim - 3), data)
+
+
+@register("_image_random_brightness", random=True)
+def _random_brightness(data, min_factor=1.0, max_factor=1.0, key=None):
+    f = jax.random.uniform(key, minval=min_factor, maxval=max_factor)
+    return _saturate_cast(data.astype(jnp.float32) * f, data.dtype)
+
+
+@register("_image_random_contrast", random=True)
+def _random_contrast(data, min_factor=1.0, max_factor=1.0, key=None):
+    f = jax.random.uniform(key, minval=min_factor, maxval=max_factor)
+    # grayscale mean over the trailing HWC dims (reference coefficients)
+    coef = jnp.asarray([0.299, 0.587, 0.114], dtype=jnp.float32)
+    gray = (data.astype(jnp.float32) * coef).sum(axis=-1, keepdims=True)
+    mean = gray.mean(axis=(-3, -2), keepdims=True)
+    return _saturate_cast(data.astype(jnp.float32) * f + mean * (1 - f),
+                          data.dtype)

@@ -31,6 +31,29 @@ contrib = _types.ModuleType(__name__ + ".contrib")
 for _full in list(_reg_mod.list_ops()):
     if _full.startswith("_contrib_"):
         setattr(contrib, _full[len("_contrib_"):], _mk(_full))
+# mx.sym.image / mx.sym.linalg / mx.sym.sparse sub-namespaces (reference:
+# python/mxnet/symbol/image.py, linalg.py, sparse.py)
+image = _types.ModuleType(__name__ + ".image")
+for _full in list(_reg_mod.list_ops()):
+    if _full.startswith("_image_"):
+        setattr(image, _full[len("_image_"):], _mk(_full))
+_sys.modules[image.__name__] = image
+
+linalg = _types.ModuleType(__name__ + ".linalg")
+for _full in list(_reg_mod.list_ops()):
+    if _full.startswith("linalg_"):
+        setattr(linalg, _full[len("linalg_"):], _mk(_full))
+_sys.modules[linalg.__name__] = linalg
+
+sparse = _types.ModuleType(__name__ + ".sparse")
+_all_ops = set(_reg_mod.list_ops())
+for _name in ("dot", "elemwise_add", "cast_storage", "zeros_like",
+              "square", "sqrt", "abs", "sum", "mean", "clip", "sign",
+              "where", "negative"):
+    if _name in _all_ops:
+        setattr(sparse, _name, _mk(_name))
+_sys.modules[sparse.__name__] = sparse
+
 # control-flow contrib ops are F-generic python functions (tracing runs
 # through nd with tracer payloads), same objects as nd.contrib's
 from ..ndarray.contrib_flow import foreach as _cf_foreach, \

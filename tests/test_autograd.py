@@ -434,3 +434,30 @@ def test_copy_on_tape_preserves_dtype():
     with autograd.record():
         c = m.copy()
     assert c.dtype == m.dtype, (c.dtype, m.dtype)
+
+
+def test_copyto_into_recorded_array_raises():
+    """Writing into an array already in the recorded graph must raise
+    (reference: 'Assigning to NDArrays that are already in a computational
+    graph'), not silently reroute its consumers' gradients."""
+    import pytest as _pytest
+    from mxnet_tpu.base import MXNetError
+    x = nd.array(np.ones((2, 2), np.float32)); x.attach_grad()
+    y = nd.array(np.full((2, 2), 7.0, np.float32))
+    with autograd.record():
+        b = x * 2.0
+        c = b + 1.0
+        with _pytest.raises(MXNetError):
+            y.copyto(b)
+    del c
+
+
+def test_copyto_cross_dtype_on_tape():
+    y = nd.array(np.ones((2, 2), np.float32)); y.attach_grad()
+    dst = nd.zeros((2, 2), dtype="float64")
+    with autograd.record():
+        out = y.copyto(dst)
+        loss = (out * 2.0).sum()
+    loss.backward()
+    assert y.grad.dtype == np.float32
+    np.testing.assert_allclose(y.grad.asnumpy(), 2.0 * np.ones((2, 2)))

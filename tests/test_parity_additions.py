@@ -242,3 +242,41 @@ def test_feedforward_eval_data_tuple_and_predict_guard():
             mx.model.FeedForward(sym_out).predict(X)
         m = mx.model.FeedForward(sym_out, num_epoch=1, numpy_batch_size=64)
         m.fit(X, y, eval_data=(X, y))  # tuple form, reference pattern
+
+
+def test_image_op_namespace():
+    """mx.nd.image / mx.sym.image / nd.linalg / sym.linalg / sym.sparse
+    sub-namespaces (reference: python/mxnet/{ndarray,symbol}/{image,
+    linalg,sparse}.py)."""
+    rng = np.random.RandomState(0)
+    img = nd.array((rng.rand(8, 6, 3) * 255).astype(np.uint8))
+    t = mx.nd.image.to_tensor(img)
+    assert t.shape == (3, 8, 6)
+    np.testing.assert_allclose(t.asnumpy(),
+                               img.asnumpy().transpose(2, 0, 1) / 255.0,
+                               rtol=1e-6)
+    nrm = mx.nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(2, 2, 2))
+    np.testing.assert_allclose(nrm.asnumpy(), (t.asnumpy() - 0.5) / 2.0,
+                               rtol=1e-5)
+    assert mx.nd.image.resize(img, size=(4, 5)).shape == (5, 4, 3)
+    assert mx.nd.image.resize(img, size=4, keep_ratio=True).shape[1] == 4
+    crop = mx.nd.image.crop(img, x=1, y=2, width=4, height=3)
+    np.testing.assert_array_equal(crop.asnumpy(),
+                                  img.asnumpy()[2:5, 1:5, :])
+    # batched NHWC
+    batch = nd.array((rng.rand(2, 8, 6, 3) * 255).astype(np.uint8))
+    assert mx.nd.image.to_tensor(batch).shape == (2, 3, 8, 6)
+    np.testing.assert_array_equal(
+        mx.nd.image.flip_top_bottom(batch).asnumpy(),
+        batch.asnumpy()[:, ::-1])
+    # symbolic composition binds and runs
+    s = mx.sym.image.to_tensor(mx.sym.Variable("img"))
+    ex = s.simple_bind(mx.cpu(), img=(8, 6, 3))
+    ex.forward(img=img.asnumpy())
+    assert ex.outputs[0].shape == (3, 8, 6)
+    out = mx.sym.linalg.gemm2(mx.sym.Variable("a"), mx.sym.Variable("b"))
+    ex2 = out.simple_bind(mx.cpu(), a=(3, 4), b=(4, 2))
+    ex2.forward(a=np.ones((3, 4), np.float32), b=np.ones((4, 2), np.float32))
+    np.testing.assert_allclose(ex2.outputs[0].asnumpy(),
+                               4.0 * np.ones((3, 2)))
+    assert hasattr(mx.sym.sparse, "dot")
