@@ -280,3 +280,31 @@ def test_image_op_namespace():
     np.testing.assert_allclose(ex2.outputs[0].asnumpy(),
                                4.0 * np.ones((3, 2)))
     assert hasattr(mx.sym.sparse, "dot")
+
+
+def test_conv_lstm_hybridize_parity_and_checkpoint(tmp_path):
+    """Conv cells hybridize to the same numbers and roundtrip through
+    save_parameters/load_parameters."""
+    rng = np.random.RandomState(5)
+    x = nd.array(rng.rand(2, 3, 3, 8, 8).astype(np.float32))
+
+    def build():
+        c = contrib.rnn.Conv2DLSTMCell(input_shape=(3, 8, 8),
+                                       hidden_channels=4, i2h_kernel=3,
+                                       h2h_kernel=3, i2h_pad=1,
+                                       prefix="clstm_")
+        return c
+    cell = build()
+    cell.initialize(mx.init.Xavier())
+    out_e, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    cell.hybridize()
+    out_h, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(out_e.asnumpy(), out_h.asnumpy(),
+                               rtol=2e-5, atol=2e-6)
+    f = str(tmp_path / "clstm.params")
+    cell.save_parameters(f)
+    cell2 = build()
+    cell2.load_parameters(f)
+    out_l, _ = cell2.unroll(3, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_allclose(out_l.asnumpy(), out_e.asnumpy(), rtol=2e-5,
+                               atol=2e-6)

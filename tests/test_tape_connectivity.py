@@ -103,3 +103,24 @@ def test_chained_transform_grad_values():
     expect = np.zeros((2, 4), np.float32)
     expect[:, 1:3] = 2.0
     np.testing.assert_allclose(x.grad.asnumpy(), expect)
+
+
+def test_np_surface_indexing_grads():
+    """The mx.np array surface delegates indexing to the same tape paths."""
+    import numpy as onp
+    from mxnet_tpu import np as mnp
+    x = mnp.array(onp.ones((3, 4), onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = (x[:, 1:3] * 2.0).sum() + (x.copy() * 1.0).sum()
+    loss.backward()
+    g = onp.asarray(x.grad.asnumpy())
+    onp.testing.assert_allclose(g[0], [1, 3, 3, 1])
+
+    y = mnp.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    y.attach_grad()
+    with autograd.record():
+        l2 = y[mnp.array([0, 2, 2], dtype="int32")].sum()
+    l2.backward()
+    onp.testing.assert_allclose(onp.asarray(y.grad.asnumpy())[:, 0],
+                                [1, 0, 2, 0])
