@@ -370,23 +370,15 @@ class FusedRNN(Initializer):
         self._forget_bias = forget_bias
 
     def _init_weight(self, desc, arr):
-        from .ops.rnn_ops import _gates, rnn_param_size
+        from .ops.rnn_ops import _gates, rnn_solve_input_size
         mode = {"rnn": "rnn_tanh"}.get(self._mode, self._mode)
         ng = _gates(mode)
         h = self._num_hidden
         ndir = 2 if self._bidirectional else 1
         L = self._num_layers
         total = int(_np.prod(arr.shape))
-        # invert rnn_param_size for the input size, then validate with it
-        bias_total = L * ndir * 2 * ng * h
-        deeper = (L - 1) * ndir * ng * h * (h * ndir + h)
-        in_sz = (total - bias_total - deeper) // (ndir * ng * h) - h
-        if in_sz <= 0 or rnn_param_size(mode, in_sz, h, L,
-                                        self._bidirectional) != total:
-            raise ValueError(
-                "FusedRNN: cannot solve input size from a %d-element "
-                "parameter vector (mode=%s, %d hidden, %d layers)"
-                % (total, self._mode, h, L))
+        in_sz = rnn_solve_input_size(mode, total, h, L,
+                                     self._bidirectional)
         flat = _np.zeros((total,), dtype=_np.float32)
         off = 0
         name = str(desc)

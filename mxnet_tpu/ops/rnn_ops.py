@@ -71,6 +71,26 @@ def rnn_param_size(mode, input_size, state_size, num_layers,
     return n
 
 
+def rnn_solve_input_size(mode, total, state_size, num_layers,
+                         bidirectional=False):
+    """Invert rnn_param_size for the input size; raises if `total` is not
+    a valid packed-vector length for these hyper-params."""
+    ng = _gates(mode)
+    h = state_size
+    ndir = 2 if bidirectional else 1
+    L = num_layers
+    bias_total = L * ndir * 2 * ng * h
+    deeper = (L - 1) * ndir * ng * h * (h * ndir + h)
+    in_sz = (total - bias_total - deeper) // (ndir * ng * h) - h
+    if in_sz <= 0 or rnn_param_size(mode, in_sz, h, L,
+                                    bidirectional) != total:
+        raise ValueError(
+            "cannot solve input size from a %d-element packed RNN "
+            "parameter vector (mode=%s, %d hidden, %d layers)"
+            % (total, mode, h, L))
+    return in_sz
+
+
 def _slice_params(params, mode, input_size, state_size, num_layers,
                   bidirectional, projection_size=None):
     """Carve the flat parameter vector into per-layer weights, matching the

@@ -42,6 +42,9 @@ class BaseRNNCell:
 
     def begin_state(self, func=None, init_sym=None, **kwargs):
         """Symbols for the initial states."""
+        assert not self._modified, \
+            "After applying modifier cells (e.g. ZoneoutCell) the base " \
+            "cell cannot be called directly. Call the modifier cell instead."
         states = []
         for i, info in enumerate(self.state_info):
             self._init_counter += 1
@@ -228,9 +231,27 @@ class FusedRNNCell(BaseRNNCell):
         stack = SequentialRNNCell()
         cls = {"rnn_tanh": RNNCell, "rnn_relu": RNNCell, "lstm": LSTMCell,
                "gru": GRUCell}[self._mode]
+        kw = {}
+        if cls is LSTMCell:
+            # the packed fused bias already carries the forget bias
+            # (initializer.FusedRNN bakes it in); a runtime add here
+            # would double-count it against unpacked weights
+            kw["forget_bias"] = 0.0
         for i in range(self._num_layers):
-            stack.add(cls(self._num_hidden,
-                          prefix="%sl%d_" % (self._prefix, i)))
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    cls(self._num_hidden,
+                        prefix="%sl%d_" % (self._prefix, i), **kw),
+                    cls(self._num_hidden,
+                        prefix="%sl%d_r_" % (self._prefix, i), **kw),
+                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+            else:
+                stack.add(cls(self._num_hidden,
+                              prefix="%sl%d_" % (self._prefix, i), **kw))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(
+                    self._dropout, prefix="%s_dropout%d_"
+                    % (self._prefix, i)))
         return stack
 
 
@@ -260,6 +281,29 @@ class SequentialRNNCell(BaseRNNCell):
             pos += n
             next_states.extend(st)
         return inputs, next_states
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=None):
+        """Layer-wise unroll: each child unrolls the whole sequence and
+        feeds the next (reference: SequentialRNNCell.unroll) — required
+        for children like BidirectionalCell that cannot be stepped."""
+        self.reset()
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = []
+        pos = 0
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            last = i == len(self._cells) - 1
+            inputs, st = cell.unroll(
+                length, inputs, begin_state[pos:pos + n],
+                input_prefix=input_prefix, layout=layout,
+                merge_outputs=None if last else False)
+            pos += n
+            states.extend(st)
+        if merge_outputs and isinstance(inputs, list):
+            inputs = sym.stack(*inputs, axis=layout.find("T"))
+        return inputs, states
 
 
 class BidirectionalCell(BaseRNNCell):
@@ -417,3 +461,247 @@ class BucketSentenceIter:
                                           self.dtype)])
         batch.bucket_key = bucket_key
         return batch
+
+
+class RNNParams:
+    """Parameter-variable container shared between legacy cells
+    (reference: rnn_cell.py RNNParams)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells that wrap another cell (reference: ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def reset(self):
+        super().reset()
+        self.base_cell.reset()
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization on the symbolic cells (reference:
+    ZoneoutCell; Krueger et al. — keep the previous state with
+    probability p instead of zeroing)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell does not support zoneout; unfuse() first"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+
+        def mask(p, like):
+            return sym.Dropout(sym.ones_like(like), p=p)
+        prev_output = self._prev_output if self._prev_output is not None \
+            else sym.zeros_like(next_output)
+        if self.zoneout_outputs > 0.0:
+            output = sym.where(mask(self.zoneout_outputs, next_output),
+                               next_output, prev_output)
+        else:
+            output = next_output
+        if self.zoneout_states > 0.0:
+            states = [sym.where(mask(self.zoneout_states, ns), ns, s)
+                      for ns, s in zip(next_states, states)]
+        else:
+            states = next_states
+        self._prev_output = output
+        return output, states
+
+
+def _fused_layout(cell, total):
+    """Per-layer slicing offsets of the packed vector (input size solved
+    by the shared ops.rnn_ops inversion)."""
+    from .ops.rnn_ops import _gates, rnn_solve_input_size
+    ng = _gates(cell._mode)
+    h = cell._num_hidden
+    ndir = 2 if cell._bidirectional else 1
+    L = cell._num_layers
+    in_sz = rnn_solve_input_size(cell._mode, total, h, L,
+                                 cell._bidirectional)
+    return ng, h, ndir, L, in_sz
+
+
+def _fused_chunks(cell, total):
+    """Yield (name, offset, shape) over the packed layout (weights then
+    biases; names match unfuse()'s per-layer cells so fused and unfused
+    checkpoints interchange)."""
+    ng, h, ndir, L, in_sz = _fused_layout(cell, total)
+    off = 0
+    for layer in range(L):
+        for d in range(ndir):
+            cur_in = in_sz if layer == 0 else h * ndir
+            tag = "%sl%d%s_" % (cell._prefix, layer, "_r" if d else "")
+            yield tag + "i2h_weight", off, (ng * h, cur_in)
+            off += ng * h * cur_in
+            yield tag + "h2h_weight", off, (ng * h, h)
+            off += ng * h * h
+    for layer in range(L):
+        for d in range(ndir):
+            tag = "%sl%d%s_" % (cell._prefix, layer, "_r" if d else "")
+            yield tag + "i2h_bias", off, (ng * h,)
+            off += ng * h
+            yield tag + "h2h_bias", off, (ng * h,)
+            off += ng * h
+
+
+def _fused_unpack(cell, args):
+    from . import ndarray as nd
+    args = dict(args)
+    key = cell._prefix + "parameters"
+    packed = args.pop(key).asnumpy().reshape(-1)
+    for name, off, shape in _fused_chunks(cell, packed.size):
+        n = 1
+        for s in shape:
+            n *= s
+        args[name] = nd.array(packed[off:off + n].reshape(shape))
+    return args
+
+
+def _fused_pack(cell, args):
+    import numpy as _np
+    from . import ndarray as nd
+    args = dict(args)
+    key0 = cell._prefix + "l0_i2h_weight"
+    if key0 not in args:
+        return args  # already packed (or not this cell's params)
+    from .ops.rnn_ops import rnn_param_size
+    in_sz = args[key0].shape[1]
+    total = rnn_param_size(cell._mode, in_sz, cell._num_hidden,
+                           cell._num_layers, cell._bidirectional)
+    flat = _np.zeros((total,), dtype=args[key0].dtype)
+    for name, off, shape in _fused_chunks(cell, total):
+        n = 1
+        for s in shape:
+            n *= s
+        flat[off:off + n] = args.pop(name).asnumpy().reshape(-1)
+    args[cell._prefix + "parameters"] = nd.array(flat)
+    return args
+
+
+FusedRNNCell.unpack_weights = _fused_unpack
+FusedRNNCell.pack_weights = _fused_pack
+BaseRNNCell.unpack_weights = lambda self, args: dict(args)
+BaseRNNCell.pack_weights = lambda self, args: dict(args)
+SequentialRNNCell.unpack_weights = lambda self, args: _chain(
+    self._cells, "unpack_weights", args)
+SequentialRNNCell.pack_weights = lambda self, args: _chain(
+    self._cells, "pack_weights", args)
+BidirectionalCell.unpack_weights = lambda self, args: _chain(
+    (self._l, self._r), "unpack_weights", args)
+BidirectionalCell.pack_weights = lambda self, args: _chain(
+    (self._l, self._r), "pack_weights", args)
+ResidualCell.unpack_weights = lambda self, args: \
+    self._base.unpack_weights(args)
+ResidualCell.pack_weights = lambda self, args: \
+    self._base.pack_weights(args)
+
+
+def _chain(cells, meth, args):
+    for c in cells:
+        args = getattr(c, meth)(args)
+    return args
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """reference: rnn/rnn.py (save_rnn_checkpoint) — pack fused-cell
+    weights, then write the standard checkpoint pair."""
+    from .model import save_checkpoint
+    if isinstance(cells, BaseRNNCell):
+        cells = [cells]
+    for cell in cells:
+        arg_params = cell.pack_weights(arg_params)
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """reference: rnn/rnn.py (load_rnn_checkpoint)."""
+    from .model import load_checkpoint
+    sym_, arg, aux = load_checkpoint(prefix, epoch)
+    if isinstance(cells, BaseRNNCell):
+        cells = [cells]
+    for cell in cells:
+        arg = cell.unpack_weights(arg)
+    return sym_, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback that saves unpacked-compatible checkpoints
+    (reference: rnn/rnn.py do_rnn_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, s=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, s, arg, aux)
+    return _callback
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0, unknown_token=None):
+    """Map token sequences to integer ids, growing the vocab as needed
+    (reference: rnn/io.py encode_sentences)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                if not new_vocab:
+                    if unknown_token:
+                        word = unknown_token
+                    else:
+                        raise ValueError("Unknown token %s" % word)
+                else:
+                    if idx == invalid_label:
+                        idx += 1
+                    vocab[word] = idx
+                    idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+__all__ += ["RNNParams", "ModifierCell", "ZoneoutCell",
+            "save_rnn_checkpoint", "load_rnn_checkpoint",
+            "do_rnn_checkpoint", "encode_sentences"]

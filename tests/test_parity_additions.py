@@ -308,3 +308,96 @@ def test_conv_lstm_hybridize_parity_and_checkpoint(tmp_path):
     out_l, _ = cell2.unroll(3, x, layout="NTC", merge_outputs=True)
     np.testing.assert_allclose(out_l.asnumpy(), out_e.asnumpy(), rtol=2e-5,
                                atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# legacy rnn: pack/unpack, checkpoints, zoneout, encode_sentences
+# ---------------------------------------------------------------------------
+def test_fused_unfused_weight_interchange(tmp_path):
+    """Fused sym.RNN vs the unfused cell stack must agree numerically
+    under exchanged (unpacked) weights — cross-validates the packed
+    layout, the lax.scan kernel, and unfuse() in one assert (reference:
+    FusedRNNCell.unpack_weights/unfuse)."""
+    import mxnet_tpu.rnn as mrnn
+    cell = mrnn.FusedRNNCell(4, num_layers=2, mode="lstm", prefix="lstm_")
+    out, _ = cell.unroll(5, sym.Variable("data"), layout="NTC")
+    ex = out.simple_bind(mx.cpu(), data=(2, 5, 6))
+    mx.init.FusedRNN(mx.init.Xavier(), 4, 2, "lstm")(
+        "lstm_parameters", ex.arg_dict["lstm_parameters"])
+    x = np.random.RandomState(0).rand(2, 5, 6).astype(np.float32)
+    ex.forward(data=x)
+    fused_out = ex.outputs[0].asnumpy()
+
+    args = {"lstm_parameters": ex.arg_dict["lstm_parameters"]}
+    unpacked = cell.unpack_weights(args)
+    stack = cell.unfuse()
+    uout, _ = stack.unroll(5, sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    shapes = {"lstm_l%d_begin_state_%d" % (i, j): (2, 4)
+              for i in range(2) for j in range(2)}
+    ex2 = uout.simple_bind(mx.cpu(), data=(2, 5, 6), **shapes)
+    for k, v in unpacked.items():
+        if k in ex2.arg_dict:
+            ex2.arg_dict[k][:] = v.asnumpy()
+    ex2.forward(data=x)
+    np.testing.assert_allclose(fused_out, ex2.outputs[0].asnumpy(),
+                               rtol=2e-5, atol=2e-6)
+    # pack is the exact inverse
+    repacked = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["lstm_parameters"].asnumpy(),
+                               args["lstm_parameters"].asnumpy(), rtol=1e-6)
+    # checkpoint helpers roundtrip through the unpacked form
+    mrnn.save_rnn_checkpoint(cell, str(tmp_path / "cp"), 3, out,
+                             dict(args), {})
+    _, arg2, _ = mrnn.load_rnn_checkpoint(cell, str(tmp_path / "cp"), 3)
+    assert "lstm_l0_i2h_weight" in arg2 and \
+        "lstm_parameters" not in arg2
+
+
+def test_legacy_zoneout_and_encode():
+    import mxnet_tpu.rnn as mrnn
+    z = mrnn.ZoneoutCell(mrnn.LSTMCell(4, prefix="zl_"),
+                         zoneout_states=0.1)
+    outs, st = z.unroll(3, sym.Variable("data"))
+    assert len(outs) == 3 and len(st) == 2
+    coded, vocab = mrnn.encode_sentences([["a", "b"], ["b", "c"]],
+                                         start_label=1)
+    assert coded == [[1, 2], [2, 3]]
+    # closed vocab raises on unknown without unknown_token
+    with pytest.raises(ValueError):
+        mrnn.encode_sentences([["zzz"]], vocab=dict(vocab))
+
+
+def test_fused_unfused_bidirectional_interchange():
+    """Bidirectional: fused kernel vs BidirectionalCell stack under
+    exchanged weights (reference: unfuse wraps layers in
+    BidirectionalCell)."""
+    import mxnet_tpu.rnn as mrnn
+    cell = mrnn.FusedRNNCell(3, num_layers=1, mode="lstm",
+                             bidirectional=True, prefix="blstm_")
+    out, _ = cell.unroll(4, sym.Variable("data"), layout="NTC")
+    ex = out.simple_bind(mx.cpu(), data=(2, 4, 5))
+    mx.init.FusedRNN(mx.init.Xavier(), 3, 1, "lstm", bidirectional=True)(
+        "blstm_parameters", ex.arg_dict["blstm_parameters"])
+    x = np.random.RandomState(1).rand(2, 4, 5).astype(np.float32)
+    ex.forward(data=x)
+    fused_out = ex.outputs[0].asnumpy()
+    assert fused_out.shape == (2, 4, 6)   # 2*hidden concat
+
+    unpacked = cell.unpack_weights(
+        {"blstm_parameters": ex.arg_dict["blstm_parameters"]})
+    assert "blstm_l0_r_i2h_weight" in unpacked
+    stack = cell.unfuse()
+    uout, _ = stack.unroll(4, sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    shapes = {}
+    for name in uout.list_arguments():
+        if "begin_state" in name:
+            shapes[name] = (2, 3)
+    ex2 = uout.simple_bind(mx.cpu(), data=(2, 4, 5), **shapes)
+    for k, v in unpacked.items():
+        if k in ex2.arg_dict:
+            ex2.arg_dict[k][:] = v.asnumpy()
+    ex2.forward(data=x)
+    np.testing.assert_allclose(fused_out, ex2.outputs[0].asnumpy(),
+                               rtol=2e-5, atol=2e-6)
