@@ -2,5 +2,5 @@
 from . import params_serde
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, LibSVMIter)
-from .image_iters import (ImageRecordIter, CSVIter, MNISTIter,
-                          ImageDetRecordIter)
+from .image_iters import (ImageRecordIter, ImageRecordUInt8Iter,
+                          CSVIter, MNISTIter, ImageDetRecordIter)

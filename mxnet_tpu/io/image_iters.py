@@ -352,7 +352,10 @@ class ImageRecordIter(DataIter):
             raise StopIteration
         chw, labels, pad = item
         lab = labels[:, 0] if self._label_width == 1 else labels
-        return DataBatch(data=[array(chw)], label=[array(lab)], pad=pad,
+        # nd.array defaults to float32 (reference semantics) — keep the
+        # iterator's dtype (e.g. ImageRecordUInt8Iter's uint8) explicit
+        return DataBatch(data=[array(chw, dtype=chw.dtype)],
+                         label=[array(lab)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
 
@@ -587,3 +590,16 @@ def ImageDetRecordIter(path_imgrec=None, batch_size=None, data_shape=None,
             "explicit aug_list instead)" % sorted(unknown))
     return ImageDetIter(batch_size=batch_size, data_shape=data_shape,
                         path_imgrec=path_imgrec, **kwargs)
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """`mx.io.ImageRecordUInt8Iter` — ImageRecordIter emitting raw uint8
+    pixels (no mean/std/scale applied). reference: iter_image_recordio_2.cc
+    (ImageRecordUInt8Iter)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["dtype"] = "uint8"
+        for k in ("mean_r", "mean_g", "mean_b", "std_r", "std_g", "std_b",
+                  "scale", "mean_img"):
+            kwargs.pop(k, None)
+        super().__init__(*args, **kwargs)

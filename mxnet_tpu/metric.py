@@ -734,3 +734,78 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation (R_k / generalized MCC) over an
+    incrementally-grown confusion matrix. reference: metric.py (PCC).
+    Degenerates to MCC for binary problems."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        self.k = 2
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def _grow(self, inc):
+        self.lcm = _np.pad(self.lcm, ((0, inc), (0, inc)), "constant")
+        self.gcm = _np.pad(self.gcm, ((0, inc), (0, inc)), "constant")
+        self.k += inc
+
+    @staticmethod
+    def _calc_mcc(cmat):
+        n = cmat.sum()
+        x = cmat.sum(axis=1)   # true-class totals
+        y = cmat.sum(axis=0)   # predicted-class totals
+        cov_xx = _np.sum(x * (n - x))
+        cov_yy = _np.sum(y * (n - y))
+        if cov_xx == 0 or cov_yy == 0:
+            return float("nan")
+        i = cmat.diagonal()
+        cov_xy = _np.sum(i * n - x * y)
+        return cov_xy / (cov_xx * cov_yy) ** 0.5
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _to_numpy(label).ravel().astype(_np.int64)
+            pred_np = _to_numpy(pred)
+            if pred_np.ndim > 1 and pred_np.shape != label_np.shape:
+                pred_np = pred_np.argmax(axis=-1)
+            pred_np = pred_np.ravel().astype(_np.int64)
+            n = max(pred_np.max(), label_np.max()) + 1
+            if n > self.k:
+                self._grow(n - self.k)
+            bcm = _np.zeros((self.k, self.k))
+            for i, j in zip(pred_np, label_np):
+                bcm[i, j] += 1
+            self.lcm += bcm
+            self.gcm += bcm
+        self.num_inst += 1
+        self.global_num_inst += 1
+
+    @property
+    def sum_metric(self):
+        return self._calc_mcc(self.lcm) * self.num_inst
+
+    @property
+    def global_sum_metric(self):
+        return self._calc_mcc(self.gcm) * self.global_num_inst
+
+    @sum_metric.setter
+    def sum_metric(self, _):
+        pass
+
+    @global_sum_metric.setter
+    def global_sum_metric(self, _):
+        pass
+
+    def reset_local(self):
+        self.num_inst = 0.0
+        self.lcm = _np.zeros((self.k, self.k))
+
+    def reset(self):
+        self.num_inst = 0.0
+        self.global_num_inst = 0.0
+        self.gcm = _np.zeros((self.k, self.k))
+        self.lcm = _np.zeros((self.k, self.k))

@@ -297,3 +297,17 @@ def test_csv_iter_wrapped_lines(tmp_path):
     it2 = mx.io.CSVIter(data_csv=path2, data_shape=(6,), batch_size=3)
     b = next(iter(it2))
     assert b.data[0].shape == (3, 6) and b.pad == 0
+
+
+def test_image_record_uint8_iter(tmp_path):
+    """reference: ImageRecordUInt8Iter — raw uint8 pixels, no
+    mean/scale normalization applied."""
+    rec, idx, _ = _make_rec(tmp_path)
+    it = mx.io.ImageRecordUInt8Iter(path_imgrec=rec, path_imgidx=idx,
+                                    data_shape=(3, 32, 32), batch_size=8)
+    batch = next(iter(it))
+    d = batch.data[0]
+    assert d.dtype == np.uint8, d.dtype
+    arr = d.asnumpy()
+    assert arr.max() > 1  # raw pixel range, not normalized
+    assert arr.shape == (8, 3, 32, 32)

@@ -142,3 +142,21 @@ def test_amp_loss_scaler_dynamics():
     s.update_scale(False)
     s.update_scale(False)
     assert s.loss_scale == 16.0
+
+
+def test_metric_pcc_matches_binary_mcc():
+    """PCC (multiclass R_k) degenerates to MCC for binary problems
+    (reference: metric.py PCC)."""
+    m = mx.metric.create("pcc")
+    labels = nd.array(np.array([0, 0, 1, 1]))
+    preds = nd.array(np.array([[0.9, 0.1], [0.6, 0.4],
+                               [0.7, 0.3], [0.2, 0.8]]))
+    m.update([labels], [preds])
+    # confusion: pred [0,0,0,1] vs truth [0,0,1,1] -> MCC = 1/sqrt(3)
+    np.testing.assert_allclose(m.get()[1], 1.0 / np.sqrt(3), rtol=1e-6)
+    # perfect multiclass = 1.0, and the matrix grows with new classes
+    m.reset()
+    labels2 = nd.array(np.array([0, 1, 2, 3]))
+    preds2 = nd.array(np.eye(4, dtype=np.float32))
+    m.update([labels2], [preds2])
+    np.testing.assert_allclose(m.get()[1], 1.0)
