@@ -513,3 +513,80 @@ def _mp_lamb_update_phase2(weight, g, r1, r2, weight32=None, lr=0.01,
         ratio = jnp.minimum(ratio, upper_bound)
     new32 = w32 - lr * ratio * g
     return new32.astype(weight.dtype), new32
+
+
+@register("ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape=None):
+    """reference: src/operator/tensor/ravel.cc (_ravel_multi_index) —
+    (ndim, N) coordinates → flat indices under `shape`."""
+    coords = tuple(data.astype(jnp.int64))
+    return jnp.ravel_multi_index(coords, tuple(shape), mode="clip") \
+        .astype(jnp.int64)
+
+
+@register("linspace", creation=True)
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, ctx=None,
+              dtype="float32"):
+    """reference: np-compat linspace op (mx.nd.linspace)."""
+    from ..base import np_dtype
+    return jnp.linspace(float(start), float(stop), int(num),
+                        endpoint=bool(endpoint)).astype(np_dtype(dtype))
+
+
+@register("digamma")
+def _digamma(data):
+    """reference: unary_op psi (mx.nd.digamma)."""
+    return jax.scipy.special.digamma(data)
+
+
+def _im2col_fn(data, kernel, stride, dilate, pad):
+    n, c = data.shape[0], data.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        data.astype(jnp.float32), filter_shape=tuple(kernel),
+        window_strides=tuple(stride),
+        padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: (N, C*prod(kernel), out_h, out_w) -> (N, C*K, L)
+    return patches.reshape(n, patches.shape[1], -1).astype(data.dtype)
+
+
+def _conv_tuples(kernel, stride, dilate, pad):
+    k = tuple(kernel)
+    nd_ = len(k)
+    def _t(v, d):
+        if v is None:
+            return (d,) * nd_
+        v = tuple(v) if isinstance(v, (tuple, list)) else (v,) * nd_
+        return v
+    return k, _t(stride, 1), _t(dilate, 1), _t(pad, 0)
+
+
+@register("im2col")
+def _im2col(data, kernel=None, stride=None, dilate=None, pad=None):
+    """reference: src/operator/nn/im2col.h (im2col op) — unfold sliding
+    conv patches into a (N, C*prod(kernel), L) matrix."""
+    k, s, d, p = _conv_tuples(kernel, stride, dilate, pad)
+    return _im2col_fn(data, k, s, d, p)
+
+
+@register("col2im")
+def _col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
+            pad=None):
+    """reference: im2col.h (col2im op) — exact transpose of im2col:
+    scatter-add the column matrix back into (N, C, *output_size).
+    Implemented as the vjp of im2col, which IS that transpose."""
+    k, s, d, p = _conv_tuples(kernel, stride, dilate, pad)
+    out_size = tuple(output_size)
+    n = data.shape[0]
+    c = data.shape[1] // _prod(k)
+    zeros = jnp.zeros((n, c) + out_size, dtype=data.dtype)
+    _, vjp = jax.vjp(lambda x: _im2col_fn(x, k, s, d, p), zeros)
+    (img,) = vjp(data)
+    return img
+
+
+def _prod(xs):
+    r = 1
+    for x in xs:
+        r *= x
+    return r

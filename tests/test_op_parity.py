@@ -177,3 +177,36 @@ def test_batch_take_reshape_like_moments():
     m, v = nd.invoke("moments", a, axes=(1,))
     np.testing.assert_allclose(m.asnumpy(), a.asnumpy().mean(1), rtol=1e-5)
     np.testing.assert_allclose(v.asnumpy(), a.asnumpy().var(1), rtol=1e-4)
+
+
+def test_linspace_digamma_ravel():
+    np.testing.assert_allclose(nd.linspace(0, 1, 5).asnumpy(),
+                               [0, 0.25, 0.5, 0.75, 1.0])
+    np.testing.assert_allclose(
+        nd.digamma(nd.array(np.array([1.0]))).asnumpy(), [-0.57721566],
+        rtol=1e-5)
+    r = nd.ravel_multi_index(
+        nd.array(np.array([[0, 1], [2, 3]]), dtype="int64"), shape=(3, 4))
+    np.testing.assert_array_equal(r.asnumpy(), [2, 7])
+    # inverse of unravel_index
+    u = nd.unravel_index(r, shape=(3, 4))
+    np.testing.assert_array_equal(u.asnumpy(), [[0, 1], [2, 3]])
+
+
+def test_im2col_col2im():
+    """reference: im2col.h ops — col2im is the exact transpose; with
+    non-overlapping windows it is the exact inverse."""
+    from mxnet_tpu import autograd
+    x = nd.array(np.arange(2 * 3 * 4 * 4, dtype=np.float32)
+                 .reshape(2, 3, 4, 4))
+    cols = nd.im2col(x, kernel=(2, 2), stride=(2, 2))
+    assert cols.shape == (2, 12, 4)
+    back = nd.col2im(cols, output_size=(4, 4), kernel=(2, 2), stride=(2, 2))
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+    # overlapping windows: gradient counts patch membership
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.im2col(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1)).sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert g[0, 0, 0, 0] == 4.0 and g[0, 0, 2, 2] == 9.0
