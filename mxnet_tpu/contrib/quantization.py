@@ -31,8 +31,8 @@ from ..context import cpu
 from ..gluon import nn as _nn
 from ..gluon.block import HybridBlock
 
-__all__ = ["quantize_net", "calib_thresholds", "QuantizedDense",
-           "QuantizedConv2D"]
+__all__ = ["quantize_net", "quantize_model", "calib_thresholds",
+           "QuantizedDense", "QuantizedConv2D"]
 
 INT8_MAX = 127.0
 
@@ -364,3 +364,220 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
     if isinstance(network, HybridBlock):
         network._clear_cached_op()
     return network
+
+
+# ---------------------------------------------------------------------------
+# symbolic quantization (reference: quantization.py quantize_model — the
+# Module-API counterpart of quantize_net: a graph rewrite over NNVM JSON)
+# ---------------------------------------------------------------------------
+def _json_nodes(symbol):
+    import json as _json
+    return _json.loads(symbol.tojson())
+
+
+def _rebuild(graph):
+    import json as _json
+    from .. import symbol as _sym
+    return _sym.load_json(_json.dumps(graph))
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, excluded_op_names=None,
+                   calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   quantize_mode="smart", logger=None):
+    """Quantize a symbolic model: FullyConnected/Convolution nodes become
+    `_contrib_quantize_v2 → _contrib_quantized_* → _contrib_dequantize`
+    chains, weights/biases become offline-quantized int8 params with
+    scalar range params. Returns (qsym, qarg_params, aux_params).
+
+    reference: python/mxnet/contrib/quantization.py (quantize_model) over
+    src/operator/quantization/quantize_graph_pass.cc. Per-tensor symmetric
+    ranges, matching the scalar-range contract of the quantized ops here.
+    """
+    if quantized_dtype != "int8":
+        raise NotImplementedError("only int8 quantization is implemented")
+    excluded_sym_names = set(excluded_sym_names or ())
+    excluded_op_names = set(excluded_op_names or ())
+    log = logger or logging.getLogger(__name__)
+
+    graph = _json_nodes(sym)
+    nodes = graph["nodes"]
+    targets = {}
+    for i, n in enumerate(nodes):
+        if n["op"] in ("FullyConnected", "Convolution") \
+                and n["op"] not in excluded_op_names \
+                and n["name"] not in excluded_sym_names:
+            wsrc = nodes[n["inputs"][1][0]]
+            if wsrc["op"] != "null" or wsrc["name"] not in arg_params:
+                continue
+            if n["op"] == "Convolution":
+                attrs = n.get("attrs", {})
+                if attrs.get("layout") not in (None, "None", "NCHW"):
+                    continue
+            targets[i] = n
+
+    # ---- calibration: per-target INPUT ranges over calib batches -------
+    thresholds = {}
+    if calib_mode in ("naive", "entropy") and targets:
+        if calib_data is None:
+            raise ValueError("calib_mode=%r requires calib_data" % calib_mode)
+        from .. import symbol as _sym_mod
+        internals = sym.get_internals()
+        by_name = {s.name: s for s in internals}
+        need = {}   # target node idx -> internal symbol of its data input
+        for i, n in targets.items():
+            src, slot, _ = n["inputs"][0]
+            sname = nodes[src]["name"]
+            # multi-output internals register ONLY under _output<i> names
+            if sname + "_output%d" % slot in by_name:
+                sname = sname + "_output%d" % slot
+            need[i] = by_name[sname]
+        group = _sym_mod.Group(list(need.values()))
+        collector = _Collector()
+        if isinstance(calib_data, (nd.NDArray, _np.ndarray)):
+            calib_data = [calib_data]
+        seen = 0
+        ex, bound_shape = None, None
+        for batch in calib_data:
+            data = batch.data[0] if hasattr(batch, "data") else batch
+            if isinstance(data, nd.NDArray):
+                data = data.asnumpy()
+            if ex is None or data.shape != bound_shape:
+                ex = group.simple_bind(ctx or cpu(),
+                                       **{data_names[0]: data.shape})
+                bound_shape = data.shape
+                for k, v in arg_params.items():
+                    if k in ex.arg_dict:
+                        ex.arg_dict[k][:] = v.asnumpy()
+                for k, v in (aux_params or {}).items():
+                    if k in ex.aux_dict:
+                        ex.aux_dict[k][:] = v.asnumpy()
+            ex.forward(**{data_names[0]: data})
+            for idx, out in zip(need, ex.outputs):
+                collector.update(nodes[idx]["name"], out.asnumpy())
+            seen += data.shape[0]
+            if num_calib_examples and seen >= num_calib_examples:
+                break
+        thresholds = calib_thresholds(collector, calib_mode)
+        log.info("quantize_model: calibrated %d layers over %d examples",
+                 len(thresholds), seen)
+
+    # ---- graph rewrite -------------------------------------------------
+    qarg = {k: v for k, v in arg_params.items()}
+    new_nodes = list(nodes)
+    # remap[i] = (node_id, slot) replacing original node i's output 0
+    remap = {}
+
+    def _add(node):
+        new_nodes.append(node)
+        return len(new_nodes) - 1
+
+    def _fix(inp):
+        src, slot, x = inp
+        if src in remap and slot == 0:
+            return [remap[src][0], remap[src][1], x]
+        return [src, slot, x]
+
+    quantized_params = {}   # fp32 name -> (node ids) for tied weights
+
+    def _offline_quantize(pname):
+        """int8-quantize one fp32 param into qarg + three null nodes;
+        reused when several targets share (tie) the same variable."""
+        if pname in quantized_params:
+            return quantized_params[pname]
+        arr = arg_params[pname].asnumpy()
+        t = float(max(abs(arr.min()), abs(arr.max()), 1e-30))
+        qarg[pname + "_quantize"] = nd.array(
+            _np.clip(_np.round(arr * (INT8_MAX / t)), -INT8_MAX, INT8_MAX)
+            .astype(_np.int8), dtype="int8")
+        qarg[pname + "_quantize_min"] = nd.array(_np.array([-t],
+                                                           _np.float32))
+        qarg[pname + "_quantize_max"] = nd.array(_np.array([t],
+                                                           _np.float32))
+        del qarg[pname]
+        ids = (_add({"op": "null", "name": pname + "_quantize",
+                     "inputs": [],
+                     "attrs": {"__shape__": str(tuple(arr.shape)),
+                               "__dtype__": "int8"}}),
+               _add({"op": "null", "name": pname + "_quantize_min",
+                     "inputs": [], "attrs": {"__shape__": "(1,)"}}),
+               _add({"op": "null", "name": pname + "_quantize_max",
+                     "inputs": [], "attrs": {"__shape__": "(1,)"}}))
+        quantized_params[pname] = ids
+        return ids
+
+    for i in sorted(targets):
+        n = dict(targets[i])
+        attrs = dict(n.get("attrs", {}))
+        no_bias = str(attrs.get("no_bias", "False")).lower() in ("true", "1")
+        wname = new_nodes[n["inputs"][1][0]]["name"]
+        wq, wmin, wmax = _offline_quantize(wname)
+        if not no_bias and len(n["inputs"]) > 2:
+            bname = new_nodes[n["inputs"][2][0]]["name"]
+            bq, bmin, bmax = _offline_quantize(bname)
+        else:
+            bq, bmin, bmax = wq, wmin, wmax  # placeholders, never read
+            attrs["no_bias"] = "True"
+
+        qv_attrs = {"out_type": "int8"}
+        if n["name"] in thresholds:
+            qv_attrs["min_calib_range"] = str(-thresholds[n["name"]])
+            qv_attrs["max_calib_range"] = str(thresholds[n["name"]])
+        qv = _add({"op": "_contrib_quantize_v2",
+                   "name": n["name"] + "_quantize", "attrs": qv_attrs,
+                   "inputs": [_fix(n["inputs"][0])]})
+        qop = _add({"op": "_contrib_quantized_" +
+                    ("fully_connected" if n["op"] == "FullyConnected"
+                     else "conv"),
+                    "name": n["name"] + "_quantized", "attrs": attrs,
+                    "inputs": [[qv, 0, 0], [wq, 0, 0], [bq, 0, 0],
+                               [qv, 1, 0], [qv, 2, 0], [wmin, 0, 0],
+                               [wmax, 0, 0], [bmin, 0, 0], [bmax, 0, 0]]})
+        deq = _add({"op": "_contrib_dequantize",
+                    "name": n["name"] + "_dequantize", "attrs": {},
+                    "inputs": [[qop, 0, 0], [qop, 1, 0], [qop, 2, 0]]})
+        remap[i] = (deq, 0)
+
+    # rewire every consumer (and heads) onto the dequantized outputs
+    for j, n in enumerate(new_nodes):
+        if n.get("inputs") and j not in (r[0] for r in remap.values()):
+            if not (n["name"].endswith("_quantize")
+                    or n["name"].endswith("_quantized")
+                    or n["name"].endswith("_dequantize")):
+                n["inputs"] = [_fix(inp) for inp in n["inputs"]]
+    graph["heads"] = [list(_fix(h)) for h in graph["heads"]]
+
+    # the rewrite appended producers after their consumers; NNVM JSON
+    # requires topological order — re-sort and renumber
+    order, seen = [], set()
+
+    def visit(j):
+        if j in seen:
+            return
+        seen.add(j)
+        for src, _, _ in new_nodes[j].get("inputs", []):
+            visit(src)
+        order.append(j)
+
+    for h in graph["heads"]:
+        visit(h[0])
+    for j in range(len(new_nodes)):   # keep unreferenced args too
+        visit(j)
+    renum = {old: new for new, old in enumerate(order)}
+    sorted_nodes = []
+    for old in order:
+        n = dict(new_nodes[old])
+        n["inputs"] = [[renum[s], sl, x]
+                       for s, sl, x in n.get("inputs", [])]
+        sorted_nodes.append(n)
+    graph["heads"] = [[renum[h[0]], h[1], h[2]] for h in graph["heads"]]
+    graph["nodes"] = sorted_nodes
+    graph["arg_nodes"] = [j for j, n in enumerate(sorted_nodes)
+                          if n["op"] == "null"]
+    graph["node_row_ptr"] = list(range(len(sorted_nodes) + 1))
+
+    qsym = _rebuild(graph)
+    log.info("quantize_model: %d layers quantized", len(targets))
+    return qsym, qarg, dict(aux_params or {})

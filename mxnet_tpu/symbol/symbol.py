@@ -48,6 +48,7 @@ class Symbol:
         self._num_outputs = num_outputs
         self._out_index = out_index  # int → this symbol is one output slice
         self._outputs_cache = None
+        self._base_ref = None        # sliced symbols: the real base object
 
     # ------------------------------------------------------------------
     @property
@@ -83,8 +84,13 @@ class Symbol:
                              (index, self._num_outputs))
         if self._num_outputs == 1:
             return self
-        return Symbol(self._op, self._name, self._inputs, self._attrs,
-                      self._kwargs, self._num_outputs, out_index=index)
+        sliced = Symbol(self._op, self._name, self._inputs, self._attrs,
+                        self._kwargs, self._num_outputs, out_index=index)
+        # keep the real base object so graph dedup (topo/tojson) sees ONE
+        # node regardless of how many slices reference it
+        sliced._base_ref = self if self._out_index is None \
+            else self._base_node()
+        return sliced
 
     def __len__(self):
         return len(self.list_outputs())
@@ -111,8 +117,12 @@ class Symbol:
     def _base_node(self):
         if self._out_index is None:
             return self
-        return Symbol(self._op, self._name, self._inputs, self._attrs,
+        if self._base_ref is not None:
+            return self._base_ref
+        base = Symbol(self._op, self._name, self._inputs, self._attrs,
                       self._kwargs, self._num_outputs)
+        self._base_ref = base
+        return base
 
     def _heads(self):
         """Output symbols (for groups: members)."""
@@ -289,6 +299,8 @@ class Symbol:
             return shapes.get(base_name)
 
         for n in nodes:
+            if n._op == "_group":
+                continue  # structural node; heads are inferred individually
             if n._op is None:
                 if n._is_literal():
                     lit = n._literal_value()
@@ -403,6 +415,8 @@ class Symbol:
         NDArray or a list."""
         node_vals = {}
         for n in self._topo():
+            if n._op == "_group":
+                continue  # structural node; heads evaluated individually
             if n._op is None:
                 lit = n._literal_value(ctx)
                 if lit is not None:
@@ -473,17 +487,26 @@ class Symbol:
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         type_dict = type_dict or {}
+        # variables may pin their dtype via the __dtype__ attr (e.g. the
+        # int8 params quantize_model emits) — honor it unless overridden
+        var_dtypes = {n._name: n._attrs["__dtype__"]
+                      for n in self._topo()
+                      if n._op is None and "__dtype__" in n._attrs}
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
             if shape is None:
                 raise MXNetError("simple_bind could not infer shape for "
                                  "argument %s" % name)
             args[name] = nd.zeros(shape, ctx=ctx,
-                                  dtype=type_dict.get(name, _np.float32))
+                                  dtype=type_dict.get(
+                                      name, var_dtypes.get(name,
+                                                           _np.float32)))
         aux = {}
         for name, shape in zip(aux_names, aux_shapes):
             aux[name] = nd.zeros(shape, ctx=ctx,
-                                 dtype=type_dict.get(name, _np.float32))
+                                 dtype=type_dict.get(
+                                     name, var_dtypes.get(name,
+                                                          _np.float32)))
         args_grad = None
         if grad_req != "null":
             args_grad = {name: nd.zeros(a.shape, ctx=ctx, dtype=a.dtype)
@@ -707,7 +730,9 @@ def load_json(json_str):
             ins = []
             for (src, out_i, _) in entry["inputs"]:
                 s = built[src]
-                if out_i and s._num_outputs > 1:
+                # slot 0 of a multi-output node still needs slicing — the
+                # bare symbol is the whole output group
+                if s._num_outputs > 1:
                     s = s[out_i]
                 ins.append(s)
             kwargs = {k: _parse_attr(v)
@@ -721,7 +746,7 @@ def load_json(json_str):
     heads = []
     for (idx, out_i, _) in graph["heads"]:
         s = built[idx]
-        if out_i and s._num_outputs > 1:
+        if s._num_outputs > 1:
             s = s[out_i]
         heads.append(s)
     return heads[0] if len(heads) == 1 else Group(heads)

@@ -158,3 +158,80 @@ def test_quantize_net_hybridized_calibrates():
         "hybridized calibration produced no thresholds"
     out = qnet(x)  # runs through a fresh trace
     assert np.isfinite(out.asnumpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# symbolic quantize_model (reference: quantization.py quantize_model)
+# ---------------------------------------------------------------------------
+def _sym_model():
+    from mxnet_tpu import sym
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, sym.Variable("c1_w"), sym.Variable("c1_b"),
+                         kernel=(3, 3), num_filter=8, pad=(1, 1), name="c1")
+    a1 = sym.Activation(c1, act_type="relu")
+    f1 = sym.FullyConnected(a1, sym.Variable("f1_w"), sym.Variable("f1_b"),
+                            num_hidden=10, name="f1")
+    return sym.softmax(f1, name="sm")
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_model_symbolic(calib_mode):
+    out = _sym_model()
+    rng = np.random.RandomState(0)
+    ex = out.simple_bind(mx.cpu(), data=(4, 3, 8, 8))
+    arg_params = {}
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = rng.randn(*v.shape).astype(np.float32) * 0.1
+            arg_params[k] = v.copy()
+    x = rng.rand(4, 3, 8, 8).astype(np.float32)
+    ex.forward(data=x)
+    ref = ex.outputs[0].asnumpy()
+
+    qsym, qarg, _ = qz.quantize_model(
+        out, arg_params, {}, calib_mode=calib_mode,
+        calib_data=nd.array(x) if calib_mode != "none" else None)
+    # fp32 weights replaced by int8 + range params
+    assert "c1_w" not in qarg and "c1_w_quantize" in qarg
+    assert qarg["c1_w_quantize"].dtype == np.int8
+    # the rewritten graph serializes and reloads (JSON roundtrip)
+    import mxnet_tpu.symbol as msym
+    qsym = msym.load_json(qsym.tojson())
+    qex = qsym.simple_bind(mx.cpu(), data=(4, 3, 8, 8))
+    # public path: simple_bind honors __dtype__ (int8 buffers), copyto
+    # preserves the payload
+    assert qex.arg_dict["c1_w_quantize"].dtype == np.int8
+    for k, v in qarg.items():
+        if k in qex.arg_dict:
+            v.copyto(qex.arg_dict[k])
+    qex.forward(data=x)
+    got = qex.outputs[0].asnumpy()
+    cos = (ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got))
+    assert cos > 0.999, cos
+    assert (got.argmax(-1) == ref.argmax(-1)).all()
+
+
+def test_quantize_model_excludes():
+    out = _sym_model()
+    rng = np.random.RandomState(0)
+    ex = out.simple_bind(mx.cpu(), data=(2, 3, 8, 8))
+    arg_params = {k: v.copy() for k, v in ex.arg_dict.items()
+                  if k != "data"}
+    qsym, qarg, _ = qz.quantize_model(out, arg_params, {},
+                                      excluded_sym_names=["c1"],
+                                      calib_mode="none")
+    assert "c1_w" in qarg and "c1_w_quantize" not in qarg
+    assert "f1_w_quantize" in qarg
+
+
+def test_load_json_multi_output_slot0():
+    """Slot 0 of a multi-output node must be sliced on reload (was: the
+    whole output group leaked into the consumer)."""
+    from mxnet_tpu import sym
+    import mxnet_tpu.symbol as msym
+    q = sym.contrib.quantize_v2(sym.Variable("x"))
+    out = q[0].astype("float32") * 2.0
+    loaded = msym.load_json(out.tojson())
+    ex = loaded.simple_bind(mx.cpu(), x=(2, 3))
+    ex.forward(x=np.ones((2, 3), np.float32))
+    assert ex.outputs[0].shape == (2, 3)

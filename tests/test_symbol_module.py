@@ -81,6 +81,7 @@ def test_simple_bind_forward_backward():
 
 
 def test_module_fit():
+    mx.random.seed(0)
     np.random.seed(0)
     x = np.random.normal(size=(96, 8)).astype("float32")
     w = np.random.normal(size=(8, 3)).astype("float32")
