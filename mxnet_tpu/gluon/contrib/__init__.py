@@ -3,5 +3,6 @@ from . import cnn
 from . import nn
 from . import rnn
 from . import estimator
+from . import data
 
-__all__ = ["cnn", "nn", "rnn", "estimator"]
+__all__ = ["cnn", "nn", "rnn", "estimator", "data"]

@@ -1,0 +1,5 @@
+"""`gluon.contrib.data` (reference: python/mxnet/gluon/contrib/data/)."""
+from . import sampler
+from .sampler import IntervalSampler
+
+__all__ = ["sampler", "IntervalSampler"]

@@ -13,7 +13,8 @@ from .context import Context, cpu, tpu, current_context
 from . import ndarray as nd
 from .ndarray import NDArray
 
-__all__ = ["download",
+__all__ = ["download", "rand_shape_2d", "rand_shape_3d",
+           "rand_sparse_ndarray", "same_symbol_structure", "discard_stderr",
            "default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "same", "rand_shape_nd", "rand_ndarray",
            "random_arrays", "check_numeric_gradient", "numeric_grad",
@@ -322,3 +323,69 @@ def download(url, fname=None, dirname=None, overwrite=False, retries=5):
     elif fname is not None:
         path = fname
     return _dl(url, path=path, overwrite=overwrite, retries=retries)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    """reference: test_utils.py (rand_shape_2d)."""
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        distribution="uniform"):
+    """Random sparse NDArray + its dense numpy mirror.
+    reference: test_utils.py (rand_sparse_ndarray) — returns (arr, (data
+    tuple)) there; here (arr, dense_np) which is what tests actually use."""
+    from .ndarray import sparse as sp
+    density = np.random.rand() if density is None else density
+    dtype = np.float32 if dtype is None else np.dtype(dtype)
+    dense = np.random.rand(*shape).astype(dtype)
+    if stype == "row_sparse":
+        mask = np.random.rand(shape[0]) < density
+        dense[~mask] = 0
+        return sp.row_sparse_array(dense), dense
+    if stype == "csr":
+        mask = np.random.rand(*shape) < density
+        dense = dense * mask
+        return sp.csr_matrix(dense), dense
+    raise ValueError("unknown stype %s" % stype)
+
+
+def same_symbol_structure(sym1, sym2):
+    """True when two symbols have identical graph structure (ops and
+    topology; names ignored). reference: test_utils.py
+    (same_symbol_structure)."""
+    import json as _json
+    def skeleton(s):
+        g = _json.loads(s.tojson())
+        return [(n["op"], [tuple(i[:2]) for i in n.get("inputs", [])])
+                for n in g["nodes"]]
+    return skeleton(sym1) == skeleton(sym2)
+
+
+class discard_stderr:
+    """Context manager silencing fd-level stderr (reference:
+    test_utils.py discard_stderr — used around intentionally-noisy
+    calls)."""
+
+    def __enter__(self):
+        import os as _os
+        import sys as _sys
+        _sys.stderr.flush()
+        self._fd = _os.dup(2)
+        self._null = _os.open(_os.devnull, _os.O_WRONLY)
+        _os.dup2(self._null, 2)
+        return self
+
+    def __exit__(self, *exc):
+        import os as _os
+        import sys as _sys
+        _sys.stderr.flush()
+        _os.dup2(self._fd, 2)
+        _os.close(self._null)
+        _os.close(self._fd)
+        return False

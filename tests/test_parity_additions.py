@@ -401,3 +401,21 @@ def test_fused_unfused_bidirectional_interchange():
     ex2.forward(data=x)
     np.testing.assert_allclose(fused_out, ex2.outputs[0].asnumpy(),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_interval_sampler_and_test_utils():
+    s = gluon.contrib.data.IntervalSampler(10, 3)
+    order = list(s)
+    assert sorted(order) == list(range(10)) and order[:4] == [0, 3, 6, 9]
+    assert list(gluon.contrib.data.IntervalSampler(10, 3,
+                                                   rollover=False)) == \
+        [0, 3, 6, 9]
+    arr, dense = mx.test_utils.rand_sparse_ndarray((6, 3), "row_sparse",
+                                                   density=0.5)
+    np.testing.assert_allclose(arr.tostype("default").asnumpy(), dense)
+    a = sym.FullyConnected(sym.Variable("x"), sym.Variable("w"),
+                           sym.Variable("b"), num_hidden=4)
+    b = sym.FullyConnected(sym.Variable("q"), sym.Variable("r"),
+                           sym.Variable("t"), num_hidden=4)
+    assert mx.test_utils.same_symbol_structure(a, b)
+    assert not mx.test_utils.same_symbol_structure(a, sym.softmax(a))
