@@ -18,6 +18,8 @@ src/ndarray/ndarray.cc). Design deltas from the reference, chosen for XLA:
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as _np
 
 import jax
@@ -660,17 +662,26 @@ def _invoke(op_name, *args, out=None, **kwargs):
     try:
         if recording:
             nd_inputs = [args[p] for p in nd_positions]
+
+            def closed(*arrs):
+                full = list(raw_args)
+                for p, a in zip(nd_positions, arrs):
+                    full[p] = a
+                return fn(*full, **kwargs)
             override = None
             if op.record_override is not None:
                 override = op.record_override(raw_args, kwargs, nd_inputs, fn)
             if override is not None:
                 out_raw, vjp_fn, primal = override
+            elif op.vjp_rule is not None and _AMP_WRAP is None:
+                # FGradient-style rule: plain forward (no per-call
+                # jax.vjp trace); the rule computes cotangents directly
+                out_raw = fn(*raw_args, **kwargs)
+                vjp_fn = functools.partial(op.vjp_rule, out=out_raw,
+                                           raw_args=raw_args, kwargs=kwargs,
+                                           nd_positions=nd_positions)
+                primal = closed
             else:
-                def closed(*arrs):
-                    full = list(raw_args)
-                    for p, a in zip(nd_positions, arrs):
-                        full[p] = a
-                    return fn(*full, **kwargs)
                 inputs_raw = [raw_args[p] for p in nd_positions]
                 out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
                 primal = closed

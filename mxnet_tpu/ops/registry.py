@@ -38,6 +38,7 @@ class Operator:
         self.shape_hint = None        # fn(in_shapes, kwargs) -> in_shapes
         #   fills unknown (None) input shapes from known ones — the forward
         #   half of the reference's bidirectional FInferShape
+        self.vjp_rule = None          # optional FGradient-style rule
         self.record_override = None   # optional custom tape recording:
         #   f(raw_args, kwargs, nd_inputs, fn) -> (out_raw, vjp_fn,
         #   primal_fn) or None to fall back to the generic jax.vjp path.
@@ -56,6 +57,18 @@ class Operator:
     def recorder(self, fn):
         """Register a custom tape-recording path (see record_override)."""
         self.record_override = fn
+        return fn
+
+    def def_grad(self, fn):
+        """Register a hand-written vjp rule — the FGradient analog
+        (reference: NNVM_REGISTER_OP(...).set_attr<FGradient>(...)).
+
+        fn(cot, out_raw, raw_args, kwargs, nd_positions) -> tuple of
+        cotangents aligned with nd_positions (None where undefined).
+        With a rule, the eager tape records WITHOUT calling jax.vjp —
+        the per-op trace (~2 ms) collapses to a plain forward, and the
+        backward runs the rule's jnp math directly."""
+        self.vjp_rule = fn
         return fn
 
     def best_fn(self, on_tpu):
