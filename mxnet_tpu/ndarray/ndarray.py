@@ -618,6 +618,84 @@ def _poisoned_outputs(exc_entry, op, ctx, out=None):
     return outs[0] if op.num_outputs == 1 and len(outs) == 1 else outs
 
 
+# --------------------------------------------------------------------------
+# signature-counted backward cache for rule-less recorded ops.
+#
+# The generic tape pays a jax.vjp re-trace on EVERY recorded call. Once the
+# same (op, kwargs, input signature) has been seen a few times — a training
+# loop — the backward is compiled ONCE as a jitted recompute program
+# (jax.vjp inside jit) and reused every step. One-off signatures (numeric
+# sweeps, ad-hoc shapes) never reach the threshold and keep the cheap
+# uncompiled path; compile cost is only spent where it amortizes.
+# --------------------------------------------------------------------------
+_SIG_SEEN: dict = {}
+_BWD_PROGS: dict = {}
+_BWD_THRESHOLD = 3
+_BWD_CACHE_MAX = 512
+
+
+def _sig_key(op_name, fn, raw_args, kwargs, nd_positions, inputs_raw):
+    try:
+        static = tuple(
+            (i, a) for i, a in enumerate(raw_args) if i not in nd_positions)
+        kw = tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in kwargs.items()))
+        avals = tuple((tuple(a.shape), str(a.dtype)) for a in inputs_raw)
+        # id(fn) pins the RESOLVED implementation (op.fn vs a Pallas
+        # tpu_impl, either may be switched/registered at runtime) so a
+        # cached backward can never differentiate a different fn than
+        # the forward ran
+        key = (op_name, id(fn), static, kw, avals)
+        hash(key)
+        return key
+    except TypeError:
+        return None
+
+
+def _cached_backward(op_name, fn, raw_args, kwargs, nd_positions,
+                     inputs_raw):
+    """Jitted backward program for a hot signature, else None."""
+    if any(_is_tracer(a) for a in inputs_raw):
+        return None
+    key = _sig_key(op_name, fn, raw_args, kwargs, nd_positions,
+                   inputs_raw)
+    if key is None:
+        return None
+    if len(_SIG_SEEN) >= 16384:   # bound the counter table itself
+        _SIG_SEEN.clear()
+    seen = _SIG_SEEN.get(key, 0) + 1
+    _SIG_SEEN[key] = seen
+    if seen < _BWD_THRESHOLD:
+        return None
+    prog = _BWD_PROGS.get(key)
+    if prog is None:
+        # null the dynamic slots: the closure must NOT retain the first
+        # hot call's device buffers
+        pos_set = set(nd_positions)
+        fixed = [None if i in pos_set else a
+                 for i, a in enumerate(raw_args)]
+        positions = list(nd_positions)
+        kw = dict(kwargs)
+
+        def rebuilt(*arrs):
+            full = list(fixed)
+            for p, a in zip(positions, arrs):
+                full[p] = a
+            return fn(*full, **kw)
+
+        @jax.jit
+        def prog(*ins_and_cot):
+            ins = ins_and_cot[:-1]
+            cot = ins_and_cot[-1]
+            return jax.vjp(rebuilt, *ins)[1](cot)
+        if len(_BWD_PROGS) >= _BWD_CACHE_MAX:
+            _BWD_PROGS.clear()   # simple bound; rebuilt on demand
+            _SIG_SEEN.clear()
+        _BWD_PROGS[key] = prog
+    return prog
+
+
 def _invoke(op_name, *args, out=None, **kwargs):
     op = _reg.get(op_name)
     from .. import autograd
@@ -683,7 +761,19 @@ def _invoke(op_name, *args, out=None, **kwargs):
                 primal = closed
             else:
                 inputs_raw = [raw_args[p] for p in nd_positions]
-                out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
+                cached = None
+                if _AMP_WRAP is None:  # AMP wraps fn per-call: uncacheable
+                    cached = _cached_backward(op_name, fn, raw_args,
+                                              kwargs, nd_positions,
+                                              inputs_raw)
+                if cached is not None:
+                    # hot signature: plain forward + a jit-compiled
+                    # recompute-backward program (traced/compiled once,
+                    # reused every step — the CachedOp-for-the-tape idea)
+                    out_raw = fn(*raw_args, **kwargs)
+                    vjp_fn = functools.partial(cached, *inputs_raw)
+                else:
+                    out_raw, vjp_fn = jax.vjp(closed, *inputs_raw)
                 primal = closed
             outputs = _wrap_out(out_raw, ctx)
             autograd.record_op(op_name, nd_inputs,
