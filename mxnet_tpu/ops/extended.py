@@ -59,7 +59,8 @@ def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
 
 @register("unravel_index", differentiable=False)
 def _unravel_index(data, shape=None):
-    idx = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    idx = jnp.unravel_index(data.astype(dt), tuple(shape))
     return jnp.stack(idx, axis=0).astype(data.dtype)
 
 
@@ -518,10 +519,13 @@ def _mp_lamb_update_phase2(weight, g, r1, r2, weight32=None, lr=0.01,
 @register("ravel_multi_index", differentiable=False)
 def _ravel_multi_index(data, shape=None):
     """reference: src/operator/tensor/ravel.cc (_ravel_multi_index) —
-    (ndim, N) coordinates → flat indices under `shape`."""
-    coords = tuple(data.astype(jnp.int64))
+    (ndim, N) coordinates → flat indices under `shape`. int64 only under
+    x64 / large_tensor_scope; int32 otherwise (avoids jax's truncation
+    warning on the default build)."""
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    coords = tuple(data.astype(dt))
     return jnp.ravel_multi_index(coords, tuple(shape), mode="clip") \
-        .astype(jnp.int64)
+        .astype(dt)
 
 
 @register("linspace", creation=True)
