@@ -26,6 +26,34 @@ import numpy as _np
 
 BASELINE_IMG_S = 2250.0
 
+# set by _probe_backend when the accelerator is unreachable; folded into the
+# telemetry counters at emit time (importing mxnet_tpu inside the probe would
+# initialize the very backend the probe guards against)
+_FELL_BACK = False
+
+
+def _emit(payload):
+    """Print the single bench JSON line, with the telemetry counters that
+    explain WHY a number moved: total jit compiles and whether the run
+    silently fell back to cpu (the BENCH_r05 failure mode)."""
+    try:
+        from mxnet_tpu import telemetry
+        if _FELL_BACK:
+            telemetry.inc("device.fallback_cpu")
+        snap = telemetry.snapshot()["counters"] if telemetry.ENABLED else {}
+        payload["counters"] = {
+            "compile": (snap.get("cachedop.compile", 0)
+                        + snap.get("fused_step.compile", 0)
+                        + snap.get("train_step.compile", 0)),
+            "cachedop_retrace": snap.get("cachedop.retrace", 0),
+            "device_fallback": snap.get("device.fallback_cpu",
+                                        1 if _FELL_BACK else 0),
+            "sync_asnumpy": snap.get("ndarray.sync.asnumpy", 0),
+        }
+    except Exception as e:   # telemetry must never break the bench row
+        print("# telemetry counters unavailable: %s" % e, file=sys.stderr)
+    print(json.dumps(payload))
+
 
 def _sync(x):
     """True device barrier. On the axon PjRt tunnel `block_until_ready`
@@ -411,6 +439,8 @@ def _probe_backend(timeout=240):
             watchdog.cancel()
     print("# accelerator backend unreachable (%s) — falling back to cpu"
           % reason, file=sys.stderr)
+    global _FELL_BACK
+    _FELL_BACK = True
     jax.config.update("jax_platforms", "cpu")
     return jax.devices()[0]
 
@@ -428,13 +458,13 @@ def main():
         fast, base = bench_fn(on_accel)
         name = ("fused_conv_bn_relu" if which == "fused"
                 else "fused_conv_bn_relu_train")
-        print(json.dumps({
+        _emit({
             "metric": ("%s_img_per_sec" % name if on_accel
                        else "%s_cpu_img_per_sec" % name),
             "value": round(fast, 2),
             "unit": "img/s",
             "vs_baseline": round(fast / base, 4),   # vs XLA composed
-        }))
+        })
         return
     if which in ("bert", "bert_gluon"):
         tok_s, _ = (bench_bert if which == "bert"
@@ -444,12 +474,12 @@ def main():
                 else "bert_tiny_cpu_tok_per_sec")
         if which == "bert_gluon":
             name = name.replace("tok_per_sec", "gluon_tok_per_sec")
-        print(json.dumps({
+        _emit({
             "metric": name,
             "value": round(tok_s, 2),
             "unit": "tok/s",
             "vs_baseline": round(tok_s / bert_bar, 4),
-        }))
+        })
         return
     if which == "functional":
         img_s, path = bench_functional(on_accel)
@@ -490,12 +520,12 @@ def main():
         # (round-1 key kept for the functional config)
         name = ("resnet_tiny_cpu_img_per_sec" if path == "functional"
                 else "resnet18_cpu_%s_img_per_sec" % path)
-    print(json.dumps({
+    _emit({
         "metric": name,
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
-    }))
+    })
 
 
 if __name__ == "__main__":

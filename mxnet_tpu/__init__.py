@@ -9,6 +9,8 @@ Import as `import mxnet_tpu as mx` — the public surface mirrors the reference:
 from . import base
 from .base import MXNetError, __version__
 
+from . import telemetry
+
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
                       num_gpus, num_tpus, current_context)
 

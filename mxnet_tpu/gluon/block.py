@@ -515,6 +515,10 @@ class CachedOp:
         self._static_alloc = static_alloc
         self._static_shape = static_shape
         self._jitted = {}
+        # (train, input shapes/dtypes) signatures already traced — the
+        # telemetry view of jit's compilation cache (src/profiler counters
+        # have no reference analog for this; recompiles were silent)
+        self._sig_seen = set()
 
     def _make(self, train, fmt_holder):
         block = self._block
@@ -562,19 +566,65 @@ class CachedOp:
     def __call__(self, block_params, args):
         """block_params: list[Parameter]; args: forward inputs (nested)."""
         from .. import profiler as _profiler
-        if _profiler._state == "run" and _profiler._config["profile_symbolic"]:
+        from .. import telemetry as _telem
+        impl = self._call_telemetry if _telem.ENABLED else self._call_impl
+        if _profiler.is_profiling("profile_symbolic"):
             import time as _time
             t0 = _time.perf_counter()
             try:
-                return self._call_impl(block_params, args)
+                return impl(block_params, args)
             finally:
                 _profiler.record_op(
                     "CachedOp:" + getattr(self._block, "name", "block"),
                     _time.perf_counter() - t0)
-        return self._call_impl(block_params, args)
+        return impl(block_params, args)
 
-    def _call_impl(self, block_params, args):
-        flat_args, in_fmt = _flatten(args, "input")
+    def _call_telemetry(self, block_params, args):
+        """JIT-cache instrumentation: a signature seen for the first time is
+        a cache miss whose wall time IS the first-trace/compile time (jit
+        traces lazily on first call); later calls with a known signature are
+        cache hits. Any miss after the first is a retrace — the silent
+        recompile this exists to expose. A failed first call records a
+        trace_error and leaves the signature unseen, so the retry that
+        actually pays the compile is counted as the compile."""
+        import time as _time
+        from .. import telemetry as _telem
+        flat = _flatten(args, "input")
+        train = autograd.is_training()
+        sig = (train, tuple(
+            (tuple(a.shape), str(a.dtype)) if isinstance(a, nd.NDArray)
+            else repr(a) for a in flat[0]))
+        is_compile = sig not in self._sig_seen
+        ts = _telem.span_clock()
+        t0 = _time.perf_counter()
+        try:
+            out = self._call_impl(block_params, args, _flat=flat)
+        except Exception:
+            if is_compile:
+                _telem.inc("cachedop.trace_error")
+            raise
+        dur = _time.perf_counter() - t0
+        name = getattr(self._block, "name", "block")
+        if is_compile:
+            self._sig_seen.add(sig)
+            _telem.inc("cachedop.cache_miss")
+            _telem.inc("cachedop.compile")
+            if len(self._sig_seen) > 1:
+                _telem.inc("cachedop.retrace")
+            _telem.observe("cachedop.compile_ms", dur * 1e3)
+            _telem.record_span(
+                "compile:%s:%s" % (name, "train" if train else "predict"),
+                "jit", ts, dur)
+        else:
+            _telem.inc("cachedop.cache_hit")
+            _telem.record_span("cachedop:%s" % name, "dispatch", ts, dur)
+        return out
+
+    def _call_impl(self, block_params, args, _flat=None):
+        # _flat: (flat_args, in_fmt) already computed by _call_telemetry —
+        # the hot dispatch path must not walk the input pytree twice
+        flat_args, in_fmt = _flat if _flat is not None else \
+            _flatten(args, "input")
         ctx = None
         for a in flat_args:
             if isinstance(a, nd.NDArray):

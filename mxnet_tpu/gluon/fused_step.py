@@ -41,6 +41,7 @@ at the HBM level — the buffer-swap NDArray mutation model at full speed.
 from __future__ import annotations
 
 import math
+import time
 
 import numpy as _np
 
@@ -49,6 +50,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import ndarray as nd
+from .. import telemetry as _telem
 from ..context import current_context
 from .block import _AUX_COLLECTOR, _TRACE_STATE, _flatten, _regroup
 
@@ -589,6 +591,19 @@ class FusedTrainStep:
     # ------------------------------------------------------------------
     def __call__(self, data, label):
         """Run one fused step; returns the mean loss as an NDArray."""
+        if not _telem.ENABLED:
+            return self._step(data, label)
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        try:
+            return self._step(data, label)
+        finally:
+            dur = time.perf_counter() - t0
+            _telem.observe("fused_step.step_ms", dur * 1e3)
+            _telem.record_span("fused_step", "step", ts, dur)
+            _telem.maybe_sample_memory()
+
+    def _step(self, data, label):
         flat_data, in_fmt = _flatten(data, "input")
         ctx = flat_data[0].context
         if not self._built:
@@ -597,6 +612,7 @@ class FusedTrainStep:
         # different pytree structure must not reuse a stale trace
         prog = self._programs.get(repr(in_fmt))
         if prog is None:
+            _telem.inc("fused_step.compile")
             prog = self._make_program(in_fmt)
             self._programs[repr(in_fmt)] = prog
         jitted, holder = prog

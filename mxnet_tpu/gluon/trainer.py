@@ -10,10 +10,12 @@ API-parity path.
 """
 from __future__ import annotations
 
+import time
 import warnings
 
 from .. import kvstore as kvs
 from .. import optimizer as opt
+from .. import telemetry as _telem
 from ..context import current_context
 from .parameter import Parameter, ParameterDict
 
@@ -191,6 +193,19 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update step: grad allreduce + optimizer.
         reference: Trainer.step."""
+        if not _telem.ENABLED:
+            return self._step_impl(batch_size, ignore_stale_grad)
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        try:
+            return self._step_impl(batch_size, ignore_stale_grad)
+        finally:
+            dur = time.perf_counter() - t0
+            _telem.observe("trainer.step_ms", dur * 1e3)
+            _telem.record_span("trainer.step", "step", ts, dur)
+            _telem.maybe_sample_memory()
+
+    def _step_impl(self, batch_size, ignore_stale_grad):
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:

@@ -25,6 +25,7 @@ import pickle
 
 from .. import ndarray as nd
 from .. import optimizer as opt
+from .. import telemetry as _telem
 from ..base import MXNetError
 
 __all__ = ["KVStore", "KVStoreLocal", "create"]
@@ -32,6 +33,32 @@ __all__ = ["KVStore", "KVStoreLocal", "create"]
 
 def _key_list(key):
     return key if isinstance(key, (list, tuple)) else [key]
+
+
+def _payload_bytes(value):
+    """Total payload bytes of a (nested) list of NDArrays — the comm-volume
+    number the reference's PS path would see on the wire. Best effort:
+    entries without size/dtype (symbols, raw scalars) count zero."""
+    total = 0
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+        elif v is not None:
+            try:
+                total += int(v.size) * int(v.dtype.itemsize)
+            except Exception:
+                pass
+    return total
+
+
+def _record_comm(direction, value):
+    """Telemetry hook shared by every store backend's push/pull."""
+    _telem.inc("kvstore.%s_calls" % direction)
+    nbytes = _payload_bytes(value)
+    if nbytes:
+        _telem.inc("kvstore.%s_bytes" % direction, nbytes)
 
 
 def _val_list(value, nkeys):
@@ -178,6 +205,8 @@ class KVStoreLocal(KVStore):
         values = _val_list(value, len(keys))
         assert len(keys) == len(values), "key/value length mismatch"
         self._check_keys(keys)
+        if _telem.ENABLED:
+            _record_comm("push", values)
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             k = str(k)
@@ -196,6 +225,8 @@ class KVStoreLocal(KVStore):
         keys = _key_list(key)
         outs = _val_list(out, len(keys))
         self._check_keys(keys)
+        if _telem.ENABLED:
+            _record_comm("pull", outs)
         for k, o in zip(keys, outs):
             src = self._store[str(k)]
             targets = o if isinstance(o, (list, tuple)) else [o]

@@ -185,11 +185,14 @@ class KVStoreDist(KVStoreLocal):
 
     def push(self, key, value, priority=0):
         from ..ndarray import sparse as _sp
-        from .kvstore import _key_list, _val_list
+        from .. import telemetry as _telem
+        from .kvstore import _key_list, _record_comm, _val_list
         keys = _key_list(key)
         values = _val_list(value, len(keys))
         assert len(keys) == len(values), "key/value length mismatch"
         self._check_keys(keys)
+        if _telem.ENABLED:
+            _record_comm("push", values)
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             k = str(k)

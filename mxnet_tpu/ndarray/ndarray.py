@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import base as _base
+from .. import telemetry as _telem
 from ..base import np_dtype
 from ..context import Context, current_context
 from ..ops import registry as _reg
@@ -152,9 +153,15 @@ class NDArray:
     def asnumpy(self):
         """Blocking copy to numpy. reference: NDArray::SyncCopyToCPU — the
         canonical sync point where async errors surface."""
+        if _telem.ENABLED:
+            # the classic hidden stall under async dispatch: every forced
+            # device→host copy shows up as a counter
+            _telem.inc("ndarray.sync.asnumpy")
         return _np.asarray(self._read())
 
     def wait_to_read(self):
+        if _telem.ENABLED:
+            _telem.inc("ndarray.sync.wait_to_read")
         arr = self._read()
         jax.block_until_ready(arr)
         # Some PjRt transports (the axon tunnel, observed 2026-07-30) ack
@@ -587,6 +594,8 @@ _PROFILE_IMPERATIVE = False
 
 
 def invoke(op_name, *args, out=None, **kwargs):
+    if _telem.ENABLED:
+        _telem.inc("ndarray.invoke")
     if _PROFILE_IMPERATIVE:
         from .. import profiler as _profiler
         import time as _time

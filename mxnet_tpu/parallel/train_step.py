@@ -17,11 +17,13 @@ shards with the parameters (ZeRO: state inherits the param's sharding — the
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _telem
 from .sharding import ShardingRules, shard_pytree
 
 __all__ = ["ShardedTrainStep", "sgd_init", "adam_init"]
@@ -196,7 +198,24 @@ class ShardedTrainStep:
                        donate_argnums=(0, 1) if self.donate else ())
 
     def __call__(self, params, opt_state, batch, step_num=0):
+        if not _telem.ENABLED:
+            return self._step(params, opt_state, batch, step_num)
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        try:
+            return self._step(params, opt_state, batch, step_num)
+        finally:
+            # host-side dispatch wall time: under async dispatch the steady
+            # state measures enqueue latency; compile steps dominate their
+            # own entry (the first call also increments train_step.compile)
+            dur = time.perf_counter() - t0
+            _telem.observe("train_step.step_ms", dur * 1e3)
+            _telem.record_span("train_step", "step", ts, dur)
+            _telem.maybe_sample_memory()
+
+    def _step(self, params, opt_state, batch, step_num):
         if self._compiled is None:
+            _telem.inc("train_step.compile")
             self._batch_proto = batch
             self._compiled = self._build(params, opt_state)
         return self._compiled(params, opt_state, batch,

@@ -10,9 +10,19 @@ TPU-native design: two layers.
   * Device traces: `set_state('run')` with `profile_all` starts
     `jax.profiler.start_trace` → TensorBoard XPlane dump (the
     chrome://tracing analog of src/profiler/profiler.cc DumpProfile).
+
+`pause()`/`resume()` suspend host-side aggregation WITHOUT ending an active
+device trace — the reference contract (MXProfilePause keeps the profiler
+session alive); ending and restarting the jax trace would discard the
+pre-pause device timeline.
+
+The runtime counter layer lives in `mxnet_tpu.telemetry`; `dumps()`/`dump()`
+embed its snapshot so the profiler API surfaces JIT-cache, comm, sync, and
+memory metrics alongside the op table.
 """
 from __future__ import annotations
 
+import functools
 import json
 import threading
 import time
@@ -26,6 +36,7 @@ _config = {"profile_all": False, "profile_symbolic": True,
            "profile_api": True, "filename": "profile.json",
            "aggregate_stats": True}
 _state = "stop"
+_paused = False
 _trace_active = False
 _agg = {}   # op name -> [count, total_s, min_s, max_s]
 
@@ -42,16 +53,21 @@ def state():
     return _state
 
 
+def _sync_imperative_flag():
+    from .ndarray import ndarray as _nd_mod
+    _nd_mod._PROFILE_IMPERATIVE = (_state == "run" and not _paused
+                                   and _config["profile_imperative"])
+
+
 def set_state(state_name="stop", profile_process="worker"):
     """reference: profiler.py (set_state) — 'run' | 'stop'."""
-    global _state, _trace_active
+    global _state, _trace_active, _paused
     if state_name not in ("run", "stop"):
         raise ValueError("state must be 'run' or 'stop'")
     prev = _state
     _state = state_name
-    from .ndarray import ndarray as _nd_mod
-    _nd_mod._PROFILE_IMPERATIVE = (state_name == "run"
-                                   and _config["profile_imperative"])
+    _paused = False
+    _sync_imperative_flag()
     if state_name == "run" and prev != "run":
         if _config["profile_all"]:
             try:
@@ -68,19 +84,42 @@ def set_state(state_name="stop", profile_process="worker"):
 
 
 def pause(profile_process="worker"):
-    set_state("stop")
+    """Suspend stat aggregation; an active jax device trace keeps running
+    (reference: MXProfilePause — pause is not stop)."""
+    global _paused
+    if _state != "run":
+        return
+    _paused = True
+    _sync_imperative_flag()
 
 
 def resume(profile_process="worker"):
-    set_state("run")
+    """Resume aggregation after pause(); the device trace never stopped."""
+    global _paused
+    if _state != "run":
+        return
+    _paused = False
+    _sync_imperative_flag()
 
 
 def is_running():
     return _state == "run"
 
 
+def is_paused():
+    return _paused
+
+
+def is_profiling(kind):
+    """True when stats of `kind` (a profile_* config key) should aggregate
+    right now — running, not paused, and enabled in the config."""
+    return _state == "run" and not _paused and _config[kind]
+
+
 def record_op(name, seconds):
     """Called by the imperative invoke / CachedOp hooks."""
+    if _paused:
+        return
     with _lock:
         ent = _agg.get(name)
         if ent is None:
@@ -97,16 +136,38 @@ def reset():
         _agg.clear()
 
 
+def _telemetry_snapshot():
+    """Counter layer snapshot for embedding in dumps(); {} when the
+    telemetry subsystem is disabled or empty."""
+    from . import telemetry
+    if not telemetry.ENABLED:
+        return {}
+    snap = telemetry.snapshot()
+    if not any(snap.values()):
+        return {}
+    return snap
+
+
 def dumps(reset_stats=False, format="table"):
     """Aggregate per-op stats table. reference: profiler.py (dumps) over
-    src/profiler/aggregate_stats.cc."""
+    src/profiler/aggregate_stats.cc. format: 'table' | 'json' (anything
+    else raises ValueError). Both formats embed the telemetry counter
+    snapshot when the telemetry subsystem is enabled and non-empty."""
+    if format not in ("table", "json"):
+        raise ValueError(
+            "profiler dumps format must be 'table' or 'json', got %r"
+            % (format,))
+    telem = _telemetry_snapshot()
     with _lock:
         rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
         if format == "json":
-            out = json.dumps({k: {"count": v[0], "total_ms": v[1] * 1e3,
-                                  "min_ms": v[2] * 1e3, "max_ms": v[3] * 1e3,
-                                  "avg_ms": v[1] / v[0] * 1e3}
-                              for k, v in rows})
+            payload = {k: {"count": v[0], "total_ms": v[1] * 1e3,
+                           "min_ms": v[2] * 1e3, "max_ms": v[3] * 1e3,
+                           "avg_ms": v[1] / v[0] * 1e3}
+                       for k, v in rows}
+            if telem:
+                payload["telemetry"] = telem
+            out = json.dumps(payload)
         else:
             lines = ["%-40s %10s %12s %12s %12s %12s" %
                      ("Name", "Calls", "Total(ms)", "Avg(ms)", "Min(ms)",
@@ -115,36 +176,78 @@ def dumps(reset_stats=False, format="table"):
                 lines.append("%-40s %10d %12.3f %12.3f %12.3f %12.3f" %
                              (k, v[0], v[1] * 1e3, v[1] / v[0] * 1e3,
                               v[2] * 1e3, v[3] * 1e3))
+            if telem:
+                from . import telemetry
+                lines.append("")
+                lines.append("Telemetry")
+                lines.append(telemetry.dumps(format="table"))
             out = "\n".join(lines)
         if reset_stats:
             _agg.clear()
     return out
 
 
-def dump(finished=True, profile_process="worker"):
-    """Write the aggregate table to the configured filename."""
+def dump(finished=True, profile_process="worker", format="json"):
+    """Write the aggregate stats to the configured filename in `format`
+    ('json' keeps the historical behavior; 'table' writes the human
+    table)."""
+    out = dumps(format=format)
     with open(_config["filename"], "w") as f:
-        f.write(dumps(format="json"))
+        f.write(out)
 
 
 class Scope:
-    """Named profiling range usable from user code. reference: profiler.py
-    (Scope) / MXProfileCreateTask."""
+    """Named profiling range usable from user code — as a context manager,
+    re-entrantly (nested `with` on the SAME instance each record their own
+    range), or as a decorator:
+
+        timed = profiler.Scope("hot")
+        with timed:
+            with timed:          # nested: two ranges recorded
+                ...
+
+        @profiler.scope("hot")
+        def f(...): ...
+
+    reference: profiler.py (Scope) / MXProfileCreateTask."""
 
     def __init__(self, name="<unk>", append_mode=True):
         # append_mode accepted for reference API parity; ranges always
         # aggregate into the op table here
         self.name = name
-        self._t0 = None
+        self._tls = threading.local()  # per-thread start stack → re-entrant
+        # AND safe for the decorator form under concurrent callers
+
+    def _stack(self):
+        stack = getattr(self._tls, "starts", None)
+        if stack is None:
+            stack = self._tls.starts = []
+        return stack
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        self._stack().append(time.perf_counter())
         return self
 
     def __exit__(self, *exc):
-        if self._t0 is not None:
-            record_op("scope:" + self.name, time.perf_counter() - self._t0)
+        stack = self._stack()
+        if stack:
+            t0 = stack.pop()
+            dur = time.perf_counter() - t0
+            record_op("scope:" + self.name, dur)
+            from . import telemetry
+            if telemetry.ENABLED:
+                # user scopes show up in the chrome trace alongside the
+                # framework's own spans
+                telemetry.record_span("scope:" + self.name, "user",
+                                      telemetry.span_clock() - dur, dur)
         return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self:
+                return fn(*args, **kwargs)
+        return wrapper
 
 
 scope = Scope

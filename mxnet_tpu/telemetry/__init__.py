@@ -1,0 +1,196 @@
+"""Runtime telemetry: counters, gauges, histograms, chrome-trace spans.
+
+The observability spine of the framework (ROADMAP: every perf/robustness PR
+reports through it). Instrumented hot paths:
+
+* `gluon.CachedOp` — `cachedop.cache_hit` / `cachedop.cache_miss` /
+  `cachedop.compile` / `cachedop.retrace` counters plus a
+  `cachedop.compile_ms` histogram and one span per (re)trace, so silent
+  recompiles become visible;
+* `nd.invoke` — `ndarray.invoke` dispatch counter, and the forced
+  device→host syncs `ndarray.sync.asnumpy` / `ndarray.sync.wait_to_read`
+  (the classic hidden stall under async PjRt dispatch);
+* `kvstore` — `kvstore.push_calls` / `pull_calls` and payload
+  `push_bytes` / `pull_bytes`;
+* train steps — `trainer.step_ms`, `fused_step.step_ms`,
+  `train_step.step_ms` histograms + compile counters;
+* memory — best-effort `memory.*.bytes_in_use` watermark gauges from the
+  PjRt allocator (memory.py).
+
+Gating: `MXNET_TPU_TELEMETRY=0` (env) or `telemetry.disable()` turns every
+instrumented path into a single global-bool check — no locks, no dict
+lookups, no allocation. Default is enabled (counters are cheap; spans are
+bounded by a ring buffer).
+
+Export: `snapshot()` (dict), `dumps(format='table'|'json')`,
+`dump(path)` (JSON), and `dump_trace(path)` — a chrome://tracing-loadable
+host-side trace, the analog of the reference's `Profiler::DumpProfile`.
+`mx.profiler.dumps()` also embeds the counter snapshot, so the existing
+profiler API surfaces telemetry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+
+from .metrics import Counter, Gauge, Histogram, Registry
+from .trace import TraceBuffer, write_chrome_trace
+from . import memory as _memory
+
+__all__ = ["enabled", "enable", "disable", "registry", "counter", "gauge",
+           "histogram", "inc", "set_gauge", "observe", "span", "record_span",
+           "snapshot", "reset", "dumps", "dump", "dump_trace",
+           "sample_memory", "maybe_sample_memory",
+           "Counter", "Gauge", "Histogram", "Registry"]
+
+# the ONLY state instrumented code reads on the disabled fast path
+ENABLED = os.environ.get("MXNET_TPU_TELEMETRY", "1").lower() not in (
+    "0", "false", "off")
+
+registry = Registry()
+_trace = TraceBuffer()
+
+
+def enabled():
+    return ENABLED
+
+
+def enable():
+    global ENABLED
+    ENABLED = True
+
+
+def disable():
+    global ENABLED
+    ENABLED = False
+
+
+# ---------------------------------------------------------------- metrics API
+def counter(name):
+    return registry.counter(name)
+
+
+def gauge(name):
+    return registry.gauge(name)
+
+
+def histogram(name, bounds=None):
+    return registry.histogram(name, bounds)
+
+
+def inc(name, n=1):
+    """Increment a counter; no-op (and no metric created) when disabled."""
+    if not ENABLED:
+        return 0
+    return registry.counter(name).inc(n)
+
+
+def set_gauge(name, value):
+    if not ENABLED:
+        return
+    registry.gauge(name).set(value)
+
+
+def observe(name, value):
+    if not ENABLED:
+        return
+    registry.histogram(name).observe(value)
+
+
+# ---------------------------------------------------------------- span API
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "_t0")
+
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = _trace.now()
+        return self
+
+    def __exit__(self, *exc):
+        _trace.add(self.name, self.cat, self._t0, _trace.now() - self._t0)
+        return False
+
+
+def span(name, cat="host"):
+    """Context manager recording one chrome-trace span (ph:'X')."""
+    if not ENABLED:
+        return _NULL_SPAN
+    return _Span(name, cat)
+
+
+def record_span(name, cat, start_s, dur_s):
+    """Record an already-timed range. start_s is on the buffer's own
+    perf_counter epoch — pair with `span_clock()`."""
+    if not ENABLED:
+        return
+    _trace.add(name, cat, start_s, dur_s)
+
+
+def span_clock():
+    """Current timestamp on the trace buffer's epoch (seconds)."""
+    return _trace.now()
+
+
+# ---------------------------------------------------------------- memory
+def sample_memory():
+    """Force one device-memory gauge sample; returns #devices reporting."""
+    if not ENABLED:
+        return 0
+    return _memory.sample(registry)
+
+
+def maybe_sample_memory():
+    """Rate-limited sample for per-step call sites."""
+    if not ENABLED:
+        return 0
+    return _memory.maybe_sample(registry)
+
+
+# ---------------------------------------------------------------- export
+def snapshot():
+    return registry.snapshot()
+
+
+def reset():
+    """Drop all metrics and recorded spans (does not change ENABLED)."""
+    registry.reset()
+    _trace.clear()
+
+
+def dumps(format="table"):
+    return registry.dumps(format=format)
+
+
+def dump(path, format="json"):
+    """Write the metric snapshot to `path` (json/table)."""
+    with open(path, "w") as f:
+        f.write(registry.dumps(format=format))
+    return path
+
+
+def dump_trace(path=None):
+    """Write recorded spans + counters as chrome://tracing JSON.
+    Default path: telemetry_trace.json in the cwd. Returns the path."""
+    if path is None:
+        path = "telemetry_trace.json"
+    write_chrome_trace(path, _trace, registry)
+    return path

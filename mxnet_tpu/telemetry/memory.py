@@ -1,0 +1,56 @@
+"""Best-effort device-memory watermark sampling.
+
+PjRt exposes per-device allocator statistics through
+`Device.memory_stats()` (TPU/GPU; the CPU backend usually returns None or
+raises NotImplementedError). The sampler folds whatever is available into
+gauges:
+
+    memory.<platform><id>.bytes_in_use        current allocation
+    memory.<platform><id>.peak_bytes_in_use   allocator high-watermark
+
+`maybe_sample` rate-limits to one device query per MIN_INTERVAL_S so
+per-step instrumentation can call it unconditionally.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["sample", "maybe_sample"]
+
+MIN_INTERVAL_S = 1.0
+_last_sample = [0.0]
+
+
+def sample(registry):
+    """Query every jax device once; returns the number of devices that
+    reported stats (0 when the backend has none — CPU, or jax absent)."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:
+        return 0
+    reported = 0
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        prefix = "memory.%s%d" % (d.platform, d.id)
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            registry.gauge(prefix + ".bytes_in_use").set(int(in_use))
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            registry.gauge(prefix + ".peak_bytes_in_use").set(int(peak))
+        reported += 1
+    return reported
+
+
+def maybe_sample(registry):
+    now = time.monotonic()
+    if now - _last_sample[0] < MIN_INTERVAL_S:
+        return 0
+    _last_sample[0] = now
+    return sample(registry)

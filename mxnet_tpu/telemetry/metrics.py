@@ -1,0 +1,214 @@
+"""Metric primitives + registry for the runtime telemetry subsystem.
+
+The reference ships aggregate runtime statistics inside its profiler
+(src/profiler/aggregate_stats.cc); TPU-native observability needs more than
+per-op timings — cache behavior, comm volume, sync stalls, memory pressure —
+so telemetry is its own thread-safe registry of named counters, gauges, and
+histograms, sampled by the instrumented hot paths and exported as JSON, a
+human table, or a chrome://tracing dump (see trace.py).
+
+All metric types are cheap under the GIL and take a per-metric lock for the
+multi-writer cases (histogram/gauge); creation goes through the registry's
+lock so concurrent get-or-create races resolve to one object.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry",
+           "DEFAULT_MS_BOUNDS"]
+
+# exponential bucket bounds tuned for millisecond latencies: 0.01 ms (jit
+# cache hit) through ~100 s (cold XLA compile of a big model)
+DEFAULT_MS_BOUNDS = tuple(0.01 * (4.0 ** i) for i in range(12))
+
+
+class Counter:
+    """Monotonically increasing count (calls, bytes, cache hits)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value with a high-watermark (memory in use, queue
+    depth). `set` keeps the max ever seen so transient peaks survive."""
+
+    __slots__ = ("name", "_value", "_max", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._max = 0
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+            if value > self._max:
+                self._max = value
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def max(self):
+        return self._max
+
+    def snapshot(self):
+        return {"value": self._value, "max": self._max}
+
+
+class Histogram:
+    """Exponential-bucket latency/size distribution."""
+
+    __slots__ = ("name", "bounds", "_buckets", "_count", "_sum", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name, bounds=None):
+        self.name = name
+        self.bounds = tuple(sorted(bounds)) if bounds else DEFAULT_MS_BOUNDS
+        self._buckets = [0] * (len(self.bounds) + 1)  # last = +inf overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._buckets[idx] += 1
+            self._count += 1
+            self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def snapshot(self):
+        with self._lock:
+            buckets = {}
+            for bound, n in zip(self.bounds, self._buckets):
+                if n:
+                    buckets["le_%g" % bound] = n
+            if self._buckets[-1]:
+                buckets["le_inf"] = self._buckets[-1]
+            return {"count": self._count, "sum": self._sum,
+                    "min": self._min, "max": self._max,
+                    "avg": (self._sum / self._count) if self._count else None,
+                    "buckets": buckets}
+
+
+class Registry:
+    """Thread-safe get-or-create store of named metrics."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name, cls, *args):
+        metric = self._metrics.get(name)
+        if metric is None:
+            with self._lock:
+                metric = self._metrics.get(name)
+                if metric is None:
+                    metric = cls(name, *args)
+                    self._metrics[name] = metric
+        if not isinstance(metric, cls):
+            raise TypeError(
+                "telemetry metric %r already registered as %s, requested %s"
+                % (name, type(metric).__name__, cls.__name__))
+        return metric
+
+    def counter(self, name):
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name):
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name, bounds=None):
+        if bounds is not None:
+            return self._get_or_create(name, Histogram, bounds)
+        return self._get_or_create(name, Histogram)
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+    # -- export --------------------------------------------------------
+    def snapshot(self):
+        """{"counters": {name: int}, "gauges": {name: {value,max}},
+        "histograms": {name: {count,sum,min,max,avg,buckets}}}."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                out["counters"][name] = m.snapshot()
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.snapshot()
+            else:
+                out["histograms"][name] = m.snapshot()
+        return out
+
+    def dumps(self, format="table"):
+        if format not in ("table", "json"):
+            raise ValueError(
+                "telemetry dumps format must be 'table' or 'json', got %r"
+                % (format,))
+        snap = self.snapshot()
+        if format == "json":
+            return json.dumps(snap)
+        lines = []
+        if snap["counters"]:
+            lines.append("%-48s %16s" % ("Counter", "Value"))
+            for name, v in snap["counters"].items():
+                lines.append("%-48s %16d" % (name, v))
+        if snap["gauges"]:
+            lines.append("%-48s %16s %16s" % ("Gauge", "Value", "Max"))
+            for name, g in snap["gauges"].items():
+                lines.append("%-48s %16g %16g" % (name, g["value"], g["max"]))
+        if snap["histograms"]:
+            lines.append("%-48s %10s %12s %12s %12s %12s" %
+                         ("Histogram", "Count", "Sum", "Avg", "Min", "Max"))
+            for name, h in snap["histograms"].items():
+                lines.append("%-48s %10d %12.3f %12.3f %12.3f %12.3f" %
+                             (name, h["count"], h["sum"], h["avg"] or 0.0,
+                              h["min"] or 0.0, h["max"] or 0.0))
+        return "\n".join(lines)

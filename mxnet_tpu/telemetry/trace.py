@@ -1,0 +1,75 @@
+"""Host-side span recording + chrome://tracing export.
+
+The analog of the reference's `Profiler::DumpProfile`
+(src/profiler/profiler.cc), which serializes recorded ranges to the chrome
+trace-event JSON format. Here spans are recorded host-side into a bounded
+ring buffer (the device timeline belongs to `jax.profiler`'s XPlane dump;
+these spans cover what XLA cannot see: trace/compile time, step cadence,
+kvstore calls, forced syncs) and exported as complete-duration ("ph": "X")
+trace events, counters appended as chrome counter ("ph": "C") samples.
+
+Load the dump at chrome://tracing or https://ui.perfetto.dev.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+__all__ = ["TraceBuffer", "write_chrome_trace"]
+
+MAX_EVENTS = 100000
+
+
+class TraceBuffer:
+    """Bounded ring of (name, cat, ts_s, dur_s, tid) span records."""
+
+    def __init__(self, maxlen=MAX_EVENTS):
+        self._events = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        # one session epoch so ts stays small and monotonic across threads
+        self._epoch = time.perf_counter()
+
+    def now(self):
+        return time.perf_counter() - self._epoch
+
+    def add(self, name, cat, ts_s, dur_s):
+        with self._lock:
+            self._events.append(
+                (name, cat, ts_s, dur_s, threading.get_ident()))
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self):
+        return len(self._events)
+
+
+def write_chrome_trace(path, buffer, registry=None, process_name="mxnet_tpu"):
+    """Serialize the span buffer (+ current counter values) to a
+    chrome://tracing-loadable JSON file; returns the event count."""
+    events = [{"name": process_name, "ph": "M", "pid": 0, "tid": 0,
+               "args": {"name": process_name}}]
+    last_ts = 0.0
+    for name, cat, ts_s, dur_s, tid in buffer.events():
+        ts_us = ts_s * 1e6
+        events.append({"name": name, "cat": cat, "ph": "X",
+                       "ts": ts_us, "dur": dur_s * 1e6,
+                       "pid": 0, "tid": tid})
+        last_ts = max(last_ts, ts_us)
+    if registry is not None:
+        counters = registry.snapshot()["counters"]
+        for name, value in counters.items():
+            events.append({"name": name, "cat": "counter", "ph": "C",
+                           "ts": last_ts, "pid": 0,
+                           "args": {"value": value}})
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return len(events)

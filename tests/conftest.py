@@ -22,13 +22,37 @@ else:
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     flags = flags + " --xla_force_host_platform_device_count=8"
-if "collective_call_terminate_timeout" not in flags:
+
+_COLLECTIVE_FLAGS = (
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+
+
+def _collective_flags_supported(base_flags):
+    """XLA aborts the whole process on unknown XLA_FLAGS, and the cpu
+    collective-watchdog flags only exist in newer jaxlibs — probe in a
+    subprocess so an older jaxlib runs the suite without them instead of
+    CHECK-aborting every test (observed with jaxlib 0.4.36)."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(base_flags + _COLLECTIVE_FLAGS).strip())
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=180).returncode == 0
+    except Exception:
+        return False
+
+
+if "collective_call_terminate_timeout" not in flags and \
+        _collective_flags_supported(flags):
     # one host core runs all 8 virtual devices serially: XLA:CPU's default
     # 40 s collective-rendezvous watchdog CHECK-aborts whole test runs
     # whenever per-shard compute skews arrivals (seen on the big-shape
     # mesh tests under suite load)
-    flags = (flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-             " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+    flags = flags + _COLLECTIVE_FLAGS
 os.environ["XLA_FLAGS"] = flags.strip()
 
 # The axon sitecustomize re-registers its TPU backend and resets

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
-"""Parse training logs into a per-epoch table. reference:
-tools/parse_log.py — extracts train/val accuracy and epoch time from the
-logging output of fit()/Speedometer (`Epoch[3] Batch [100] Speed: ...
-accuracy=0.9`, `Epoch[3] Validation-accuracy=0.91`, `Epoch[3] Time
-cost=12.3`)."""
+"""Parse training logs — or telemetry JSON dumps — into a summary table.
+reference: tools/parse_log.py — extracts train/val accuracy and epoch time
+from the logging output of fit()/Speedometer (`Epoch[3] Batch [100] Speed:
+... accuracy=0.9`, `Epoch[3] Validation-accuracy=0.91`, `Epoch[3] Time
+cost=12.3`).
+
+Telemetry mode (--telemetry, or auto-detected when the file is a JSON
+object): flattens a `mx.telemetry.dump()` snapshot — or a
+`mx.profiler.dump()` file embedding one under its "telemetry" key — into
+the same markdown/csv table shape the log mode produces."""
 from __future__ import annotations
 
 import argparse
+import json
 import re
 import sys
 
@@ -40,13 +46,63 @@ def parse(lines, metric="accuracy"):
     return rows
 
 
+def parse_telemetry(obj):
+    """Flatten a telemetry snapshot into [(metric, kind, count, value, max)]
+    rows. Accepts either a raw `telemetry.dump()` object or a
+    `profiler.dump()` object with the snapshot under "telemetry"."""
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    rows = []
+    for name, value in sorted(obj.get("counters", {}).items()):
+        rows.append((name, "counter", "", value, ""))
+    for name, g in sorted(obj.get("gauges", {}).items()):
+        rows.append((name, "gauge", "", g.get("value"), g.get("max")))
+    for name, h in sorted(obj.get("histograms", {}).items()):
+        avg = h.get("avg")
+        rows.append((name, "histogram", h.get("count"),
+                     round(avg, 3) if avg is not None else "",
+                     h.get("max")))
+    return rows
+
+
+def _print_telemetry(rows, fmt):
+    if fmt == "markdown":
+        print("| metric | kind | count | value | max |")
+        print("| --- | --- | --- | --- | --- |")
+        line = "| %s | %s | %s | %s | %s |"
+    else:
+        print("metric,kind,count,value,max")
+        line = "%s,%s,%s,%s,%s"
+    for r in rows:
+        print(line % r)
+
+
+def _load_json(path):
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        return obj if isinstance(obj, dict) else None
+    except (ValueError, OSError):
+        return None
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("logfile")
     parser.add_argument("--format", choices=["markdown", "csv"],
                         default="markdown")
     parser.add_argument("--metric", default="accuracy")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="treat the input as a telemetry/profiler JSON "
+                             "dump (auto-detected for JSON files)")
     args = parser.parse_args()
+    obj = _load_json(args.logfile)
+    if args.telemetry or obj is not None:
+        if obj is None:
+            sys.exit("--telemetry input is not a JSON object: %s"
+                     % args.logfile)
+        _print_telemetry(parse_telemetry(obj), args.format)
+        return
     with open(args.logfile) as f:
         rows = parse(f, args.metric)
     if args.format == "markdown":
