@@ -49,6 +49,12 @@ def _emit(payload):
             "device_fallback": snap.get("device.fallback_cpu",
                                         1 if _FELL_BACK else 0),
             "sync_asnumpy": snap.get("ndarray.sync.asnumpy", 0),
+            # a noisy run (retried comm, watchdog stalls, restores) must be
+            # distinguishable from a clean one in the bench history
+            "resilience_faults": snap.get("resilience.faults_injected", 0),
+            "resilience_retries": snap.get("resilience.retries", 0),
+            "resilience_stalls": snap.get("resilience.stalls", 0),
+            "resilience_restores": snap.get("resilience.restores", 0),
         }
     except Exception as e:   # telemetry must never break the bench row
         print("# telemetry counters unavailable: %s" % e, file=sys.stderr)

@@ -604,6 +604,11 @@ class FusedTrainStep:
             _telem.maybe_sample_memory()
 
     def _step(self, data, label):
+        # injection-only resilience site (hang/preempt/latency testable on
+        # one chip); recovery belongs to resilience.run, which owns the
+        # checkpoint needed to replay a half-applied step
+        from ..resilience import faults as _faults
+        _faults.check("train.step")
         flat_data, in_fmt = _flatten(data, "input")
         ctx = flat_data[0].context
         if not self._built:

@@ -201,16 +201,27 @@ class KVStoreLocal(KVStore):
         the merged value REPLACES the store; with an updater the store holds
         weights and the updater applies the merged gradient (reference:
         KVStoreLocal::PushImpl — updater_ path vs CopyFromTo path)."""
+        from ..resilience import faults as _faults
         keys = _key_list(key)
         values = _val_list(value, len(keys))
         assert len(keys) == len(values), "key/value length mismatch"
         self._check_keys(keys)
         if _telem.ENABLED:
             _record_comm("push", values)
+        inject = _faults.active_plan() is not None
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             k = str(k)
             stored = self._store[k]
+            if inject:
+                # injection-only site (no retry: the updater below mutates
+                # the store, so replaying a half-applied push is NOT
+                # idempotent — recovery happens one level up via
+                # restore-and-replay); context formatting gated so the
+                # no-plan hot path pays nothing
+                _faults.check("kvstore.push",
+                              context="key=%s shard=%s"
+                              % (k, tuple(merged.shape)))
             if self._updater is not None:
                 idx = int(k) if k.isdigit() else k
                 self._updater(idx, merged, stored)
@@ -220,18 +231,37 @@ class KVStoreLocal(KVStore):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast merged value to all outs (reference:
-        KVStoreLocal::PullImpl → comm Broadcast)."""
+        KVStoreLocal::PullImpl → comm Broadcast). A resilience fault site
+        ("kvstore.pull") with retry: local broadcast is idempotent, and the
+        dist backend inherits this path for its replicated store."""
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
         assert out is not None, "pull requires out="
         keys = _key_list(key)
         outs = _val_list(out, len(keys))
         self._check_keys(keys)
         if _telem.ENABLED:
             _record_comm("pull", outs)
+        # this broadcast is a local copyto even for the dist store (its
+        # replicas are reconciled at push time by the allreduce) — it cannot
+        # fail transiently, so pay the retry wrapper and per-key context
+        # formatting only when a fault plan makes it injectable
+        use_retry = _faults.active_plan() is not None
         for k, o in zip(keys, outs):
             src = self._store[str(k)]
             targets = o if isinstance(o, (list, tuple)) else [o]
-            for t in targets:
-                src.copyto(t)
+            if not use_retry:
+                for t in targets:
+                    src.copyto(t)
+                continue
+            context = "key=%s shard=%s" % (k, tuple(src.shape))
+
+            def broadcast(src=src, targets=targets, context=context):
+                _faults.check("kvstore.pull", context=context)
+                for t in targets:
+                    src.copyto(t)
+
+            call_with_retry(broadcast, site="kvstore.pull", context=context)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)

@@ -154,22 +154,39 @@ class KVStoreDist(KVStoreLocal):
                       out_shardings=NamedSharding(mesh, P()))(garr)
         return out.addressable_data(0)
 
-    def _allreduce(self, raw):
+    def _allreduce(self, raw, site="kvstore.push", context=None):
         """Sum a host-local array across all workers (replicated result) —
-        one on-device psum over the worker mesh."""
-        if dist.num_workers() == 1:
-            return raw
-        return self._cross_worker(raw, _sum0)
+        one on-device psum over the worker mesh. The dispatch is a
+        resilience fault-injection site and retries transient transport
+        faults (flaky DCN endpoint ≠ dead run)."""
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+
+        def dispatch():
+            _faults.check(site, context=context)
+            if dist.num_workers() == 1:
+                return raw
+            return self._cross_worker(raw, _sum0)
+
+        return call_with_retry(dispatch, site=site, context=context)
 
     def _allreduce_compressed(self, raw, key):
         """2-bit path: only ceil(n/4) packed bytes per worker cross the
         wire; decode + sum fuse into the same XLA program as the gather.
         reference: gradient_compression.cc (quantize on worker, server
-        dequantizes each worker's message and accumulates)."""
+        dequantizes each worker's message and accumulates).
+
+        Retry boundary: compress() carries the error-feedback residual
+        (stateful — must run once per push), so only the wire exchange
+        below it is retriable."""
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        context = "key=%s shard=%s 2bit" % (key, tuple(raw.shape))
         packed = self._gc.compress(key, jnp.asarray(raw))
         if dist.num_workers() == 1:
             # still quantize (error feedback must behave identically on 1
             # worker) but skip the exchange
+            _faults.check("kvstore.push", context=context)
             return self._gc.decompress(packed, tuple(raw.shape), raw.dtype)
         # stable callable per (shape, dtype): jax.jit caches by identity
         sig = (tuple(raw.shape), str(raw.dtype))
@@ -181,11 +198,18 @@ class KVStoreDist(KVStoreLocal):
                 return jnp.sum(gc.decompress(gpacked, shape, dtype), axis=0)
 
             fn = self._decode_fns[sig] = decode_sum
-        return self._cross_worker(packed, fn)
+
+        def dispatch():
+            _faults.check("kvstore.push", context=context)
+            return self._cross_worker(packed, fn)
+
+        return call_with_retry(dispatch, site="kvstore.push",
+                               context=context)
 
     def push(self, key, value, priority=0):
-        from ..ndarray import sparse as _sp
         from .. import telemetry as _telem
+        from ..resilience.errors import (FatalTrainingError, ResilienceError,
+                                         TransportError, classify)
         from .kvstore import _key_list, _record_comm, _val_list
         keys = _key_list(key)
         values = _val_list(value, len(keys))
@@ -197,30 +221,49 @@ class KVStoreDist(KVStoreLocal):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             k = str(k)
             stored = self._store[k]
-            if isinstance(merged, _sp.RowSparseNDArray):
-                # union of touched rows across workers, dense over the union
-                local_rows = _np.zeros((merged.shape[0],), _np.bool_)
-                local_rows[_np.asarray(merged._indices)] = True
-                all_rows = _np.asarray(self._allreduce(
-                    jnp.asarray(local_rows, jnp.int32))) > 0
-                rows = jnp.asarray(_np.nonzero(all_rows)[0].astype(_np.int32))
-                dense_rows = merged._read()[rows]
-                summed = self._allreduce(dense_rows)
-                merged = _sp.RowSparseNDArray(summed, rows, merged.shape,
-                                              ctx=stored.context)
+            try:
+                self._push_one(k, merged, stored)
+            except ResilienceError:
+                raise  # already carries key/shard/attempt context
+            except Exception as exc:
+                # a bare backend exception tells the operator nothing; wrap
+                # with key, shard, and a retriable/fatal verdict
+                detail = ("kvstore_dist push failed: key=%s shard=%s "
+                          "worker=%d/%d: %s: %s"
+                          % (k, tuple(merged.shape), dist.rank(),
+                             dist.num_workers(), type(exc).__name__, exc))
+                if classify(exc) == "retriable":
+                    raise TransportError(detail, site="kvstore.push",
+                                         key=k) from exc
+                raise FatalTrainingError(detail) from exc
+
+    def _push_one(self, k, merged, stored):
+        from ..ndarray import sparse as _sp
+        context = "key=%s shard=%s" % (k, tuple(merged.shape))
+        if isinstance(merged, _sp.RowSparseNDArray):
+            # union of touched rows across workers, dense over the union
+            local_rows = _np.zeros((merged.shape[0],), _np.bool_)
+            local_rows[_np.asarray(merged._indices)] = True
+            all_rows = _np.asarray(self._allreduce(
+                jnp.asarray(local_rows, jnp.int32), context=context)) > 0
+            rows = jnp.asarray(_np.nonzero(all_rows)[0].astype(_np.int32))
+            dense_rows = merged._read()[rows]
+            summed = self._allreduce(dense_rows, context=context)
+            merged = _sp.RowSparseNDArray(summed, rows, merged.shape,
+                                          ctx=stored.context)
+        else:
+            raw = merged._read()
+            if self._gc is not None:
+                summed = self._allreduce_compressed(raw, k)
             else:
-                raw = merged._read()
-                if self._gc is not None:
-                    summed = self._allreduce_compressed(raw, k)
-                else:
-                    summed = self._allreduce(raw)
-                merged = nd.from_jax(summed, ctx=stored.context)
-            if self._updater is not None:
-                idx = int(k) if k.isdigit() else k
-                self._updater(idx, merged, stored)
-            else:
-                stored._write(merged.as_in_context(
-                    stored.context)._read().astype(stored.dtype))
+                summed = self._allreduce(raw, context=context)
+            merged = nd.from_jax(summed, ctx=stored.context)
+        if self._updater is not None:
+            idx = int(k) if k.isdigit() else k
+            self._updater(idx, merged, stored)
+        else:
+            stored._write(merged.as_in_context(
+                stored.context)._read().astype(stored.dtype))
 
     def barrier(self):
         nd.waitall()

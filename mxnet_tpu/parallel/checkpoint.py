@@ -27,27 +27,62 @@ __all__ = ["save_sharded", "restore_sharded", "latest_step",
            "save_train_state", "restore_train_state"]
 
 
-def _mgr(path):
+def _mgr(path, keep=None):
     import orbax.checkpoint as ocp
-    return ocp.CheckpointManager(os.path.abspath(path))
+    options = None
+    if keep is not None:
+        options = ocp.CheckpointManagerOptions(max_to_keep=max(1, int(keep)))
+    # item_handlers: a FRESH manager (the restore-after-crash case) has seen
+    # no save in-process and cannot infer the handler — without this,
+    # item_metadata returns None and restore raises KeyError on orbax 0.7
+    return ocp.CheckpointManager(os.path.abspath(path), options=options,
+                                 item_handlers=ocp.StandardCheckpointHandler())
 
 
-def save_sharded(path, tree, step=0, wait=True):
+def _commit_latest_marker(path, step):
+    """Atomic write-then-rename LATEST marker, committed only after the
+    save fully finished — readers that trust it can never see a step whose
+    payload was torn by a crash mid-save. (orbax itself commits each step
+    dir atomically; the marker adds a cheap, scan-free `latest_step` that
+    is correct even while a newer save is in flight.)"""
+    from ..util import write_latest_marker
+    write_latest_marker(os.path.abspath(path), step)
+
+
+def save_sharded(path, tree, step=0, wait=True, keep=None):
     """Write one step of a (possibly sharded) pytree. Every process must
-    call this (multi-host collective); single-process works as-is."""
+    call this (multi-host collective); single-process works as-is.
+
+    keep=N retains only the newest N steps (unbounded growth killed real
+    disks before it ever killed a run); the LATEST marker commits via
+    write-then-rename strictly after the step's payload is durable."""
     import orbax.checkpoint as ocp
-    mgr = _mgr(path)
+    mgr = _mgr(path, keep=keep)
     mgr.save(int(step), args=ocp.args.StandardSave(tree))
     if wait:
         mgr.wait_until_finished()
+        if jax.process_index() == 0:
+            _commit_latest_marker(path, step)
     mgr.close()
 
 
 def latest_step(path):
+    """Newest fully-committed step: the max of orbax's scan (tmp dirs from
+    a crashed save are invisible to it) and the atomic LATEST marker
+    (accepted only when its step dir exists). Either source alone survives
+    a crash mid-save; together a stale/lost marker never hides or loses a
+    checkpoint."""
+    from ..util import read_latest_marker
+    root = os.path.abspath(path)
     mgr = _mgr(path)
-    step = mgr.latest_step()
+    scanned = mgr.latest_step()
     mgr.close()
-    return step
+    marked = read_latest_marker(root)
+    if marked is not None and not os.path.isdir(
+            os.path.join(root, str(marked))):
+        marked = None
+    candidates = [s for s in (scanned, marked) if s is not None]
+    return max(candidates) if candidates else None
 
 
 def restore_sharded(path, step=None, mesh=None, rules=None, template=None):
@@ -75,20 +110,19 @@ def restore_sharded(path, step=None, mesh=None, rules=None, template=None):
                 tuple(leaf.shape), leaf.dtype,
                 sharding=NamedSharding(mesh, spec)))
         template = jax.tree_util.tree_unflatten(treedef, outs)
-    if template is not None:
-        restored = mgr.restore(
-            int(step), args=ocp.args.StandardRestore(template))
-    else:
-        restored = mgr.restore(int(step))
+    # StandardRestore(None) restores host-resident arrays with the saved
+    # topology — still explicit args, which a fresh manager requires
+    restored = mgr.restore(
+        int(step), args=ocp.args.StandardRestore(template))
     mgr.close()
     return restored
 
 
-def save_train_state(path, params, opt_state, step):
+def save_train_state(path, params, opt_state, step, keep=None):
     """Params + optimizer state in one step dir (the Trainer.save_states
     analog for the fused ShardedTrainStep path)."""
     save_sharded(path, {"params": params, "opt_state": opt_state,
-                        "step": int(step)}, step=step)
+                        "step": int(step)}, step=step, keep=keep)
 
 
 def restore_train_state(path, mesh=None, rules=None, step=None):

@@ -48,7 +48,13 @@ def ppermute(x, axis_name, perm):
 
 def barrier(mesh=None):
     """Device-sync barrier: a trivial psum everyone must join. Analog of the
-    reference's engine WaitForAll + ps-lite Barrier (ps::Postoffice)."""
+    reference's engine WaitForAll + ps-lite Barrier (ps::Postoffice).
+
+    Eager dispatch = a resilience site: a peer that died mid-rendezvous
+    surfaces as a retriable fault (or, under a watchdog guard, a StallError)
+    instead of an opaque hang."""
+    from ..resilience import faults as _faults
+    from ..resilience.retry import call_with_retry
     if mesh is None:
         from .mesh import current_mesh, local_mesh
         mesh = current_mesh() or local_mesh()
@@ -57,14 +63,27 @@ def barrier(mesh=None):
     f = jax.jit(shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
                           in_specs=P(axis), out_specs=P()),
                 out_shardings=NamedSharding(mesh, P()))
-    f(ones).block_until_ready()
+
+    def dispatch():
+        _faults.check("collective.barrier")
+        f(ones).block_until_ready()
+
+    call_with_retry(dispatch, site="collective.barrier")
 
 
 def _eager_allreduce(arr, mesh, axis):
+    from ..resilience import faults as _faults
+    from ..resilience.retry import call_with_retry
     spec = P(axis)
     f = shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
                   in_specs=spec, out_specs=P())
-    return jax.jit(f)(arr)
+
+    def dispatch():
+        _faults.check("collective.all_reduce",
+                      context="shape=%s axis=%s" % (tuple(arr.shape), axis))
+        return jax.jit(f)(arr)
+
+    return call_with_retry(dispatch, site="collective.all_reduce")
 
 
 def allreduce_bench(size_mb=64, iters=20, mesh=None, dtype=jnp.float32):

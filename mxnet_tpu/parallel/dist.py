@@ -59,10 +59,22 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         # single-process: nothing to rendezvous
         _STATE.update(initialized=True, rank=0, num=1)
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id,
-        local_device_ids=local_device_ids)
+    # rendezvous against a coordinator that may still be booting (or was
+    # just restarted by *its* supervisor) — classic retriable transport
+    from ..resilience import faults as _faults
+    from ..resilience.retry import RetryPolicy, call_with_retry
+
+    def rendezvous():
+        _faults.check("dist.initialize",
+                      context="coordinator=%s" % coordinator_address)
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            local_device_ids=local_device_ids)
+
+    call_with_retry(rendezvous, site="dist.initialize",
+                    policy=RetryPolicy(base_delay_s=0.5, max_delay_s=10.0),
+                    context="coordinator=%s" % coordinator_address)
     _STATE.update(initialized=True, rank=jax.process_index(),
                   num=jax.process_count())
 

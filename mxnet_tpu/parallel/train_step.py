@@ -214,6 +214,8 @@ class ShardedTrainStep:
             _telem.maybe_sample_memory()
 
     def _step(self, params, opt_state, batch, step_num):
+        from ..resilience import faults as _faults
+        _faults.check("train.step")  # injection-only; resilience.run recovers
         if self._compiled is None:
             _telem.inc("train_step.compile")
             self._batch_proto = batch

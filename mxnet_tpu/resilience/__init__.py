@@ -1,0 +1,55 @@
+"""mxnet_tpu.resilience — fault-tolerant training.
+
+On real TPU fleets the dominant failure modes are preempted hosts, hung
+collectives, and flaky dist-kvstore endpoints; without this layer a single
+fault kills the whole run. The subsystem has four parts, each usable alone:
+
+``faults``    deterministic fault injection (env ``MXNET_TPU_FAULT_PLAN`` or
+              ``faults.inject(...)``) into kvstore push/pull, collective
+              dispatch, and train-step sites — every recovery path below is
+              testable on one chip;
+``retry``     exponential-backoff retry engine with jitter, per-op
+              deadlines, and transient-vs-fatal error classification —
+              wired into `kvstore_dist`, eager collectives, and
+              `dist.initialize` (knob: ``MXNET_TPU_RETRIES``);
+``watchdog``  heartbeat monitor that turns a hung step/collective into a
+              structured `StallError` (with a telemetry span dump) instead
+              of silence (knob: ``MXNET_TPU_STEP_DEADLINE_S``);
+``run``       `ResilientRunner` — periodic atomic checkpoints, catch
+              retriable faults, restore ``latest_step`` and replay, with a
+              max-restart budget and graceful degradation to a smaller
+              mesh when the device set shrinks.
+
+Everything reports through `mx.telemetry`: ``resilience.faults_injected`` /
+``retries`` / ``stalls`` / ``restores`` / ``checkpoints`` counters plus
+chrome-trace spans for backoffs, checkpoints, restores, and stalls.
+
+Quick start::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import resilience
+
+    runner = resilience.ResilientRunner.for_fused_step(
+        fused_step, batch_fn, ckpt_dir="/tmp/ckpt", ckpt_every=50,
+        max_restarts=3, step_deadline_s=120)
+    report = runner.run(num_steps)
+"""
+from . import errors, faults, retry, watchdog, run  # noqa: F401
+
+from .errors import (ResilienceError, RetriableError, TransportError,  # noqa: F401
+                     InjectedFault, PreemptionError, StallError,
+                     RetryExhausted, FatalTrainingError, classify,
+                     is_retriable)
+from .faults import FaultPlan, FaultSpec, inject  # noqa: F401
+from .retry import RetryPolicy, call_with_retry, retriable  # noqa: F401
+from .run import ResilientRunner, RunReport, SnapshotCheckpointer  # noqa: F401
+from .watchdog import Watchdog, guard, heartbeat  # noqa: F401
+
+__all__ = ["errors", "faults", "retry", "watchdog", "run",
+           "ResilienceError", "RetriableError", "TransportError",
+           "InjectedFault", "PreemptionError", "StallError",
+           "RetryExhausted", "FatalTrainingError", "classify",
+           "is_retriable", "FaultPlan", "FaultSpec", "inject",
+           "RetryPolicy", "call_with_retry", "retriable",
+           "ResilientRunner", "RunReport", "SnapshotCheckpointer",
+           "Watchdog", "guard", "heartbeat"]

@@ -1,0 +1,160 @@
+"""Structured error taxonomy for fault-tolerant training.
+
+On a real TPU fleet the failures that kill a run are rarely bugs in the
+model: they are preempted hosts, flaky dist-kvstore endpoints, and hung
+collectives. Recovering from them safely requires telling *transient
+transport* faults (worth retrying, worth restoring a checkpoint for) apart
+from *deterministic* errors (wrong shape/dtype/key — retrying replays the
+same crash forever). This module is that classifier plus the exception
+types every resilience component raises.
+
+Hierarchy::
+
+    MXNetError
+      ResilienceError                  base of everything raised here
+        RetriableError                 safe to retry / restore-and-replay
+          TransportError               flaky comm endpoint, reset conn, ...
+            InjectedFault              raised by resilience.faults (testing)
+          PreemptionError              host/device preemption notice
+          StallError                   watchdog deadline passed (span dump)
+          RetryExhausted               retries spent; carries the last cause
+        FatalTrainingError             deterministic — do NOT retry
+
+`classify(exc)` maps arbitrary exceptions (including jaxlib's
+XlaRuntimeError grpc-flavored messages) onto "retriable" / "fatal".
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ResilienceError", "RetriableError", "TransportError",
+           "InjectedFault", "PreemptionError", "StallError",
+           "RetryExhausted", "FatalTrainingError", "classify",
+           "is_retriable"]
+
+
+class ResilienceError(MXNetError):
+    """Base class of every error raised by mxnet_tpu.resilience."""
+
+
+class RetriableError(ResilienceError):
+    """A fault where retrying (or restoring a snapshot and replaying) can
+    succeed: nothing about the program itself is wrong."""
+
+
+class TransportError(RetriableError):
+    """Flaky communication: reset connections, unreachable endpoints,
+    transient collective failures. The dist-kvstore analog of ps-lite's
+    ZMQ send/recv errors."""
+
+    def __init__(self, message, site=None, key=None, attempt=None):
+        super().__init__(message)
+        self.site = site
+        self.key = key
+        self.attempt = attempt
+
+
+class InjectedFault(TransportError):
+    """A deterministic fault planted by `resilience.faults` so recovery
+    paths are testable on one chip. Behaves exactly like a TransportError."""
+
+
+class PreemptionError(RetriableError):
+    """The host (or part of the device set) is going away — the simulated
+    analog of a TPU-VM maintenance preemption. Recovery is
+    restore-from-checkpoint, not an in-place retry."""
+
+
+class StallError(RetriableError):
+    """A watched operation failed to heartbeat before its deadline.
+
+    Raised by `resilience.watchdog` *instead of hanging forever* — the
+    structured replacement for a run that sits in a dead collective until
+    an operator kills it. Carries the site, the deadline, and a dump of
+    the most recent telemetry spans so the post-mortem starts with data.
+    """
+
+    def __init__(self, message, site=None, deadline_s=None, span_dump=None):
+        super().__init__(message)
+        self.site = site
+        self.deadline_s = deadline_s
+        # list of (name, cat, ts_s, dur_s, tid) — telemetry.span_events tail
+        self.span_dump = list(span_dump or [])
+
+    def format_spans(self, limit=20):
+        lines = ["recent spans (newest last):"]
+        for name, cat, ts_s, dur_s, _tid in self.span_dump[-limit:]:
+            lines.append("  %10.3fs %-8s %s (%.3f ms)"
+                         % (ts_s, cat, name, dur_s * 1e3))
+        return "\n".join(lines)
+
+
+class RetryExhausted(RetriableError):
+    """Every attempt a RetryPolicy allowed failed with a retriable error.
+    Carries the last underlying cause; still retriable at a coarser
+    granularity (a runner may restore a checkpoint and replay)."""
+
+    def __init__(self, message, site=None, attempts=None, last_error=None):
+        super().__init__(message)
+        self.site = site
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+class FatalTrainingError(ResilienceError):
+    """Deterministic failure (shape/dtype mismatch, uninitialized key,
+    programming error). Retrying replays the identical crash — surface it
+    immediately instead."""
+
+
+# ---------------------------------------------------------------- classifier
+# Substrings that mark a low-level runtime error as transient transport
+# trouble. Sources: grpc status names surfaced by jaxlib's XlaRuntimeError,
+# the distributed-runtime coordinator, and plain socket errors.
+_TRANSIENT_MARKERS = (
+    "unavailable", "deadline_exceeded", "deadline exceeded",
+    "connection reset", "connection refused", "connection closed",
+    "broken pipe", "socket closed", "timed out", "timeout",
+    "preempted", "cancelled", "aborted", "heartbeat",
+    "failed to connect", "coordination service",
+)
+
+# Deterministic-programming-error markers: never retriable even when they
+# arrive wrapped in a runtime error type.
+_FATAL_MARKERS = (
+    "shape", "dtype", "rank mismatch", "invalid_argument",
+    "invalid argument", "not been initialized", "unimplemented",
+    "out of memory", "resource_exhausted", "resource exhausted",
+)
+
+
+def classify(exc):
+    """Map an exception to "retriable" or "fatal".
+
+    Explicit resilience types carry their own verdict; everything else is
+    judged by type and message. Unknown errors default to "fatal" — silently
+    retrying an unclassified crash hides bugs.
+    """
+    if isinstance(exc, RetriableError):
+        return "retriable"
+    if isinstance(exc, FatalTrainingError):
+        return "fatal"
+    if isinstance(exc, (ConnectionError, BrokenPipeError, TimeoutError,
+                        InterruptedError)):
+        return "retriable"
+    if isinstance(exc, (TypeError, ValueError, KeyError, IndexError,
+                        AssertionError, NotImplementedError,
+                        ZeroDivisionError, AttributeError)):
+        return "fatal"
+    msg = str(exc).lower()
+    # fatal markers win: "invalid argument: connection metadata" should not
+    # spin in a retry loop
+    if any(m in msg for m in _FATAL_MARKERS):
+        return "fatal"
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return "retriable"
+    return "fatal"
+
+
+def is_retriable(exc):
+    return classify(exc) == "retriable"

@@ -1,0 +1,240 @@
+"""Deterministic fault injection for comm and train-step call sites.
+
+Every recovery path in this framework must be testable on one chip, with no
+fleet and no luck involved. The instrumented hot paths (kvstore push/pull,
+eager collectives, fused train steps, the resilient runner) call
+``faults.check(site)``; when a fault plan is active and one of its entries
+matches (site, nth-call-at-that-site), the harness injects the fault:
+
+``error``    raise `InjectedFault` (a TransportError — retriable)
+``latency``  sleep `arg` seconds, then continue (models a slow endpoint)
+``hang``     sleep in small cooperative ticks for `arg` seconds (default
+             3600 — "forever" at test scale). The tick loop gives the hang
+             watchdog's asynchronous `StallError` a bytecode boundary to
+             land on, exactly like a Python-level wait on a dead collective.
+``preempt``  raise `PreemptionError` (models host preemption — the runner
+             restores a checkpoint instead of retrying in place)
+
+Plans come from the ``MXNET_TPU_FAULT_PLAN`` env var or a context manager::
+
+    MXNET_TPU_FAULT_PLAN="kvstore.push:error:1;run.step:preempt:4"
+
+    with faults.inject("collective.all_reduce:latency:2:0.05"):
+        ...
+
+Entry grammar: ``site:kind:nth[:arg]`` joined by ``;``. ``nth`` is the
+1-based call count at that site (each retry re-enters the site and counts
+again — so ``error:1`` fails the first attempt and lets the retry through,
+which is precisely the "retry succeeds" scenario). ``nth`` may also be
+``N+`` (every call from the Nth on) or ``*`` (every call).
+
+When no plan is active ``check()`` is one global ``is None`` test — the
+instrumented paths pay nothing in production.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .errors import InjectedFault, PreemptionError
+
+__all__ = ["FaultSpec", "FaultPlan", "inject", "activate", "deactivate",
+           "active_plan", "check", "reset_counts", "call_count",
+           "HANG_TICK_S"]
+
+KINDS = ("error", "latency", "hang", "preempt")
+
+# cooperative hang granularity: small enough that an async StallError lands
+# promptly, large enough to stay off the scheduler's back
+HANG_TICK_S = 0.01
+
+# the ONLY state `check` reads when no plan is active
+_ACTIVE = None
+_LOCK = threading.Lock()
+
+
+class FaultSpec:
+    """One planned fault: (site, kind, nth, arg)."""
+
+    __slots__ = ("site", "kind", "nth", "from_nth_on", "every", "arg")
+
+    def __init__(self, site, kind, nth, arg=None):
+        if kind not in KINDS:
+            raise ValueError("fault kind must be one of %s, got %r"
+                             % (KINDS, kind))
+        self.site = site
+        self.kind = kind
+        nth = str(nth)
+        self.every = nth == "*"
+        self.from_nth_on = nth.endswith("+")
+        self.nth = 0 if self.every else int(nth.rstrip("+"))
+        if not self.every and self.nth < 1:
+            raise ValueError("fault nth is 1-based, got %r" % (nth,))
+        self.arg = arg
+
+    def matches(self, count):
+        if self.every:
+            return True
+        if self.from_nth_on:
+            return count >= self.nth
+        return count == self.nth
+
+    def __repr__(self):
+        nth = "*" if self.every else (
+            "%d+" % self.nth if self.from_nth_on else str(self.nth))
+        core = "%s:%s:%s" % (self.site, self.kind, nth)
+        return core if self.arg is None else "%s:%g" % (core, self.arg)
+
+
+class FaultPlan:
+    """An ordered set of FaultSpecs plus per-site call counters."""
+
+    def __init__(self, specs=()):
+        self.specs = list(specs)
+        self._counts = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text):
+        """Parse the ``site:kind:nth[:arg];...`` grammar (env var format)."""
+        specs = []
+        for entry in (text or "").split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.split(":")
+            if len(parts) not in (3, 4):
+                raise ValueError(
+                    "fault plan entry %r is not site:kind:nth[:arg]" % entry)
+            site, kind, nth = parts[0], parts[1], parts[2]
+            arg = float(parts[3]) if len(parts) == 4 else None
+            specs.append(FaultSpec(site, kind, nth, arg))
+        return cls(specs)
+
+    def bump(self, site):
+        with self._lock:
+            c = self._counts.get(site, 0) + 1
+            self._counts[site] = c
+            return c
+
+    def count(self, site):
+        return self._counts.get(site, 0)
+
+    def reset_counts(self):
+        with self._lock:
+            self._counts.clear()
+
+    def match(self, site, count):
+        for spec in self.specs:
+            if spec.site == site and spec.matches(count):
+                return spec
+        return None
+
+    def __repr__(self):
+        return "FaultPlan(%s)" % ";".join(repr(s) for s in self.specs)
+
+
+def _plan_from_env():
+    text = os.environ.get("MXNET_TPU_FAULT_PLAN", "")
+    return FaultPlan.parse(text) if text.strip() else None
+
+
+def activate(plan=None):
+    """Install `plan` (a FaultPlan or plan string) globally; with no
+    argument, (re)load from MXNET_TPU_FAULT_PLAN. Returns the active plan
+    (None if there is nothing to inject)."""
+    global _ACTIVE
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    with _LOCK:
+        _ACTIVE = plan if plan is not None else _plan_from_env()
+        return _ACTIVE
+
+
+def deactivate():
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+def active_plan():
+    return _ACTIVE
+
+
+class inject:
+    """Context manager scoping a fault plan: the previous plan is restored
+    on exit, call counters start fresh on entry."""
+
+    def __init__(self, plan):
+        self.plan = (FaultPlan.parse(plan) if isinstance(plan, str)
+                     else plan)
+
+    def __enter__(self):
+        global _ACTIVE
+        with _LOCK:
+            self._prev = _ACTIVE
+            self.plan.reset_counts()
+            _ACTIVE = self.plan
+        return self.plan
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        with _LOCK:
+            _ACTIVE = self._prev
+        return False
+
+
+def reset_counts():
+    plan = _ACTIVE
+    if plan is not None:
+        plan.reset_counts()
+
+
+def call_count(site):
+    plan = _ACTIVE
+    return plan.count(site) if plan is not None else 0
+
+
+def _fire(spec, site, count, context):
+    from .. import telemetry as _telem
+    _telem.inc("resilience.faults_injected")
+    _telem.inc("resilience.faults_injected.%s" % spec.kind)
+    where = "%s (call #%d%s)" % (
+        site, count, (", %s" % context) if context else "")
+    if spec.kind == "error":
+        raise InjectedFault(
+            "injected transport fault at %s" % where, site=site)
+    if spec.kind == "preempt":
+        raise PreemptionError("injected host preemption at %s" % where)
+    if spec.kind == "latency":
+        time.sleep(spec.arg if spec.arg is not None else 0.05)
+        return
+    # hang: cooperative tick loop — an async StallError from the watchdog
+    # (or plain slow-path completion when nobody is watching) ends it
+    deadline = time.monotonic() + (spec.arg if spec.arg is not None
+                                   else 3600.0)
+    with _telem.span("injected_hang@%s" % site, "fault"):
+        while time.monotonic() < deadline:
+            time.sleep(HANG_TICK_S)
+
+
+def check(site, context=None):
+    """Fault-injection hook — call at the top of an instrumented site.
+
+    No-op (one global read) when no plan is active. Otherwise counts the
+    call and fires any matching planned fault. `context` is a short string
+    folded into the injected error message (e.g. "key=conv0_weight").
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return
+    count = plan.bump(site)
+    spec = plan.match(site, count)
+    if spec is not None:
+        _fire(spec, site, count, context)
+
+
+# load any env-provided plan at import so `MXNET_TPU_FAULT_PLAN=... python
+# train.py` works with zero code changes
+activate(_plan_from_env())

@@ -1,0 +1,145 @@
+"""Retry policy engine: exponential backoff + jitter + per-op deadlines.
+
+The comm layers (`kvstore_dist`, eager collectives, `dist.initialize`) wrap
+their dispatch in `call_with_retry`, so a flaky endpoint costs a backoff
+sleep instead of the whole run. Error classification is delegated to
+`resilience.errors.classify` — deterministic failures (shape/dtype, key not
+initialized) are re-raised on the first attempt; only transient transport
+faults burn retry budget.
+
+Env knobs (read per-call so tests can flip them):
+
+``MXNET_TPU_RETRIES``        max attempts per op (default 3; 1 = no retry)
+``MXNET_TPU_RETRY_BASE_S``   first backoff delay (default 0.05 s)
+``MXNET_TPU_RETRY_MAX_S``    backoff ceiling (default 2 s)
+
+Telemetry: every retried attempt increments ``resilience.retries`` (and
+``resilience.retries.<site>``); exhaustion increments
+``resilience.retry_exhausted`` and raises `RetryExhausted` carrying the
+site, attempt count, and last cause.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+import time
+
+from .errors import RetryExhausted, classify
+
+__all__ = ["RetryPolicy", "call_with_retry", "retriable", "default_policy"]
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class RetryPolicy:
+    """How many times, how long apart, and for how long in total.
+
+    jitter: each delay is multiplied by a uniform draw from
+    [1-jitter, 1+jitter] so synchronized workers don't stampede a
+    recovering endpoint in lockstep.
+    deadline_s: wall-clock budget across ALL attempts of one op; when the
+    next backoff would cross it, the policy gives up early.
+    """
+
+    def __init__(self, max_attempts=None, base_delay_s=None, max_delay_s=None,
+                 jitter=0.25, deadline_s=None):
+        if max_attempts is None:
+            max_attempts = int(_env_float("MXNET_TPU_RETRIES", 3))
+        if base_delay_s is None:
+            base_delay_s = _env_float("MXNET_TPU_RETRY_BASE_S", 0.05)
+        if max_delay_s is None:
+            max_delay_s = _env_float("MXNET_TPU_RETRY_MAX_S", 2.0)
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.jitter = float(jitter)
+        self.deadline_s = deadline_s
+
+    def delay(self, attempt):
+        """Backoff before attempt number `attempt+1` (attempt is 1-based:
+        delay(1) runs after the first failure)."""
+        d = min(self.max_delay_s, self.base_delay_s * (2.0 ** (attempt - 1)))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * _pyrandom.random() - 1.0)
+        return max(0.0, d)
+
+    def __repr__(self):
+        return ("RetryPolicy(max_attempts=%d, base=%gs, max=%gs, "
+                "jitter=%g, deadline=%s)"
+                % (self.max_attempts, self.base_delay_s, self.max_delay_s,
+                   self.jitter, self.deadline_s))
+
+
+def default_policy():
+    return RetryPolicy()
+
+
+def call_with_retry(fn, *args, site="op", policy=None, context=None,
+                    on_retry=None, retry_on=None, **kwargs):
+    """Run ``fn(*args, **kwargs)``, retrying transient failures.
+
+    site: telemetry/diagnostic label for this call site.
+    context: short string folded into error messages (e.g. "key=3 shard=(4,)").
+    on_retry: optional callback ``(attempt, exc)`` before each backoff sleep.
+    retry_on: predicate narrowing WHICH retriable errors retry in place —
+        e.g. a runner passes ``lambda e: isinstance(e, TransportError)`` so
+        preemptions/stalls propagate to its restore-and-replay path instead
+        of burning in-place attempts.
+
+    Fatal errors (per `errors.classify`) propagate immediately. Transient
+    errors are retried up to ``policy.max_attempts`` within
+    ``policy.deadline_s``; then `RetryExhausted` chains the last cause.
+    """
+    from .. import telemetry as _telem
+    if policy is None:
+        policy = RetryPolicy()
+    t0 = time.monotonic()
+    last = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as exc:  # noqa: BLE001 — classifier decides
+            if classify(exc) != "retriable":
+                raise
+            if retry_on is not None and not retry_on(exc):
+                raise
+            last = exc
+            if attempt >= policy.max_attempts:
+                break
+            delay = policy.delay(attempt)
+            if (policy.deadline_s is not None
+                    and time.monotonic() + delay - t0 > policy.deadline_s):
+                break
+            _telem.inc("resilience.retries")
+            _telem.inc("resilience.retries.%s" % site)
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            with _telem.span("retry_backoff@%s" % site, "resilience"):
+                time.sleep(delay)
+    _telem.inc("resilience.retry_exhausted")
+    detail = (" [%s]" % context) if context else ""
+    raise RetryExhausted(
+        "%s%s failed after %d attempt(s) in %.2fs; last error: %s: %s"
+        % (site, detail, min(policy.max_attempts, attempt),
+           time.monotonic() - t0, type(last).__name__, last),
+        site=site, attempts=attempt, last_error=last) from last
+
+
+def retriable(site="op", policy=None):
+    """Decorator form of `call_with_retry`. site/policy bind at decoration
+    time; every call arg/kwarg reaches the wrapped function untouched
+    (including ones named like call_with_retry's own parameters)."""
+    def wrap(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            return call_with_retry(lambda: fn(*args, **kwargs),
+                                   site=site, policy=policy)
+        return inner
+    return wrap

@@ -1,0 +1,465 @@
+"""Resumable training driver: checkpoints, fault recovery, restart budget.
+
+`ResilientRunner` wraps a per-step callable (a `gluon.FusedTrainStep`, a
+`parallel.ShardedTrainStep`, or any ``step_fn(step_idx) -> loss``) with the
+full recovery loop a preemptible fleet needs:
+
+* **periodic snapshots** — every ``ckpt_every`` steps the runner captures
+  training state (params, optimizer state and bookkeeping, RNG streams)
+  through ``state_get`` and commits it atomically (write-then-rename, with
+  ``keep=N`` retention, so a crash mid-save never corrupts the latest
+  checkpoint);
+* **fault handling** — transport faults at the step boundary are retried in
+  place (they precede any state mutation); everything else retriable —
+  `PreemptionError` (host going away), `StallError` (watchdog deadline),
+  `RetryExhausted` bubbling up from comm layers, or a mid-step transport
+  fault — triggers *restore-and-replay*: reload the latest snapshot, rewind
+  the step counter, continue. Replay is deterministic, so an interrupted
+  run reproduces the uninterrupted trajectory exactly;
+* **restart budget** — ``max_restarts`` caps recovery attempts; the budget
+  spent is reported, and exceeding it re-raises the last fault;
+* **hang watchdog** — each step runs under ``watchdog.guard`` with
+  ``step_deadline_s`` (default env ``MXNET_TPU_STEP_DEADLINE_S``), so a dead
+  collective becomes a recoverable `StallError` instead of a silent hang;
+* **mesh degradation** — an optional ``mesh_factory`` is re-polled after
+  every restore; when the visible device set shrank (preempted hosts), the
+  ``on_shrink`` hook rebuilds the step for the smaller mesh and training
+  continues degraded instead of dying.
+
+Telemetry: ``resilience.checkpoints`` / ``restores`` / ``mesh_shrinks``
+counters and ``checkpoint`` / ``restore`` chrome-trace spans (retries and
+stalls are counted by their own modules).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import re
+import shutil
+import time
+
+from . import faults, watchdog
+from .errors import RetriableError, TransportError
+from .retry import RetryPolicy, call_with_retry
+
+__all__ = ["SnapshotCheckpointer", "ResilientRunner", "RunReport",
+           "fused_step_state", "restore_fused_step_state"]
+
+_LOG = logging.getLogger("mxnet_tpu.resilience")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint backend
+# ---------------------------------------------------------------------------
+class SnapshotCheckpointer:
+    """Atomic pickled-pytree checkpoints with ``keep=N`` retention.
+
+    The dependency-free backend for host-resident state (the Gluon path, or
+    any pytree of host arrays). Pod-scale sharded trees should go through
+    `parallel.checkpoint.save_sharded` (orbax/OCDBT) instead — pass any
+    object with the same ``save/restore/latest_step`` trio as
+    ``checkpointer=`` to use it.
+
+    Commit protocol: write ``step_N.ckpt.tmp`` → fsync → ``os.replace`` to
+    ``step_N.ckpt`` → rewrite the ``LATEST`` marker the same way. A crash at
+    any point leaves either the previous committed state or the new one,
+    never a torn file.
+    """
+
+    _STEP_RE = re.compile(r"^step_(\d+)\.ckpt$")
+
+    def __init__(self, path, keep=2):
+        self.path = os.path.abspath(path)
+        self.keep = None if keep in (None, 0) else max(1, int(keep))
+        os.makedirs(self.path, exist_ok=True)
+
+    def _file(self, step):
+        return os.path.join(self.path, "step_%d.ckpt" % int(step))
+
+    def save(self, step, tree):
+        from ..util import atomic_write, write_latest_marker
+        atomic_write(self._file(step),
+                     pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL))
+        write_latest_marker(self.path, step)
+        self._retain()
+        return self._file(step)
+
+    def steps(self):
+        out = []
+        for name in os.listdir(self.path):
+            m = self._STEP_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self):
+        """Newest committed step — prefers the LATEST marker, falls back to
+        a directory scan (marker lost/corrupt ≠ checkpoints lost)."""
+        from ..util import read_latest_marker
+        step = read_latest_marker(self.path)
+        if step is not None and os.path.exists(self._file(step)):
+            return step
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step=None):
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    "no checkpoint under %s" % self.path)
+        with open(self._file(step), "rb") as f:
+            return step, pickle.load(f)
+
+    def _retain(self):
+        if self.keep is None:
+            return
+        steps = self.steps()
+        for step in steps[:-self.keep]:
+            try:
+                os.remove(self._file(step))
+            except OSError:  # pragma: no cover — races with manual cleanup
+                pass
+
+    def clear(self):
+        shutil.rmtree(self.path, ignore_errors=True)
+        os.makedirs(self.path, exist_ok=True)
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+class RunReport:
+    """What happened: per-step losses plus the recovery ledger."""
+
+    def __init__(self):
+        self.losses = []
+        self.restarts = 0
+        self.retries = 0
+        self.steps_executed = 0     # includes replayed steps
+        self.checkpoints = 0
+        self.mesh_shrinks = 0
+
+    def __repr__(self):
+        return ("RunReport(steps=%d, executed=%d, restarts=%d, retries=%d, "
+                "checkpoints=%d, mesh_shrinks=%d)"
+                % (len(self.losses), self.steps_executed, self.restarts,
+                   self.retries, self.checkpoints, self.mesh_shrinks))
+
+
+class ResilientRunner:
+    """Drive ``step_fn`` for N steps, surviving retriable faults.
+
+    step_fn(step_idx) -> loss   (must be deterministic given restored state
+                                 — replay correctness depends on it)
+    state_get() -> pytree       (host-resident snapshot of ALL mutable
+                                 training state)
+    state_set(tree)             (restore that snapshot in place)
+    """
+
+    def __init__(self, step_fn, state_get, state_set, ckpt_dir=None,
+                 checkpointer=None, ckpt_every=1, keep=2, max_restarts=3,
+                 step_deadline_s=None, retry_policy=None, mesh_factory=None,
+                 on_shrink=None, on_stall=None):
+        if checkpointer is None and ckpt_dir is not None:
+            checkpointer = SnapshotCheckpointer(ckpt_dir, keep=keep)
+        self.step_fn = step_fn
+        self.state_get = state_get
+        self.state_set = state_set
+        self.ckpt = checkpointer
+        self.ckpt_every = max(1, int(ckpt_every))
+        self.max_restarts = int(max_restarts)
+        self.step_deadline_s = (step_deadline_s
+                                if step_deadline_s is not None
+                                else watchdog.default_deadline_s())
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.mesh_factory = mesh_factory
+        self.on_shrink = on_shrink
+        self.on_stall = on_stall
+        self._mesh_size = None
+        if mesh_factory is not None:
+            mesh = mesh_factory()
+            self._mesh_size = getattr(getattr(mesh, "devices", None),
+                                      "size", None)
+
+    # ------------------------------------------------------------------
+    def _save(self, step, report):
+        if self.ckpt is None:
+            return
+        from .. import telemetry as _telem
+        with _telem.span("checkpoint", "resilience"):
+            self.ckpt.save(step, self.state_get())
+        _telem.inc("resilience.checkpoints")
+        report.checkpoints += 1
+
+    def _restore(self, report, cause):
+        if self.ckpt is None:
+            raise cause
+        from .. import telemetry as _telem
+        with _telem.span("restore", "resilience"):
+            try:
+                step, tree = self.ckpt.restore()
+            except FileNotFoundError:
+                # nothing saved yet (e.g. start_step off the ckpt cadence):
+                # the original fault is the story, not the empty dir
+                raise cause from None
+            self.state_set(tree)
+        _telem.inc("resilience.restores")
+        report.restarts += 1
+        _LOG.warning("resilience: restored step %d after %s: %s",
+                     step, type(cause).__name__, cause)
+        self._maybe_shrink(report)
+        return step
+
+    def _maybe_shrink(self, report):
+        """Poll the device set; a shrink means preempted hosts — rebuild for
+        the smaller mesh via on_shrink instead of dying on the next
+        collective."""
+        if self.mesh_factory is None:
+            return
+        mesh = self.mesh_factory()
+        size = getattr(getattr(mesh, "devices", None), "size", None)
+        if (size is not None and self._mesh_size is not None
+                and size < self._mesh_size):
+            from .. import telemetry as _telem
+            _telem.inc("resilience.mesh_shrinks")
+            report.mesh_shrinks += 1
+            _LOG.warning(
+                "resilience: device set shrank %d -> %d; degrading to the "
+                "smaller mesh", self._mesh_size, size)
+            if self.on_shrink is not None:
+                new_step_fn = self.on_shrink(mesh)
+                if new_step_fn is not None:
+                    self.step_fn = new_step_fn
+        self._mesh_size = size
+
+    # ------------------------------------------------------------------
+    def _boundary_check(self, step):
+        """The pre-mutation fault boundary: injected/transient transport
+        faults raised HERE are retried in place (nothing has changed yet).
+        Counted faults deeper in the step go down the restore path."""
+        faults.check("run.step", context="step=%d" % step)
+
+    def _run_one(self, step, report):
+        def on_retry(attempt, exc):
+            report.retries += 1
+        call_with_retry(self._boundary_check, step, site="run.step",
+                        policy=self.retry_policy,
+                        retry_on=lambda e: isinstance(e, TransportError),
+                        on_retry=on_retry)
+        with watchdog.guard("run.step", deadline_s=self.step_deadline_s,
+                            on_stall=self.on_stall):
+            loss = self.step_fn(step)
+        report.steps_executed += 1
+        return loss
+
+    def run(self, num_steps, start_step=0, resume=False):
+        """Run steps ``[start_step, num_steps)``; returns a `RunReport`.
+
+        resume=True restores the newest checkpoint first (auto-resume after
+        a process-level kill: relaunch with the same ckpt_dir and resume).
+        """
+        report = RunReport()
+        report.losses = [None] * num_steps
+        step = start_step
+        if resume and self.ckpt is not None \
+                and self.ckpt.latest_step() is not None:
+            step = self._restore(report, RetriableError("process resume"))
+            report.restarts -= 1  # a requested resume is not a failure
+        last_saved = None
+        while step < num_steps:
+            if (self.ckpt is not None and step % self.ckpt_every == 0
+                    and last_saved != step):
+                self._save(step, report)
+                last_saved = step
+            try:
+                loss = self._run_one(step, report)
+            except RetriableError as exc:
+                if report.restarts >= self.max_restarts:
+                    _LOG.error(
+                        "resilience: restart budget (%d) exhausted",
+                        self.max_restarts)
+                    raise
+                step = self._restore(report, exc)
+                last_saved = step  # that snapshot is already on disk
+                continue
+            report.losses[step] = self._to_float(loss)
+            step += 1
+        return report
+
+    @staticmethod
+    def _to_float(loss):
+        try:
+            return float(loss.asnumpy()) if hasattr(loss, "asnumpy") \
+                else float(loss)
+        except (TypeError, ValueError):
+            return loss
+
+    # ------------------------------------------------------------------
+    # adapters
+    # ------------------------------------------------------------------
+    @classmethod
+    def for_fused_step(cls, fused, batch_fn, **kwargs):
+        """Wrap a `gluon.FusedTrainStep`: state capture/restore covers the
+        net's params (train + aux), optimizer state and host bookkeeping
+        (num_update / per-index counts / schedules), and the mx.random key
+        table — kill-and-resume replays the uninterrupted trajectory
+        exactly. ``batch_fn(step_idx) -> (data, label)`` must be
+        deterministic per index (re-fetchable for replay)."""
+        data, label = batch_fn(0)
+        if not fused._built:
+            from ..gluon.fused_step import _flatten
+            flat, _ = _flatten(data, "input")
+            fused._build(flat[0].context, data, label)
+
+        def step_fn(i):
+            d, l = batch_fn(i)
+            return fused(d, l)
+
+        return cls(step_fn,
+                   state_get=lambda: fused_step_state(fused),
+                   state_set=lambda tree: restore_fused_step_state(
+                       fused, tree),
+                   **kwargs)
+
+    @classmethod
+    def for_sharded_step(cls, step, params, opt_state, batch_fn, **kwargs):
+        """Wrap a `parallel.ShardedTrainStep` (functional path): the runner
+        owns the (params, opt_state) pytrees; read the final values off the
+        returned runner via ``runner.holder``."""
+        import jax
+        import numpy as _np
+        holder = {"params": params, "opt_state": opt_state}
+
+        def step_fn(i):
+            p, o, loss = step(holder["params"], holder["opt_state"],
+                              batch_fn(i), i)
+            holder["params"], holder["opt_state"] = p, o
+            return loss
+
+        def state_get():
+            return jax.tree_util.tree_map(
+                lambda x: _np.asarray(x),
+                {"params": holder["params"],
+                 "opt_state": holder["opt_state"]})
+
+        def state_set(tree):
+            import jax.numpy as jnp
+            holder["params"] = jax.tree_util.tree_map(
+                jnp.asarray, tree["params"])
+            holder["opt_state"] = jax.tree_util.tree_map(
+                jnp.asarray, tree["opt_state"])
+
+        runner = cls(step_fn, state_get, state_set, **kwargs)
+        runner.holder = holder
+        return runner
+
+
+# ---------------------------------------------------------------------------
+# FusedTrainStep state capture (module-level so tooling can reuse it)
+# ---------------------------------------------------------------------------
+def _rng_capture():
+    import jax
+    import numpy as _np
+    from .. import random as _random
+    table = _random._table()
+    return {k: _np.asarray(jax.random.key_data(v))
+            for k, v in table.items()}
+
+
+def _rng_restore(snap):
+    import jax
+    from .. import random as _random
+    table = _random._table()
+    table.clear()
+    for k, data in snap.items():
+        table[k] = jax.random.wrap_key_data(data)
+
+
+def fused_step_state(fused):
+    """Host-resident snapshot of everything a FusedTrainStep mutates."""
+    import numpy as _np
+    from ..gluon.fused_step import _state_raws
+    if not fused._built:
+        raise RuntimeError(
+            "fused_step_state: step not built yet — run one step or use "
+            "ResilientRunner.for_fused_step (it pre-builds)")
+
+    def host(x):
+        if x is None:
+            return None
+        if isinstance(x, (tuple, list)):
+            return tuple(host(e) for e in x)
+        return _np.asarray(x)
+
+    opt = fused._trainer._optimizer
+    return {
+        "train": [host(p._read()) for p in fused._train_nds],
+        "other": [host(p._read()) for p in fused._other_nds],
+        "states": [host(_state_raws(s)) for s in fused._states],
+        "optimizer": _opt_capture(opt),
+        "rng": _rng_capture(),
+        "wall_time": time.time(),
+    }
+
+
+# mutable host-side schedule attrs some optimizers carry (Nadam's running
+# m_schedule product, LBSGD's lbmult) — the param-facing state lives in
+# `states` already
+_OPT_SCALAR_ATTRS = ("m_schedule", "lbmult")
+
+
+def _opt_capture(opt):
+    """Host bookkeeping only — NOT a pickle of the optimizer (param_dict
+    holds live Parameters; the arrays are captured separately)."""
+    return {
+        "num_update": opt.num_update,
+        "index_update_count": dict(opt._index_update_count),
+        "attrs": {a: getattr(opt, a) for a in _OPT_SCALAR_ATTRS
+                  if hasattr(opt, a)},
+        "sched": (pickle.dumps(opt.lr_scheduler,
+                               protocol=pickle.HIGHEST_PROTOCOL)
+                  if opt.lr_scheduler is not None else None),
+    }
+
+
+def _opt_restore(opt, snap):
+    opt.num_update = snap["num_update"]
+    # in place: _all_index_update_counts[0] aliases this dict
+    opt._index_update_count.clear()
+    opt._index_update_count.update(snap["index_update_count"])
+    for a, v in snap["attrs"].items():
+        setattr(opt, a, v)
+    if snap["sched"] is not None and opt.lr_scheduler is not None:
+        clone = pickle.loads(snap["sched"])
+        opt.lr_scheduler.__dict__.update(clone.__dict__)
+
+
+def restore_fused_step_state(fused, tree):
+    """Inverse of `fused_step_state` — writes the snapshot back in place
+    (the jitted programs keep their captured NDArray objects)."""
+    import jax.numpy as jnp
+    from ..gluon.fused_step import _state_write
+
+    def dev(x):
+        return None if x is None else jnp.asarray(x)
+
+    for p, raw in zip(fused._train_nds, tree["train"]):
+        p._write(dev(raw))
+    for p, raw in zip(fused._other_nds, tree["other"]):
+        p._write(dev(raw))
+
+    def dev_tree(x):
+        if x is None:
+            return None
+        if isinstance(x, tuple):
+            return tuple(dev_tree(e) for e in x)
+        return dev(x)
+
+    for s, raws in zip(fused._states, tree["states"]):
+        _state_write(s, dev_tree(raws))
+    _opt_restore(fused._trainer._optimizer, tree["optimizer"])
+    # the host scalar cache (lr/t schedules) is stale for the rewound counts
+    fused._scal_cache = None
+    _rng_restore(tree["rng"])

@@ -1,0 +1,263 @@
+"""Hang watchdog: deadlines and heartbeats around dispatched steps.
+
+The worst fleet failure mode is not a crash — it is a collective that never
+completes. One peer is gone, every other worker blocks inside the dispatch,
+and the run sits silent until an operator notices. The watchdog replaces
+that silence with a structured `StallError`:
+
+    wd = watchdog.get()
+    with wd.guard("train.step", deadline_s=30):
+        loss = step(params, batch)          # raises StallError if > 30 s
+
+A single daemon monitor thread tracks every armed guard (one per guarded
+thread). When a deadline passes it:
+
+1. increments ``resilience.stalls`` (+ per-site counter),
+2. snapshots the telemetry span tail — the post-mortem's first page,
+3. raises `StallError` *asynchronously inside the guarded thread* via
+   ``PyThreadState_SetAsyncExc``, and
+4. invokes the guard's ``on_stall`` callback (fleet integration point:
+   page someone, dump a trace file, start draining).
+
+The async raise lands at the next Python bytecode boundary — it interrupts
+Python-level waits (including the cooperative hangs `resilience.faults`
+injects, which sleep in small ticks for exactly this reason) but cannot
+interrupt a thread blocked inside a C call; for that case the stall is
+still *recorded* and `guard.__exit__` re-checks, so the error surfaces the
+moment the call returns instead of being silently swallowed.
+
+``heartbeat()`` re-arms the current thread's deadline — long steps that are
+alive (e.g. per-microbatch progress) call it to say "still moving".
+
+Default deadline: ``MXNET_TPU_STEP_DEADLINE_S`` (unset = no default; a
+guard without any deadline is a no-op).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+
+from .errors import StallError
+
+__all__ = ["Watchdog", "get", "guard", "heartbeat", "default_deadline_s"]
+
+
+def default_deadline_s():
+    val = os.environ.get("MXNET_TPU_STEP_DEADLINE_S")
+    if not val:
+        return None
+    try:
+        return float(val)
+    except ValueError:
+        return None
+
+
+def _async_raise(tid, exctype):
+    """Raise `exctype` in thread `tid` at its next bytecode boundary."""
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid), ctypes.py_object(exctype))
+    if res > 1:  # pragma: no cover — "we broke more than one thread state"
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
+
+
+def _async_clear(tid):
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
+
+
+class _AsyncStall(BaseException):
+    """Carrier raised asynchronously in the stalled thread; `_Guard.__exit__`
+    converts it into the rich `StallError` (SetAsyncExc only accepts a
+    class, so the payload travels on the guard entry instead).
+
+    BaseException so a guarded ``except Exception`` retry loop inside the
+    stalled region cannot accidentally swallow the interruption."""
+
+
+class _Entry:
+    __slots__ = ("site", "deadline", "deadline_s", "on_stall", "fired",
+                 "stall")
+
+    def __init__(self, site, deadline, deadline_s, on_stall):
+        self.site = site
+        self.deadline = deadline          # absolute monotonic time
+        self.deadline_s = deadline_s      # the span, for messages
+        self.on_stall = on_stall
+        self.fired = False
+        self.stall = None                 # prepared StallError
+
+
+class Watchdog:
+    """Monitor thread + per-thread guard registry."""
+
+    def __init__(self, poll_floor_s=0.005):
+        self._entries = {}  # thread ident -> _Entry
+        self._cond = threading.Condition()
+        self._thread = None
+        self._poll_floor_s = poll_floor_s
+
+    # ------------------------------------------------------------- monitor
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="mxnet_tpu_watchdog", daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if not self._entries:
+                    # park until the next guard arms
+                    self._cond.wait()
+                    continue
+                now = time.monotonic()
+                pending = [e.deadline for e in self._entries.values()
+                           if not e.fired]
+                if not pending:
+                    self._cond.wait()
+                    continue
+                next_deadline = min(pending)
+                if next_deadline > now:
+                    self._cond.wait(max(self._poll_floor_s,
+                                        next_deadline - now))
+                    continue
+                expired = [(tid, e) for tid, e in self._entries.items()
+                           if not e.fired and e.deadline <= now]
+                for _, entry in expired:
+                    entry.fired = True  # claim under the lock: fire once
+            # fire OUTSIDE the lock — building the span dump and (above
+            # all) the user on_stall callback must not block heartbeat(),
+            # _disarm(), or other threads' deadlines
+            for tid, entry in expired:
+                self._fire(tid, entry)
+
+    def _fire(self, tid, entry):
+        """Called without the lock; entry.fired was claimed under it."""
+        from .. import telemetry as _telem
+        stall = StallError(
+            "watchdog: %r exceeded its %.3gs deadline (no heartbeat) — "
+            "raising instead of hanging forever"
+            % (entry.site, entry.deadline_s),
+            site=entry.site, deadline_s=entry.deadline_s,
+            span_dump=_telem.span_events(limit=64))
+        with self._cond:
+            if self._entries.get(tid) is not entry:
+                # the op completed between deadline-claim and now: its guard
+                # saw stall=None and exited clean — do NOT raise into
+                # whatever that thread is running next
+                return
+            entry.stall = stall
+            _async_raise(tid, _AsyncStall)
+        _telem.inc("resilience.stalls")
+        _telem.inc("resilience.stalls.%s" % entry.site)
+        _telem.record_span("stall@%s" % entry.site, "resilience",
+                           _telem.span_clock(), 0.0)
+        if entry.on_stall is not None:
+            try:
+                entry.on_stall(stall)
+            except Exception:  # noqa: BLE001 — callbacks must not kill us
+                pass
+
+    # -------------------------------------------------------------- guards
+    def guard(self, site, deadline_s=None, on_stall=None):
+        """Context manager arming a deadline for the calling thread."""
+        return _Guard(self, site, deadline_s, on_stall)
+
+    def heartbeat(self):
+        """Push the current thread's armed deadline forward by its full
+        span — "alive, keep waiting"."""
+        tid = threading.get_ident()
+        with self._cond:
+            entry = self._entries.get(tid)
+            if entry is not None and not entry.fired:
+                entry.deadline = time.monotonic() + entry.deadline_s
+                self._cond.notify_all()
+
+    def _arm(self, entry):
+        tid = threading.get_ident()
+        with self._cond:
+            if tid in self._entries:
+                raise RuntimeError(
+                    "watchdog guard already armed for this thread "
+                    "(site=%r); nested guards are not supported"
+                    % self._entries[tid].site)
+            self._entries[tid] = entry
+            self._ensure_thread()
+            self._cond.notify_all()
+        return tid
+
+    def _disarm(self, tid):
+        """Remove the thread's entry; returns the prepared StallError if the
+        async raise was actually sent (and clears it if still undelivered).
+        entry.fired with stall=None means the deadline was claimed but the
+        op completed before _fire re-checked — no exception was or will be
+        sent (the _fire registration re-check), so that is a clean exit."""
+        with self._cond:
+            entry = self._entries.pop(tid, None)
+            stall = entry.stall if entry is not None else None
+            self._cond.notify_all()
+        if stall is not None:
+            _async_clear(tid)
+        return stall
+
+
+class _Guard:
+    def __init__(self, wd, site, deadline_s, on_stall):
+        self._wd = wd
+        self._site = site
+        if deadline_s is None:
+            deadline_s = default_deadline_s()
+        self._deadline_s = deadline_s
+        self._on_stall = on_stall
+        self._tid = None
+
+    def __enter__(self):
+        if self._deadline_s is None:
+            return self  # no deadline configured: transparent
+        entry = _Entry(self._site, time.monotonic() + self._deadline_s,
+                       self._deadline_s, self._on_stall)
+        self._tid = self._wd._arm(entry)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._tid is None:
+            return False
+        try:
+            stall = self._wd._disarm(self._tid)
+        except _AsyncStall:
+            # the carrier landed INSIDE __exit__ (op completed a hair after
+            # the raise was sent, before _disarm could clear it) — the
+            # retry returns the prepared StallError for the normal path
+            stall = self._wd._disarm(self._tid)
+        if stall is not None:
+            # the deadline fired and the async carrier was sent: surface
+            # the rich StallError whether the carrier landed (exc_type is
+            # _AsyncStall), the op raised something else while dying, or
+            # the carrier was cleared undelivered just above.
+            if exc is not None and not isinstance(exc, _AsyncStall):
+                raise stall from exc
+            raise stall
+        if isinstance(exc, _AsyncStall):
+            # carrier without a recorded stall should be impossible; never
+            # let the bare internal BaseException escape regardless
+            raise StallError(
+                "watchdog: %r interrupted (stall record lost)" % self._site,
+                site=self._site, deadline_s=self._deadline_s) from exc
+        return False
+
+
+# ------------------------------------------------------------- module-level
+_DEFAULT = Watchdog()
+
+
+def get():
+    return _DEFAULT
+
+
+def guard(site, deadline_s=None, on_stall=None):
+    return _DEFAULT.guard(site, deadline_s=deadline_s, on_stall=on_stall)
+
+
+def heartbeat():
+    _DEFAULT.heartbeat()

@@ -41,7 +41,8 @@ from . import memory as _memory
 
 __all__ = ["enabled", "enable", "disable", "registry", "counter", "gauge",
            "histogram", "inc", "set_gauge", "observe", "span", "record_span",
-           "snapshot", "reset", "dumps", "dump", "dump_trace",
+           "snapshot", "reset", "dumps", "dump", "dump_trace", "span_events",
+           "aggregate_snapshot", "merge_snapshots",
            "sample_memory", "maybe_sample_memory",
            "Counter", "Gauge", "Histogram", "Registry"]
 
@@ -150,6 +151,16 @@ def span_clock():
     return _trace.now()
 
 
+def span_events(limit=None):
+    """Recorded spans as (name, cat, ts_s, dur_s, tid) tuples, oldest first;
+    `limit` keeps only the newest N. The resilience watchdog embeds this
+    tail in `StallError` so a hang post-mortem starts with data."""
+    events = _trace.events()
+    if limit is not None and len(events) > limit:
+        events = events[-limit:]
+    return events
+
+
 # ---------------------------------------------------------------- memory
 def sample_memory():
     """Force one device-memory gauge sample; returns #devices reporting."""
@@ -194,3 +205,20 @@ def dump_trace(path=None):
         path = "telemetry_trace.json"
     write_chrome_trace(path, _trace, registry)
     return path
+
+
+def aggregate_snapshot(snapshot=None):
+    """Fleet-wide snapshot: this worker's (or `snapshot`) merged with every
+    other worker's over one DCN allgather — counters sum, gauge watermarks
+    take the fleet max, histograms merge bucket-wise. Collective on
+    multi-worker runtimes; local-only (and cheap) on one process. See
+    telemetry/aggregate.py."""
+    from .aggregate import aggregate_snapshot as _agg
+    return _agg(snapshot)
+
+
+def merge_snapshots(snaps):
+    """Pure merge of snapshot dicts (the host-side half of
+    `aggregate_snapshot`) — usable on dumps collected out-of-band."""
+    from .aggregate import merge_snapshots as _merge
+    return _merge(snaps)

@@ -1,0 +1,101 @@
+"""Cross-worker telemetry aggregation (fleet view).
+
+`mx.telemetry` is per-process; a multi-host mesh has one registry per
+worker, and a fleet dashboard wants ONE view: total retries, total bytes
+pushed, the worst stall. This module merges counter/gauge/histogram
+snapshots across all workers of the multi-controller runtime.
+
+Mechanism: each worker serializes its snapshot to JSON bytes, the buffers
+are length-padded and exchanged with one
+``multihost_utils.process_allgather`` (riding the same DCN collectives as
+training — no side channel, no extra server), and every worker merges the
+decoded snapshots identically:
+
+* counters — summed (call counts, bytes, faults are extensive),
+* gauges — ``value``/``max`` take the max across workers (they are
+  watermarks; a fleet watermark is the worst offender),
+* histograms — bucket-wise sum, count/sum summed, min/max of the extremes,
+* plus a ``workers`` key: how many snapshots were merged.
+
+Key sets may differ per worker (e.g. only rank 0 ran a compile) — the
+merge is over the union. Single-process: returns the local snapshot merged
+with nothing, same shape, so dashboards need no special case.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["merge_snapshots", "aggregate_snapshot"]
+
+
+def _merge_hist(a, b):
+    buckets = dict(a.get("buckets", {}))
+    for k, n in b.get("buckets", {}).items():
+        buckets[k] = buckets.get(k, 0) + n
+    count = a.get("count", 0) + b.get("count", 0)
+    total = a.get("sum", 0.0) + b.get("sum", 0.0)
+    mins = [v for v in (a.get("min"), b.get("min")) if v is not None]
+    maxs = [v for v in (a.get("max"), b.get("max")) if v is not None]
+    return {"count": count, "sum": total,
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+            "avg": (total / count) if count else None,
+            "buckets": buckets}
+
+
+def merge_snapshots(snaps):
+    """Merge a list of `Registry.snapshot()` dicts into one fleet view."""
+    out = {"counters": {}, "gauges": {}, "histograms": {},
+           "workers": len(snaps)}
+    for snap in snaps:
+        for name, v in snap.get("counters", {}).items():
+            out["counters"][name] = out["counters"].get(name, 0) + v
+        for name, g in snap.get("gauges", {}).items():
+            cur = out["gauges"].get(name)
+            if cur is None:
+                out["gauges"][name] = {"value": g["value"], "max": g["max"]}
+            else:
+                cur["value"] = max(cur["value"], g["value"])
+                cur["max"] = max(cur["max"], g["max"])
+        for name, h in snap.get("histograms", {}).items():
+            cur = out["histograms"].get(name)
+            out["histograms"][name] = _merge_hist(cur, h) if cur else \
+                _merge_hist(h, {})
+    return out
+
+
+def _exchange_json(payload_bytes):
+    """All-gather variable-length byte strings across workers: gather the
+    lengths (fixed shape), right-pad to the global max, gather the padded
+    buffers, trim. One extra scalar collective is the price of not forcing
+    every worker to have identical metric sets."""
+    import numpy as _np
+    from jax.experimental import multihost_utils
+    local = _np.frombuffer(payload_bytes, dtype=_np.uint8)
+    lengths = multihost_utils.process_allgather(
+        _np.asarray([local.size], _np.int32))
+    lengths = _np.asarray(lengths).reshape(-1)
+    width = int(lengths.max())
+    padded = _np.zeros((width,), _np.uint8)
+    padded[:local.size] = local
+    stacked = _np.asarray(multihost_utils.process_allgather(padded))
+    stacked = stacked.reshape(-1, width)
+    return [stacked[i, :int(n)].tobytes() for i, n in enumerate(lengths)]
+
+
+def aggregate_snapshot(snapshot=None):
+    """Fleet-wide merged snapshot (every worker returns the same dict).
+
+    Collective: on a multi-worker runtime EVERY process must call this at
+    the same point (like a barrier). Single-process calls are local-only
+    and always safe.
+    """
+    from .. import telemetry as _telem
+    from ..parallel import dist
+    if snapshot is None:
+        snapshot = _telem.snapshot()
+    if dist.num_workers() <= 1:
+        return merge_snapshots([snapshot])
+    blobs = _exchange_json(
+        json.dumps(snapshot, sort_keys=True).encode("utf-8"))
+    return merge_snapshots([json.loads(b.decode("utf-8")) for b in blobs])

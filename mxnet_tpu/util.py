@@ -7,11 +7,45 @@ import os
 __all__ = ["large_tensor_scope",
            "makedirs", "getenv", "setenv", "set_np", "reset_np",
            "is_np_array", "is_np_shape", "use_np", "np_array", "np_shape",
-           "default_array"]
+           "default_array", "atomic_write", "write_latest_marker",
+           "read_latest_marker"]
 
 
 def makedirs(d):
     os.makedirs(d, exist_ok=True)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe file commit — shared by the checkpoint layers
+# (parallel.checkpoint LATEST marker, resilience.run SnapshotCheckpointer)
+# ---------------------------------------------------------------------------
+def atomic_write(path, data):
+    """Write `data` (bytes) to `path` via tmp + fsync + os.replace: a crash
+    at any point leaves the previous content or the new one, never a torn
+    file."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_latest_marker(root, step):
+    """Commit `root`/LATEST naming the newest fully-durable checkpoint
+    step. Call strictly AFTER the step's payload is on disk."""
+    atomic_write(os.path.join(root, "LATEST"), ("%d\n" % int(step)).encode())
+
+
+def read_latest_marker(root):
+    """The step named by `root`/LATEST, or None (missing/corrupt marker —
+    callers fall back to a directory scan; a lost marker never loses
+    checkpoints)."""
+    try:
+        with open(os.path.join(root, "LATEST")) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
 
 
 def getenv(name):

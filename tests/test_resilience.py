@@ -1,0 +1,694 @@
+"""mxnet_tpu.resilience — fault injection, retry, watchdog, auto-resume.
+
+Every scenario runs on one chip: the fault harness makes preemptions,
+transport faults, and hangs deterministic, so the recovery paths
+(in-place retry, StallError-instead-of-hang, restore-and-replay) are
+ordinary unit tests. The kill-and-resume parity tests reuse the 6-step
+trajectory pattern from test_fused_step.py.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, resilience as rz, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import faults, retry, watchdog
+from mxnet_tpu.resilience.errors import (FatalTrainingError, InjectedFault,
+                                         PreemptionError, RetryExhausted,
+                                         StallError, TransportError,
+                                         classify)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    return telemetry.snapshot()["counters"].get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# faults: plan grammar + injection
+# ---------------------------------------------------------------------------
+def test_fault_plan_parse():
+    plan = faults.FaultPlan.parse(
+        "kvstore.push:error:1; collective.all_reduce:latency:2:0.01;"
+        "run.step:preempt:3+;train.step:hang:*:0.1")
+    kinds = [(s.site, s.kind) for s in plan.specs]
+    assert kinds == [("kvstore.push", "error"),
+                     ("collective.all_reduce", "latency"),
+                     ("run.step", "preempt"), ("train.step", "hang")]
+    assert plan.specs[1].arg == pytest.approx(0.01)
+    assert plan.specs[2].from_nth_on and plan.specs[2].nth == 3
+    assert plan.specs[3].every
+    # nth matching
+    assert not plan.specs[0].matches(2)
+    assert plan.specs[2].matches(3) and plan.specs[2].matches(7)
+    assert plan.specs[3].matches(1)
+
+
+def test_fault_plan_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("justonefield")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("a:explode:1")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("a:error:0")
+
+
+def test_inject_scoping_and_counts():
+    before = faults.active_plan()
+    with faults.inject("s:error:2") as plan:
+        faults.check("s")              # call 1: clean
+        with pytest.raises(InjectedFault):
+            faults.check("s")          # call 2: fires
+        faults.check("s")              # call 3: clean again
+        assert plan.count("s") == 3
+    assert faults.active_plan() is before
+
+
+def test_env_fault_plan(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FAULT_PLAN", "e.site:preempt:1")
+    try:
+        faults.activate()
+        with pytest.raises(PreemptionError):
+            faults.check("e.site")
+    finally:
+        faults.deactivate()
+
+
+def test_latency_injection_sleeps():
+    with faults.inject("l.site:latency:1:0.05"):
+        t0 = time.monotonic()
+        faults.check("l.site")
+        assert time.monotonic() - t0 >= 0.04
+
+
+# ---------------------------------------------------------------------------
+# error classification
+# ---------------------------------------------------------------------------
+def test_classify_taxonomy():
+    assert classify(TransportError("x")) == "retriable"
+    assert classify(PreemptionError("x")) == "retriable"
+    assert classify(StallError("x")) == "retriable"
+    assert classify(FatalTrainingError("x")) == "fatal"
+    assert classify(ValueError("anything")) == "fatal"
+    assert classify(ConnectionResetError("peer")) == "retriable"
+    # message-based: grpc-ish runtime errors
+    assert classify(RuntimeError("UNAVAILABLE: connection reset")) \
+        == "retriable"
+    assert classify(RuntimeError("DEADLINE_EXCEEDED while waiting")) \
+        == "retriable"
+    # fatal markers beat transient markers
+    assert classify(RuntimeError(
+        "INVALID_ARGUMENT: shape mismatch on connection")) == "fatal"
+    assert classify(RuntimeError("no idea what happened")) == "fatal"
+
+
+# ---------------------------------------------------------------------------
+# retry engine
+# ---------------------------------------------------------------------------
+def test_retry_succeeds_after_injected_fault():
+    base = _counter("resilience.retries")
+    calls = {"n": 0}
+
+    def flaky():
+        faults.check("r.site")
+        calls["n"] += 1
+        return "ok"
+
+    with faults.inject("r.site:error:1"):
+        out = retry.call_with_retry(
+            flaky, site="r.site",
+            policy=retry.RetryPolicy(max_attempts=3, base_delay_s=0.001))
+    assert out == "ok" and calls["n"] == 1
+    assert _counter("resilience.retries") == base + 1
+    assert _counter("resilience.retries.r.site") >= 1
+
+
+def test_retry_fatal_propagates_first_attempt():
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("dtype mismatch")
+
+    with pytest.raises(ValueError):
+        retry.call_with_retry(fatal, site="f.site",
+                              policy=retry.RetryPolicy(max_attempts=5,
+                                                       base_delay_s=0.001))
+    assert calls["n"] == 1
+
+
+def test_retry_exhausted_carries_context():
+    def always_down():
+        raise TransportError("endpoint down")
+
+    with pytest.raises(RetryExhausted) as ei:
+        retry.call_with_retry(
+            always_down, site="kvstore.push", context="key=7 shard=(4, 4)",
+            policy=retry.RetryPolicy(max_attempts=3, base_delay_s=0.001))
+    err = ei.value
+    assert err.attempts == 3 and err.site == "kvstore.push"
+    assert isinstance(err.last_error, TransportError)
+    assert "key=7" in str(err) and "3 attempt" in str(err)
+    # RetryExhausted is itself retriable at a coarser granularity
+    assert classify(err) == "retriable"
+
+
+def test_retry_on_filter():
+    """A runner narrows in-place retry to TransportError: preemptions must
+    reach its restore path un-retried."""
+    calls = {"n": 0}
+
+    def preempted():
+        calls["n"] += 1
+        raise PreemptionError("going away")
+
+    with pytest.raises(PreemptionError):
+        retry.call_with_retry(
+            preempted, site="p",
+            retry_on=lambda e: isinstance(e, TransportError),
+            policy=retry.RetryPolicy(max_attempts=5, base_delay_s=0.001))
+    assert calls["n"] == 1
+
+
+def test_retriable_decorator_passes_kwargs_through():
+    """site/policy bind at decoration; the wrapped function's own kwargs —
+    even ones named like call_with_retry parameters — arrive untouched."""
+    seen = {}
+
+    @retry.retriable("deco.site",
+                     policy=retry.RetryPolicy(max_attempts=2,
+                                              base_delay_s=0.001))
+    def fn(x, context=None, policy="user-policy"):
+        seen.update(x=x, context=context, policy=policy)
+        return x + 1
+
+    assert fn(1, context="user-context") == 2
+    assert seen == {"x": 1, "context": "user-context",
+                    "policy": "user-policy"}
+
+
+def test_retry_env_knob(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_RETRIES", "7")
+    assert retry.RetryPolicy().max_attempts == 7
+    monkeypatch.setenv("MXNET_TPU_RETRIES", "1")
+    calls = {"n": 0}
+
+    def down():
+        calls["n"] += 1
+        raise TransportError("down")
+
+    with pytest.raises(RetryExhausted):
+        retry.call_with_retry(down, site="k")
+    assert calls["n"] == 1  # max_attempts=1 == no retry
+
+
+def test_backoff_is_exponential_with_ceiling():
+    pol = retry.RetryPolicy(max_attempts=10, base_delay_s=0.1,
+                            max_delay_s=0.5, jitter=0.0)
+    assert pol.delay(1) == pytest.approx(0.1)
+    assert pol.delay(2) == pytest.approx(0.2)
+    assert pol.delay(3) == pytest.approx(0.4)
+    assert pol.delay(4) == pytest.approx(0.5)  # ceiling
+    jittered = retry.RetryPolicy(base_delay_s=0.1, jitter=0.25)
+    assert 0.074 <= jittered.delay(1) <= 0.126
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_turns_hang_into_stall_error():
+    base = _counter("resilience.stalls")
+    telemetry.span("warmup", "test").__enter__()  # ensure some span exists
+    t0 = time.monotonic()
+    with pytest.raises(StallError) as ei:
+        with faults.inject("w.site:hang:1:30"):
+            with watchdog.guard("w.site", deadline_s=0.25):
+                faults.check("w.site")  # cooperative hang, 30s
+    took = time.monotonic() - t0
+    assert took < 5.0, "watchdog did not interrupt the hang (took %.1fs)" % took
+    err = ei.value
+    assert err.site == "w.site" and err.deadline_s == pytest.approx(0.25)
+    assert err.span_dump, "StallError must carry the telemetry span dump"
+    assert "recent spans" in err.format_spans()
+    assert _counter("resilience.stalls") == base + 1
+    assert _counter("resilience.stalls.w.site") >= 1
+
+
+def test_watchdog_quiet_when_fast():
+    base = _counter("resilience.stalls")
+    with watchdog.guard("q.site", deadline_s=5.0):
+        x = sum(range(1000))
+    assert x == 499500
+    assert _counter("resilience.stalls") == base
+
+
+def test_watchdog_heartbeat_extends_deadline():
+    base = _counter("resilience.stalls")
+    with watchdog.guard("h.site", deadline_s=0.3):
+        for _ in range(5):
+            time.sleep(0.15)
+            watchdog.heartbeat()  # 0.75s total but never 0.3s silent
+    assert _counter("resilience.stalls") == base
+
+
+def test_watchdog_no_deadline_is_transparent():
+    with watchdog.guard("n.site", deadline_s=None):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# kvstore wiring
+# ---------------------------------------------------------------------------
+def test_kvstore_dist_push_retries_injected_fault():
+    kv = mx.kv.create("dist_sync")
+    shape = (4, 3)
+    kv.init("w", nd.zeros(shape))
+    base = _counter("resilience.retries")
+    with faults.inject("kvstore.push:error:1"):
+        kv.push("w", nd.ones(shape))
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(shape))
+    assert _counter("resilience.retries") > base
+
+
+def test_kvstore_pull_retries_injected_fault():
+    kv = mx.kv.create("local")
+    kv.init("p", nd.full((2, 2), 3.0))
+    out = nd.zeros((2, 2))
+    with faults.inject("kvstore.pull:error:1"):
+        kv.pull("p", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0 * np.ones((2, 2)))
+
+
+def test_kvstore_dist_exhaustion_reports_key_and_attempts(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_RETRIES", "2")
+    monkeypatch.setenv("MXNET_TPU_RETRY_BASE_S", "0.001")
+    kv = mx.kv.create("dist_sync")
+    kv.init("conv0_weight", nd.zeros((4,)))
+    with faults.inject("kvstore.push:error:*"):
+        with pytest.raises(RetryExhausted) as ei:
+            kv.push("conv0_weight", nd.ones((4,)))
+    msg = str(ei.value)
+    assert "key=conv0_weight" in msg and "shard=(4,)" in msg
+    assert "2 attempt" in msg
+    assert ei.value.site == "kvstore.push"
+
+
+def test_kvstore_dist_wraps_foreign_errors_with_context():
+    kv = mx.kv.create("dist_sync")
+    kv.init("3", nd.zeros((2,)))
+    kv._updater = lambda *a: (_ for _ in ()).throw(
+        RuntimeError("UNAVAILABLE: endpoint lost"))
+    with pytest.raises(TransportError) as ei:
+        kv.push("3", nd.ones((2,)))
+    assert "key=3" in str(ei.value) and "UNAVAILABLE" in str(ei.value)
+
+
+def test_collective_barrier_retries_injected_fault():
+    from mxnet_tpu.parallel import collectives
+    base = _counter("resilience.retries")
+    with faults.inject("collective.barrier:error:1"):
+        collectives.barrier()
+    assert _counter("resilience.retries") > base
+
+
+# ---------------------------------------------------------------------------
+# snapshot checkpointer
+# ---------------------------------------------------------------------------
+def test_snapshot_checkpointer_roundtrip_retention_atomicity(tmp_path):
+    ck = rz.SnapshotCheckpointer(str(tmp_path / "ck"), keep=2)
+    for step in range(5):
+        ck.save(step, {"w": np.full((3,), step), "step": step})
+    assert ck.steps() == [3, 4], "keep=2 must prune older steps"
+    assert ck.latest_step() == 4
+    step, tree = ck.restore()
+    assert step == 4 and tree["step"] == 4
+    np.testing.assert_array_equal(tree["w"], np.full((3,), 4))
+    # torn write simulation: a stray .tmp and a corrupt LATEST marker must
+    # not lose the committed checkpoints
+    (tmp_path / "ck" / "step_9.ckpt.tmp").write_bytes(b"torn")
+    (tmp_path / "ck" / "LATEST").write_text("not a number")
+    assert ck.latest_step() == 4
+    step, tree = ck.restore()
+    assert step == 4
+
+
+def test_sharded_checkpoint_keep_and_latest_marker(tmp_path):
+    """parallel.checkpoint satellite: keep=N retention + atomic LATEST."""
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    path = str(tmp_path / "ck")
+    for step in (1, 2, 3, 4):
+        ckpt.save_sharded(path, {"w": np.ones((2,)) * step}, step=step,
+                          keep=2)
+    assert ckpt.latest_step(path) == 4
+    committed = [d for d in os.listdir(path) if d.isdigit()]
+    assert sorted(int(d) for d in committed) == [3, 4], \
+        "keep=2 must retain exactly the newest two steps"
+    assert (tmp_path / "ck" / "LATEST").read_text().strip() == "4"
+    # corrupt marker: scan fallback still finds the newest step
+    (tmp_path / "ck" / "LATEST").write_text("garbage")
+    assert ckpt.latest_step(path) == 4
+    restored = ckpt.restore_sharded(path)
+    np.testing.assert_allclose(np.asarray(restored["w"]), 4 * np.ones((2,)))
+
+
+# ---------------------------------------------------------------------------
+# resilient runner: the acceptance scenario
+# ---------------------------------------------------------------------------
+def _build_mlp():
+    mx.random.seed(42)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    return net, tr
+
+
+def _six_batches():
+    rng = np.random.RandomState(0)
+    X = rng.rand(6, 32, 8).astype(np.float32)
+    Y = rng.randint(0, 3, (6, 32)).astype(np.float32)
+    return lambda i: (nd.array(X[i]), nd.array(Y[i]))
+
+
+def test_kill_and_resume_matches_fault_free_run(tmp_path, monkeypatch):
+    """ISSUE acceptance: MXNET_TPU_FAULT_PLAN injects a transport fault AND
+    a mid-run kill; the 6-step resilient run must reproduce the fault-free
+    trajectory and final params within fp32 tolerance, with nonzero
+    resilience.retries and resilience.restores."""
+    batch_fn = _six_batches()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    clean = [float(fused_a(*batch_fn(i)).asnumpy()) for i in range(6)]
+
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    retries0 = _counter("resilience.retries")
+    restores0 = _counter("resilience.restores")
+    monkeypatch.setenv("MXNET_TPU_FAULT_PLAN",
+                       "run.step:error:2;run.step:preempt:5")
+    try:
+        faults.activate()
+        runner = rz.ResilientRunner.for_fused_step(
+            fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+            max_restarts=3,
+            retry_policy=retry.RetryPolicy(max_attempts=3,
+                                           base_delay_s=0.001))
+        report = runner.run(6)
+    finally:
+        faults.deactivate()
+
+    assert report.restarts >= 1 and report.retries >= 1
+    np.testing.assert_allclose(clean, report.losses, rtol=1e-5, atol=1e-6)
+    for (ka, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                 sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=ka)
+    assert _counter("resilience.retries") > retries0
+    assert _counter("resilience.restores") > restores0
+
+
+def test_kill_and_resume_with_dropout_rng_state(tmp_path):
+    """RNG key table is checkpointed: even a net that CONSUMES randomness
+    every step (dropout) replays the uninterrupted trajectory."""
+    def build():
+        mx.random.seed(9)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dropout(0.4),
+                    nn.Dense(3))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        return net, tr
+
+    batch_fn = _six_batches()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net_a, tr_a = build()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    clean = [float(fused_a(*batch_fn(i)).asnumpy()) for i in range(6)]
+
+    net_b, tr_b = build()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    with faults.inject("run.step:preempt:3"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+            max_restarts=2)
+        report = runner.run(6)
+    assert report.restarts == 1
+    np.testing.assert_allclose(clean, report.losses, rtol=1e-5, atol=1e-6)
+
+
+def test_runner_fault_before_first_checkpoint_surfaces_cause(tmp_path):
+    """A fault with an EMPTY checkpoint dir must surface the fault itself,
+    not a FileNotFoundError about the missing snapshot."""
+    def step_fn(i):
+        faults.check("bare.step")
+        return 0.0
+
+    state = {"w": 1.0}
+    with faults.inject("bare.step:preempt:1"):
+        runner = rz.ResilientRunner(
+            step_fn, state_get=lambda: dict(state),
+            state_set=lambda t: state.update(t),
+            ckpt_dir=str(tmp_path / "ck"), ckpt_every=5, max_restarts=3)
+        # start_step=2 is off the ckpt cadence: nothing saved before the hit
+        with pytest.raises(PreemptionError):
+            runner.run(6, start_step=2)
+
+
+def test_runner_restart_budget_exhausts():
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    batch_fn = _six_batches()
+    with faults.inject("run.step:preempt:1+"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused, batch_fn, ckpt_dir=None, max_restarts=2)
+        # no checkpointer: first preemption must surface immediately
+        with pytest.raises(PreemptionError):
+            runner.run(6)
+
+
+def test_runner_recovers_from_stall(tmp_path):
+    """A hang inside the step (dead collective) → watchdog StallError →
+    restore-and-replay, run completes."""
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    batch_fn = _six_batches()
+    stalls0 = _counter("resilience.stalls")
+    with faults.inject("train.step:hang:3:30"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+            max_restarts=2, step_deadline_s=0.5)
+        report = runner.run(4)
+    assert report.restarts == 1
+    assert _counter("resilience.stalls") > stalls0
+    assert all(l is not None for l in report.losses)
+
+
+def test_runner_step_deadline_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_STEP_DEADLINE_S", "0.4")
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    runner = rz.ResilientRunner.for_fused_step(
+        fused, _six_batches(), ckpt_dir=str(tmp_path / "ck"))
+    assert runner.step_deadline_s == pytest.approx(0.4)
+
+
+def test_runner_auto_resume_after_process_kill(tmp_path):
+    """resume=True restores the newest checkpoint — the relaunch-after-kill
+    path (same ckpt_dir, fresh process state)."""
+    batch_fn = _six_batches()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    clean = [float(fused_a(*batch_fn(i)).asnumpy()) for i in range(6)]
+
+    # "first boot": dies by preemption with the restart budget at 0
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    runner = rz.ResilientRunner.for_fused_step(
+        fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+        max_restarts=0)
+    with faults.inject("run.step:preempt:4"):
+        with pytest.raises(PreemptionError):
+            runner.run(6)
+
+    # "relaunch": perturb live state to prove restore really happens
+    for _, p in net_b.collect_params().items():
+        p.set_data(p.data() * 0.0)
+    runner2 = rz.ResilientRunner.for_fused_step(
+        fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1)
+    report = runner2.run(6, resume=True)
+    assert report.restarts == 0  # a requested resume is not a failure
+    for (ka, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                 sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=ka)
+    # the tail of the trajectory (post-resume steps) matches the clean run
+    resumed_tail = [l for l in report.losses if l is not None]
+    np.testing.assert_allclose(clean[-len(resumed_tail):], resumed_tail,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_runner_mesh_shrink_degrades_gracefully(tmp_path):
+    """Device set shrinks across a restore → on_shrink rebuilds the step
+    for the smaller mesh and the run continues (degraded, not dead)."""
+    class FakeDevices:
+        def __init__(self, size):
+            self.size = size
+
+    class FakeMesh:
+        def __init__(self, size):
+            self.devices = FakeDevices(size)
+
+    sizes = {"n": 8}
+    meshes = []
+
+    def mesh_factory():
+        m = FakeMesh(sizes["n"])
+        meshes.append(m)
+        return m
+
+    state = {"w": 0.0, "rebuilt_for": None}
+
+    def step_fn(i):
+        faults.check("fake.step")
+        state["w"] += 1.0
+        return state["w"]
+
+    def on_shrink(mesh):
+        state["rebuilt_for"] = mesh.devices.size
+        return step_fn  # rebuilt step for the smaller mesh
+
+    shrinks0 = _counter("resilience.mesh_shrinks")
+    with faults.inject("fake.step:preempt:3"):
+        runner = rz.ResilientRunner(
+            step_fn, state_get=lambda: dict(state),
+            state_set=lambda t: state.update(t),
+            ckpt_dir=str(tmp_path / "ck"), ckpt_every=1, max_restarts=2,
+            mesh_factory=mesh_factory, on_shrink=on_shrink)
+        sizes["n"] = 4  # preemption takes half the fleet
+        report = runner.run(5)
+    assert report.restarts == 1 and report.mesh_shrinks == 1
+    assert state["rebuilt_for"] == 4
+    assert _counter("resilience.mesh_shrinks") == shrinks0 + 1
+
+
+def test_sharded_train_step_resilient_run(tmp_path):
+    """Functional path: ShardedTrainStep under the runner reproduces the
+    uninterrupted trajectory through a preemption."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import ShardedTrainStep, create_mesh
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(6, 16, 4).astype(np.float32)
+    Y = rng.rand(6, 16, 2).astype(np.float32)
+
+    def batch_fn(i):
+        return {"x": jnp.asarray(X[i]), "y": jnp.asarray(Y[i])}
+
+    def make():
+        mesh = create_mesh(data=2)
+        params = {"w": jnp.zeros((4, 2))}
+        step = ShardedTrainStep(loss_fn, params, mesh, optimizer="sgd",
+                                lr=0.1, momentum=0.9, donate=False)
+        return step, step.init()
+
+    step_a, (pa, oa) = make()
+    clean = []
+    for i in range(6):
+        pa, oa, l = step_a(pa, oa, batch_fn(i), i)
+        clean.append(float(l))
+
+    step_b, (pb, ob) = make()
+    with faults.inject("run.step:preempt:4"):
+        runner = rz.ResilientRunner.for_sharded_step(
+            step_b, pb, ob, batch_fn, ckpt_dir=str(tmp_path / "ck"),
+            ckpt_every=2, max_restarts=2)
+        report = runner.run(6)
+    assert report.restarts == 1
+    np.testing.assert_allclose(clean, report.losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(pa["w"]),
+                               np.asarray(runner.holder["params"]["w"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# telemetry aggregation (satellite)
+# ---------------------------------------------------------------------------
+def test_merge_snapshots_fleet_semantics():
+    a = {"counters": {"kvstore.push_calls": 3, "resilience.retries": 1},
+         "gauges": {"memory.dev0.bytes_in_use": {"value": 10, "max": 40}},
+         "histograms": {"step_ms": {"count": 2, "sum": 10.0, "min": 4.0,
+                                    "max": 6.0, "avg": 5.0,
+                                    "buckets": {"le_10": 2}}}}
+    b = {"counters": {"kvstore.push_calls": 5, "cachedop.compile": 2},
+         "gauges": {"memory.dev0.bytes_in_use": {"value": 30, "max": 35}},
+         "histograms": {"step_ms": {"count": 1, "sum": 8.0, "min": 8.0,
+                                    "max": 8.0, "avg": 8.0,
+                                    "buckets": {"le_10": 1}}}}
+    m = telemetry.merge_snapshots([a, b])
+    assert m["workers"] == 2
+    assert m["counters"]["kvstore.push_calls"] == 8      # extensive: sum
+    assert m["counters"]["cachedop.compile"] == 2        # union of keys
+    g = m["gauges"]["memory.dev0.bytes_in_use"]
+    assert g["value"] == 30 and g["max"] == 40           # fleet watermark
+    h = m["histograms"]["step_ms"]
+    assert h["count"] == 3 and h["sum"] == pytest.approx(18.0)
+    assert h["min"] == 4.0 and h["max"] == 8.0
+    assert h["avg"] == pytest.approx(6.0)
+    assert h["buckets"]["le_10"] == 3
+
+
+def test_aggregate_snapshot_single_process():
+    telemetry.inc("agg.test.counter", 4)
+    merged = telemetry.aggregate_snapshot()
+    assert merged["workers"] == 1
+    assert merged["counters"]["agg.test.counter"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# tooling (satellite)
+# ---------------------------------------------------------------------------
+def test_parse_log_resilience_mode(tmp_path):
+    telemetry.reset()  # counters are process-global; start this dump clean
+    telemetry.inc("resilience.retries")
+    telemetry.inc("resilience.retries.kvstore.push")
+    telemetry.inc("resilience.restores", 2)
+    dump = str(tmp_path / "telemetry.json")
+    telemetry.dump(dump)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         dump, "--resilience"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "| retries | total |" in r.stdout
+    assert "| retries | kvstore.push | 1 |" in r.stdout
+    assert "| restores | total |" in r.stdout
+    # csv shape too
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         dump, "--resilience", "--format", "csv"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "event,site,count" in r.stdout

@@ -77,6 +77,56 @@ def _print_telemetry(rows, fmt):
         print(line % r)
 
 
+# the headline resilience events, in narrative order; per-site counters
+# (resilience.retries.kvstore.push, ...) list after their total
+_RESILIENCE_EVENTS = ("faults_injected", "retries", "retry_exhausted",
+                      "stalls", "restores", "checkpoints", "mesh_shrinks")
+
+
+def parse_resilience(obj):
+    """Extract the resilience story from a telemetry snapshot: one row per
+    `resilience.*` counter — was the run clean, noisy-but-recovered, or
+    restart-heavy? Returns [(event, site, count)]."""
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    counters = obj.get("counters", {})
+    rows = []
+    for event in _RESILIENCE_EVENTS:
+        total_key = "resilience.%s" % event
+        if total_key in counters:
+            rows.append((event, "total", counters[total_key]))
+        prefix = total_key + "."
+        for name in sorted(counters):
+            if name.startswith(prefix):
+                rows.append((event, name[len(prefix):], counters[name]))
+    # unknown resilience.* counters (future events) still surface
+    known = {"resilience.%s" % e for e in _RESILIENCE_EVENTS}
+    for name in sorted(counters):
+        if name.startswith("resilience.") and name not in known and \
+                not any(name.startswith("resilience.%s." % e)
+                        for e in _RESILIENCE_EVENTS):
+            rows.append((name[len("resilience."):], "total", counters[name]))
+    return rows
+
+
+def _print_resilience(rows, fmt):
+    if not rows:
+        # nothing on stdout: a header with zero rows reads as data to
+        # downstream CSV consumers
+        print("no resilience.* counters in this dump (clean run or "
+              "telemetry disabled)", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| event | site | count |")
+        print("| --- | --- | --- |")
+        line = "| %s | %s | %s |"
+    else:
+        print("event,site,count")
+        line = "%s,%s,%s"
+    for r in rows:
+        print(line % r)
+
+
 def _load_json(path):
     try:
         with open(path) as f:
@@ -95,8 +145,19 @@ def main():
     parser.add_argument("--telemetry", action="store_true",
                         help="treat the input as a telemetry/profiler JSON "
                              "dump (auto-detected for JSON files)")
+    parser.add_argument("--resilience", action="store_true",
+                        help="resilience-events mode: table of retries/"
+                             "stalls/restores/faults from a telemetry JSON "
+                             "dump — distinguishes a noisy-but-recovered "
+                             "run from a clean one")
     args = parser.parse_args()
     obj = _load_json(args.logfile)
+    if args.resilience:
+        if obj is None:
+            sys.exit("--resilience input is not a JSON object: %s"
+                     % args.logfile)
+        _print_resilience(parse_resilience(obj), args.format)
+        return
     if args.telemetry or obj is not None:
         if obj is None:
             sys.exit("--telemetry input is not a JSON object: %s"
