@@ -1,0 +1,56 @@
+"""`mx.analysis` — tracelint: trace-safety & concurrency linter for
+hybridized code, plus a runtime trace guard.
+
+The single largest class of silent perf/correctness bugs in the MXNet→TPU
+graft is code that is legal eager MXNet but hostile under `jit` tracing:
+hidden host syncs, Python side effects, data-dependent control flow,
+signature-cache churn, trace-time RNG. Relay/TVM showed these checks
+compose as independent passes over an IR; here the IR is the Python AST
+and the passes are registered rules:
+
+====== ============================ ======== =========================
+code   name                         severity what it catches
+====== ============================ ======== =========================
+TPU001 host-sync-under-trace        error    .asnumpy()/.item()/float()/
+                                             np.* on traced values
+TPU002 side-effect-under-trace      warning  print, self.*/global/closure
+                                             mutation, tracer leaks
+TPU003 data-dependent-control-flow  error    if/while/assert/early-return
+                                             on array values
+TPU004 retrace-hazard               warning  loop-varying scalars & dict/
+                                             list literals in hot-loop
+                                             call signatures; unstable
+                                             static_argnums
+TPU005 host-rng-under-trace         error    random.*/np.random.* baked
+                                             in at trace time
+TPU006 thread-shared-state          warning  module-level mutable state
+                                             touched from threads lock-free
+====== ============================ ======== =========================
+
+Use:
+
+* ``mx.analysis.check(block_or_fn)`` → ``list[Finding]`` (file/line, rule
+  code, severity, fix hint);
+* ``python -m mxnet_tpu.analysis mxnet_tpu/ --fail-on=error`` (CI);
+* ``# tpu-lint: disable=TPU001`` suppresses a finding on its line;
+* ``MXNET_TPU_TRACE_GUARD=1`` arms the runtime guard: dynamic host syncs
+  under trace raise `TraceGuardError` (counter
+  ``analysis.guard.host_sync``) and retrace churn past
+  ``MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT`` is surfaced with the
+  changed-signature reason (``analysis.guard.retrace``).
+"""
+from __future__ import annotations
+
+from .findings import Finding, Severity, SEVERITY_ORDER, max_severity
+from .engine import (check, check_source, lint_file, lint_paths,
+                     lint_source)
+from .rules import RULES, LINT_VERSION, rule_table
+from .guard import TraceGuardError, set_mode as set_guard_mode, \
+    mode as guard_mode, active as guard_active
+from . import guard
+
+__all__ = ["Finding", "Severity", "SEVERITY_ORDER", "max_severity",
+           "check", "check_source", "lint_file", "lint_paths",
+           "lint_source", "RULES", "LINT_VERSION", "rule_table",
+           "TraceGuardError", "set_guard_mode", "guard_mode",
+           "guard_active", "guard"]

@@ -1,0 +1,201 @@
+"""tracelint CLI — ``python -m mxnet_tpu.analysis path_or_module ...``.
+
+Text or JSON output, ``--fail-on`` severity gating for CI, rule selection,
+and an optional per-file mtime cache so the tier-1 self-check re-lints only
+files that changed (tools/run_tracelint.sh).
+
+Exit codes: 0 clean (below the fail-on bar), 1 findings at/above the bar,
+2 usage or input error.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+
+from .engine import lint_paths
+from .findings import Finding, SEVERITY_ORDER, Severity
+from .rules import LINT_VERSION, RULES, rule_table
+
+__all__ = ["main", "FileCache"]
+
+# uid-scoped so the CI gate never trusts (or fights over) another local
+# user's cache file in the shared tempdir
+_CACHE_DEFAULT = os.path.join(
+    tempfile.gettempdir(),
+    "mxnet_tpu_tracelint_cache_%s.json"
+    % getattr(os, "getuid", lambda: "u")())
+
+
+class FileCache:
+    """Per-file findings cache keyed by (mtime, size, lint version, rule
+    selection). A malformed or version-skewed cache file is ignored."""
+
+    def __init__(self, path):
+        self.path = path
+        self._files = {}
+        self._dirty = False
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("version") == LINT_VERSION:
+                self._files = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    @staticmethod
+    def _rules_key(rules):
+        return ",".join(rules) if rules else "*"
+
+    def get(self, fname, rules):
+        entry = self._files.get(os.path.abspath(fname))
+        if not entry:
+            return None
+        try:
+            st = os.stat(fname)
+        except OSError:
+            return None
+        if entry.get("mtime") != st.st_mtime or \
+                entry.get("size") != st.st_size or \
+                entry.get("rules") != self._rules_key(rules):
+            return None
+        return [Finding.from_dict(d) for d in entry.get("findings", [])]
+
+    def put(self, fname, rules, findings):
+        try:
+            st = os.stat(fname)
+        except OSError:
+            return
+        self._files[os.path.abspath(fname)] = {
+            "mtime": st.st_mtime, "size": st.st_size,
+            "rules": self._rules_key(rules),
+            "findings": [f.to_dict() for f in findings]}
+        self._dirty = True
+
+    def save(self):
+        if not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": LINT_VERSION, "files": self._files},
+                          f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+
+def _resolve_target(target):
+    """A filesystem path, or an importable module/package name."""
+    if os.path.exists(target):
+        return target
+    try:
+        spec = importlib.util.find_spec(target)
+    except (ImportError, ValueError, ModuleNotFoundError):
+        spec = None
+    if spec is not None:
+        if spec.submodule_search_locations:
+            return list(spec.submodule_search_locations)[0]
+        if spec.origin and os.path.exists(spec.origin):
+            return spec.origin
+    return None
+
+
+def _severity_counts(findings):
+    counts = {Severity.ERROR: 0, Severity.WARNING: 0, Severity.INFO: 0}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="tracelint: trace-safety & concurrency linter for "
+                    "hybridized mxnet_tpu code")
+    parser.add_argument("targets", nargs="*",
+                        help="files, directories, or importable module "
+                             "names (e.g. mxnet_tpu/ or mxnet_tpu.gluon)")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text")
+    parser.add_argument("--fail-on",
+                        choices=["error", "warning", "info", "never"],
+                        default="error",
+                        help="exit 1 when findings at/above this severity "
+                             "exist (default: error)")
+    parser.add_argument("--rules",
+                        help="comma-separated rule codes to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--cache", action="store_true",
+                        help="enable the per-file mtime cache")
+    parser.add_argument("--cache-file", default=None,
+                        help="cache path (implies --cache); default %s"
+                             % _CACHE_DEFAULT)
+    return parser
+
+
+def main(argv=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, name, severity, scope, desc in rule_table():
+            print("%s  %-28s %-8s %-7s %s"
+                  % (code, name, severity, scope,
+                     " ".join(desc.split())))
+        return 0
+
+    if not args.targets:
+        parser.print_usage(sys.stderr)
+        print("error: no targets given", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        rules = [c.strip() for c in args.rules.split(",") if c.strip()]
+        unknown = [c for c in rules if c not in RULES]
+        if unknown:
+            print("error: unknown rule(s) %s (see --list-rules)"
+                  % ", ".join(unknown), file=sys.stderr)
+            return 2
+
+    paths = []
+    for target in args.targets:
+        resolved = _resolve_target(target)
+        if resolved is None:
+            print("error: %r is neither a path nor an importable module"
+                  % target, file=sys.stderr)
+            return 2
+        paths.append(resolved)
+
+    cache = None
+    if args.cache or args.cache_file:
+        cache = FileCache(args.cache_file or _CACHE_DEFAULT)
+
+    findings = lint_paths(paths, rules=rules, cache=cache)
+    if cache is not None:
+        cache.save()
+
+    counts = _severity_counts(findings)
+    if args.format == "json":
+        print(json.dumps({
+            "version": LINT_VERSION,
+            "counts": counts,
+            "findings": [f.to_dict() for f in findings]}, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print("tracelint: %d error(s), %d warning(s), %d info(s)"
+              % (counts[Severity.ERROR], counts[Severity.WARNING],
+                 counts[Severity.INFO]))
+
+    if args.fail_on != "never":
+        bar = SEVERITY_ORDER[args.fail_on]
+        if any(SEVERITY_ORDER.get(f.severity, 0) >= bar for f in findings):
+            return 1
+    return 0

@@ -1,0 +1,432 @@
+"""tracelint engine: source → traced regions → rule passes → findings.
+
+Traced regions (where `traced`-scope rules run, with taint seeded at the
+array parameters):
+
+* ``hybrid_forward(self, F, ...)`` methods — params after ``F`` are traced;
+* ``forward`` methods of classes that look HybridBlock-derived (a base name
+  ending in ``HybridBlock``/``HybridSequential`` or a sibling
+  ``hybrid_forward``) — the hybridized path traces the same body;
+* functions decorated with ``jax.jit`` / ``pmap`` (including
+  ``@partial(jax.jit, ...)``), minus literal ``static_argnums``/
+  ``static_argnames`` params;
+* functions wrapped later in the same file: ``step = jax.jit(step_fn)``
+  marks ``step_fn``.
+
+Suppression: ``# tpu-lint: disable=TPU001[,TPU002]`` (or bare ``disable``
+for all rules) on the finding's line — or on a comment-only line directly
+above it; ``# tpu-lint: disable-file=TPU004`` anywhere suppresses for the
+whole file. Suppressions are part of the contract: every suppression in
+`mxnet_tpu/` itself must carry a justification comment.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding, Severity
+from .rules import RULES, dotted
+from .taint import TaintTracker
+
+__all__ = ["ModuleInfo", "TracedFn", "lint_source", "lint_file",
+           "lint_paths", "check", "check_source", "iter_py_files"]
+
+_HYBRID_BASES = ("HybridBlock", "HybridSequential", "HybridLambda",
+                 "HybridConcurrent")
+_JIT_NAMES = ("jit", "pmap")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*(disable(?:-file)?)\s*(?:=\s*([A-Z0-9,\s]+))?")
+
+
+class TracedFn:
+    """One traced function plus its taint state."""
+
+    __slots__ = ("node", "qualname", "taint")
+
+    def __init__(self, node, qualname, tainted_params):
+        self.node = node
+        self.qualname = qualname
+        self.taint = TaintTracker(node, tainted_params)
+
+
+class ModuleInfo:
+    """Parsed file + import aliases + suppression map + traced regions."""
+
+    def __init__(self, source, filename="<string>"):
+        self.filename = filename
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=filename)
+        self.np_aliases = set()      # numpy module aliases (np, _np, ...)
+        self.np_names = set()        # from numpy import asarray, ...
+        self.np_random_aliases = set()  # numpy.random module aliases
+        self.np_random_names = set()    # from numpy.random import uniform
+        self.random_aliases = set()  # stdlib random module aliases
+        self.random_names = set()    # from random import randint, ...
+        self._collect_imports()
+        self.all_functions = [n for n in ast.walk(self.tree)
+                              if isinstance(n, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))]
+        self.jit_wrapped_names = self._jit_wrapped_names()
+        self.traced = self._find_traced()
+        self.line_suppress, self.file_suppress = self._collect_suppressions()
+
+    # ------------------------------------------------------------- helpers
+    def source_line(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    top = alias.name.split(".")[0]
+                    if alias.name.startswith("numpy.random") and \
+                            alias.asname:
+                        # import numpy.random as npr → npr.uniform()
+                        self.np_random_aliases.add(alias.asname)
+                    elif top == "numpy":
+                        # plain `import numpy.random` binds `numpy`
+                        self.np_aliases.add(alias.asname or top)
+                    elif top == "random":
+                        self.random_aliases.add(alias.asname or top)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "numpy":
+                    for alias in node.names:
+                        if alias.name == "random":
+                            # from numpy import random as r → r.uniform()
+                            self.np_random_aliases.add(
+                                alias.asname or "random")
+                        else:
+                            self.np_names.add(alias.asname or alias.name)
+                elif mod.startswith("numpy.random"):
+                    for alias in node.names:
+                        # from numpy.random import uniform → uniform()
+                        self.np_random_names.add(alias.asname or alias.name)
+                elif mod == "random":
+                    for alias in node.names:
+                        self.random_names.add(alias.asname or alias.name)
+
+    # ----------------------------------------------------- traced discovery
+    def _find_traced(self):
+        traced = []
+        jit_wrapped = self.jit_wrapped_names
+
+        def visit(node, qual, cls_hybrid):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    hybrid = self._class_is_hybrid(child)
+                    visit(child, qual + child.name + ".", hybrid)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qn = qual + child.name
+                    tainted = self._traced_params(child, cls_hybrid,
+                                                  jit_wrapped)
+                    if tainted is not None:
+                        traced.append(TracedFn(child, qn, tainted))
+                    visit(child, qn + ".", False)
+
+        visit(self.tree, "", False)
+        return traced
+
+    @staticmethod
+    def _class_is_hybrid(cls):
+        for base in cls.bases:
+            chain = dotted(base)
+            if chain and any(chain[-1].startswith(h) or chain[-1] == h
+                             for h in _HYBRID_BASES):
+                return True
+        return any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and n.name == "hybrid_forward" for n in cls.body)
+
+    def _jit_wrapped_names(self):
+        """Function names passed positionally to jax.jit/pmap in this file
+        (``step = jax.jit(step_fn)`` / ``return jax.jit(run, ...)``)."""
+        names = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if chain and chain[-1] in _JIT_NAMES and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+        return names
+
+    def _traced_params(self, func, cls_hybrid, jit_wrapped):
+        """Tainted param names when `func` is a traced region, else None."""
+        args = func.args
+        all_params = [a.arg for a in args.posonlyargs + args.args]
+        static = self._decorator_static(func)
+        if static is None and func.name not in jit_wrapped and \
+                not (func.name == "hybrid_forward" or
+                     (func.name == "forward" and cls_hybrid)):
+            return None
+        tainted = []
+        skip = 0
+        if all_params[:1] == ["self"]:
+            skip = 1
+        if func.name == "hybrid_forward" and len(all_params) > 1:
+            skip = 2  # self, F
+        for i, name in enumerate(all_params[skip:], start=skip):
+            if static and (i in static or name in static):
+                continue
+            tainted.append(name)
+        for extra in (args.vararg, args.kwarg):
+            if extra is not None:
+                tainted.append(extra.arg)
+        tainted.extend(a.arg for a in args.kwonlyargs
+                       if not (static and a.arg in static))
+        return tainted
+
+    @staticmethod
+    def _decorator_static(func):
+        """set of static positions/names when func has a jit-ish decorator;
+        empty set for a plain @jax.jit; None when not jit-decorated."""
+        for dec in func.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            chain = dotted(target) or []
+            if not chain:
+                continue
+            if chain[-1] in _JIT_NAMES:
+                return ModuleInfo._static_from_call(dec)
+            if chain[-1] == "partial" and isinstance(dec, ast.Call) and \
+                    dec.args:
+                inner = dotted(dec.args[0]) or []
+                if inner and inner[-1] in _JIT_NAMES:
+                    return ModuleInfo._static_from_call(dec)
+        return None
+
+    @staticmethod
+    def _static_from_call(dec):
+        static = set()
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    vals = kw.value.elts if isinstance(
+                        kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                    for v in vals:
+                        if isinstance(v, ast.Constant):
+                            static.add(v.value)
+        return static
+
+    # --------------------------------------------------------- suppressions
+    def _collect_suppressions(self):
+        """Scan real COMMENT tokens only — a `# tpu-lint: ...` inside a
+        string literal (e.g. lint-test fixture sources) must not
+        suppress anything."""
+        import io
+        import tokenize
+
+        line_sup = {}
+        file_sup = set()
+        comment_only = set()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return line_sup, file_sup
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            codes = None
+            if m.group(2):
+                codes = {c.strip() for c in m.group(2).split(",")
+                         if c.strip()}
+            if m.group(1) == "disable-file":
+                file_sup |= codes if codes else {"*"}
+                continue
+            i = tok.start[0]
+            line_sup.setdefault(i, set())
+            line_sup[i] |= codes if codes else {"*"}
+            if self.lines[i - 1][:tok.start[1]].strip() == "":
+                comment_only.add(i)
+        # a comment-only suppression line covers the next code line
+        for i in sorted(comment_only):
+            line_sup.setdefault(i + 1, set())
+            line_sup[i + 1] |= line_sup[i]
+        return line_sup, file_sup
+
+    def is_suppressed(self, finding):
+        if "*" in self.file_suppress or finding.code in self.file_suppress:
+            return True
+        codes = self.line_suppress.get(finding.line)
+        return bool(codes) and ("*" in codes or finding.code in codes)
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+def _selected_rules(rules):
+    if rules is None:
+        return list(RULES.values())
+    out = []
+    for code in rules:
+        if code not in RULES:
+            raise ValueError("unknown tracelint rule %r (known: %s)"
+                             % (code, ", ".join(sorted(RULES))))
+        out.append(RULES[code])
+    return out
+
+
+def lint_source(source, filename="<string>", rules=None,
+                keep_suppressed=False):
+    """Lint python source text; returns a list of `Finding`."""
+    try:
+        mod = ModuleInfo(source, filename)
+    except SyntaxError as e:
+        return [Finding("TPU000", Severity.ERROR,
+                        "syntax error: %s" % e.msg, file=filename,
+                        line=e.lineno or 0, col=e.offset or 0)]
+    findings = []
+    for rule in _selected_rules(rules):
+        if rule.scope == "traced":
+            for fn in mod.traced:
+                findings.extend(rule.check_function(fn, mod))
+        else:
+            findings.extend(rule.check_module(mod))
+    if not keep_suppressed:
+        findings = [f for f in findings if not mod.is_suppressed(f)]
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
+    return findings
+
+
+def lint_file(path, rules=None):
+    with open(path, encoding="utf-8", errors="replace") as f:
+        source = f.read()
+    return lint_source(source, filename=path, rules=rules)
+
+
+def iter_py_files(path):
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git", "build",
+                                      ".pytest_cache"))
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+
+
+def lint_paths(paths, rules=None, cache=None):
+    """Lint files/directories. `cache` is an optional `FileCache` — per-file
+    results keyed by (mtime, size, LINT_VERSION, rule selection)."""
+    findings = []
+    for path in paths:
+        for fname in iter_py_files(path):
+            if cache is not None:
+                cached = cache.get(fname, rules)
+                if cached is not None:
+                    findings.extend(cached)
+                    continue
+            got = lint_file(fname, rules=rules)
+            if cache is not None:
+                cache.put(fname, rules, got)
+            findings.extend(got)
+    return findings
+
+
+def check_source(source, filename="<string>", rules=None):
+    """Alias of lint_source — the fixture-facing name."""
+    return lint_source(source, filename=filename, rules=rules)
+
+
+def check(obj, rules=None):
+    """Programmatic API: lint a HybridBlock (instance or class), a function
+    (e.g. a jitted train step), a module object, or a path string.
+    Returns list[Finding].
+
+    For live objects the *whole defining file* is parsed (so imports and
+    class bases resolve), then findings are restricted to the object's
+    source span. Functions passed directly are always treated as traced —
+    `check(fn)` asks "is this body safe to jit?".
+    """
+    import inspect
+    import types
+
+    if isinstance(obj, str):
+        return lint_paths([obj], rules=rules)
+    if isinstance(obj, types.ModuleType):
+        path = getattr(obj, "__file__", None)
+        if path is None:
+            raise ValueError("module %r has no source file" % obj)
+        if os.path.basename(path) == "__init__.py":
+            return lint_paths([os.path.dirname(path)], rules=rules)
+        return lint_file(path, rules=rules)
+
+    if isinstance(obj, (types.FunctionType, types.MethodType)):
+        target = inspect.unwrap(obj)
+    elif isinstance(obj, type):
+        target = obj
+    else:
+        # an instance: a jit/partial wrapper exposes the wrapped function;
+        # anything else (HybridBlock instances are callable!) lints as
+        # its class
+        wrapped = getattr(obj, "__wrapped__", None)
+        target = inspect.unwrap(wrapped) if wrapped is not None \
+            else type(obj)
+    try:
+        src_lines, start = inspect.getsourcelines(target)
+        path = inspect.getsourcefile(target)
+    except (OSError, TypeError) as e:
+        raise ValueError(
+            "cannot retrieve source for %r (%s); pass source text to "
+            "mx.analysis.check_source instead" % (obj, e))
+    end = start + len(src_lines) - 1
+
+    if path and os.path.exists(path):
+        with open(path, encoding="utf-8", errors="replace") as f:
+            file_src = f.read()
+        findings = _lint_object_span(file_src, path, start, end, target,
+                                     rules)
+    else:  # dynamically created source (exec'd fixtures)
+        src = "".join(src_lines)
+        findings = lint_source(src, filename=path or "<dynamic>",
+                               rules=rules)
+    return findings
+
+
+def _lint_object_span(file_src, path, start, end, target, rules):
+    import inspect
+    mod = ModuleInfo(file_src, path)
+    # a plain function passed to check() is traced by definition, even
+    # without a jit decorator — inject it if discovery didn't
+    if inspect.isfunction(target):
+        covered = any(start <= fn.node.lineno <= end for fn in mod.traced)
+        if not covered:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node.name == target.__name__ and \
+                        start <= node.lineno <= end:
+                    args = node.args
+                    params = [a.arg for a in args.posonlyargs + args.args
+                              if a.arg not in ("self", "F")]
+                    params += [a.arg for a in args.kwonlyargs]
+                    for extra in (args.vararg, args.kwarg):
+                        if extra is not None:
+                            params.append(extra.arg)
+                    mod.traced.append(TracedFn(node, target.__name__,
+                                               params))
+                    break
+    findings = []
+    for rule in _selected_rules(rules):
+        if rule.scope == "traced":
+            for fn in mod.traced:
+                if start <= fn.node.lineno <= end:
+                    findings.extend(rule.check_function(fn, mod))
+        else:
+            findings.extend(
+                f for f in rule.check_module(mod)
+                if start <= f.line <= end)
+    findings = [f for f in findings if not mod.is_suppressed(f)]
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
+    return findings

@@ -1,0 +1,78 @@
+"""Finding/severity model for tracelint (mx.analysis).
+
+A `Finding` is one diagnosed hazard: rule code (TPU0xx), severity, location
+(file/line/col), the offending source line, a message, and a fix hint. The
+model is deliberately plain-dict-serializable so the CLI JSON mode, the
+per-file mtime cache, and `tools/parse_log.py --lint` all speak the same
+shape without import coupling.
+"""
+from __future__ import annotations
+
+__all__ = ["Severity", "Finding", "SEVERITY_ORDER", "max_severity"]
+
+
+class Severity:
+    """String severity levels with a comparison helper."""
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+SEVERITY_ORDER = {Severity.INFO: 0, Severity.WARNING: 1, Severity.ERROR: 2}
+
+
+def max_severity(findings):
+    """Highest severity present in `findings` (None when empty)."""
+    best = None
+    for f in findings:
+        if best is None or SEVERITY_ORDER.get(f.severity, 0) > \
+                SEVERITY_ORDER.get(best, -1):
+            best = f.severity
+    return best
+
+
+class Finding:
+    """One tracelint diagnostic."""
+
+    __slots__ = ("code", "severity", "message", "hint", "file", "line",
+                 "col", "symbol", "source")
+
+    def __init__(self, code, severity, message, hint="", file="<unknown>",
+                 line=0, col=0, symbol="", source=""):
+        self.code = code            # rule code, e.g. "TPU001"
+        self.severity = severity    # Severity.*
+        self.message = message
+        self.hint = hint            # how to fix
+        self.file = file
+        self.line = line            # 1-based
+        self.col = col              # 0-based
+        self.symbol = symbol        # enclosing function/class, "" for module
+        self.source = source        # offending source line (stripped)
+
+    def to_dict(self):
+        return {"code": self.code, "severity": self.severity,
+                "message": self.message, "hint": self.hint,
+                "file": self.file, "line": self.line, "col": self.col,
+                "symbol": self.symbol, "source": self.source}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d.get("code", "TPU000"), d.get("severity", "warning"),
+                   d.get("message", ""), d.get("hint", ""),
+                   d.get("file", "<unknown>"), d.get("line", 0),
+                   d.get("col", 0), d.get("symbol", ""), d.get("source", ""))
+
+    def format(self):
+        loc = "%s:%d:%d" % (self.file, self.line, self.col)
+        sym = (" [%s]" % self.symbol) if self.symbol else ""
+        out = "%s: %s %s%s: %s" % (loc, self.code, self.severity, sym,
+                                   self.message)
+        if self.hint:
+            out += "\n    hint: %s" % self.hint
+        if self.source:
+            out += "\n    > %s" % self.source
+        return out
+
+    def __repr__(self):
+        return "Finding(%s %s %s:%d %r)" % (
+            self.code, self.severity, self.file, self.line, self.message)

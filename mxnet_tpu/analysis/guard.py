@@ -1,0 +1,136 @@
+"""Runtime trace guard — catches at run time what the AST can't prove.
+
+Static tracelint sees the source; it cannot see a host sync hidden behind
+a dynamic dispatch, a helper defined in another package, or retrace churn
+caused by caller behavior. The guard closes that gap:
+
+* **host-sync guard** — `NDArray.asnumpy()` / `wait_to_read()` (and the
+  `item`/`float`/`bool` paths that funnel through them) check whether the
+  payload is a `jax` tracer. Inside a CachedOp/jit trace that means the
+  caller is forcing a host value that does not exist yet — the guard
+  increments ``analysis.guard.host_sync`` and raises a structured
+  `TraceGuardError` naming the offending API *before* jax produces its
+  generic concretization error.
+* **retrace guard** — `CachedOp` reports every retrace here with the
+  changed-signature reason (see gluon/block.py); past
+  ``MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT`` distinct signatures the guard
+  warns (or raises) with that reason, and always counts
+  ``analysis.guard.retrace``.
+
+Modes (``MXNET_TPU_TRACE_GUARD``): unset/``0`` = off, ``1``/``raise``/
+``error`` = raise `TraceGuardError`, ``warn`` = warn once per site and
+continue (jax will still hard-error on true concretizations). The
+disabled fast path is a single module-bool check (`ACTIVE`), mirroring
+telemetry's gate.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+from ..base import MXNetError
+
+__all__ = ["TraceGuardError", "mode", "set_mode", "active", "host_sync",
+           "on_retrace", "retrace_limit"]
+
+_MODE_OFF = "off"
+_MODE_WARN = "warn"
+_MODE_RAISE = "raise"
+
+
+class TraceGuardError(MXNetError):
+    """A trace-safety violation caught at run time by the trace guard."""
+
+    def __init__(self, message, kind=None, site=None):
+        super().__init__(message)
+        self.kind = kind   # 'host_sync' | 'retrace'
+        self.site = site   # offending API / block name
+
+
+def _parse_mode(raw):
+    raw = str(raw).strip().lower()
+    if raw in ("", "0", "false", "off", "no", "none"):
+        return _MODE_OFF
+    if raw == "warn":
+        return _MODE_WARN
+    return _MODE_RAISE  # "1", "raise", "error", anything affirmative
+
+
+_mode = _parse_mode(os.environ.get("MXNET_TPU_TRACE_GUARD", ""))
+# hot-path gate: instrumented code checks this single bool
+ACTIVE = _mode != _MODE_OFF
+
+_warned_sites = set()
+
+
+def mode():
+    return _mode
+
+
+def active():
+    return ACTIVE
+
+
+def set_mode(value):
+    """'off' | 'warn' | 'raise' (or truthy/falsy strings as the env var
+    accepts — same parser). Returns the previous mode — tests restore
+    with it."""
+    global _mode, ACTIVE
+    prev = _mode
+    _mode = _parse_mode(value)
+    ACTIVE = _mode != _MODE_OFF
+    _warned_sites.clear()
+    return prev
+
+
+def retrace_limit():
+    try:
+        return int(os.environ.get("MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT",
+                                  "8"))
+    except ValueError:
+        return 8
+
+
+def _emit(counter, site, message, kind=None):
+    """Count, then raise or warn-once per (counter, site) by mode.
+    `counter` names the telemetry counter family; `kind` is the
+    TraceGuardError.kind when it differs (retrace_limit → 'retrace')."""
+    from .. import telemetry as _telem
+    _telem.inc("analysis.guard.%s" % counter)
+    _telem.inc("analysis.guard.%s.%s" % (counter, site))
+    if _mode == _MODE_RAISE:
+        raise TraceGuardError(message, kind=kind or counter, site=site)
+    key = (counter, site)
+    if key not in _warned_sites:
+        _warned_sites.add(key)
+        warnings.warn(message, RuntimeWarning, stacklevel=4)
+
+
+def host_sync(site):
+    """Called from NDArray sync points when the payload is a tracer.
+    `site` is the mxnet-level API name ('asnumpy', 'wait_to_read')."""
+    _emit(
+        "host_sync", site,
+        "trace guard: NDArray.%s() called on a traced value inside a "
+        "jit/CachedOp trace — the concrete value does not exist at trace "
+        "time. Keep the computation on-device (mx.nd/F ops) or move the "
+        "host read outside the hybridized body. (tracelint rule TPU001; "
+        "MXNET_TPU_TRACE_GUARD=0 disables this guard)" % site)
+
+
+def on_retrace(name, n_signatures, reason):
+    """Called from CachedOp telemetry on every retrace. Counts always;
+    warns/raises once past the distinct-signature limit."""
+    from .. import telemetry as _telem
+    _telem.inc("analysis.guard.retrace")
+    limit = retrace_limit()
+    if n_signatures <= limit:
+        return
+    _emit(
+        "retrace_limit", name,
+        "trace guard: CachedOp %r retraced %d times (limit %d) — the call "
+        "signature keeps changing: %s. Stabilize shapes/dtypes and pass "
+        "loop-varying Python scalars as arrays (tracelint rule TPU004). "
+        "(MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT raises the limit)"
+        % (name, n_signatures, limit, reason or "unknown"),
+        kind="retrace")

@@ -1,0 +1,726 @@
+"""tracelint rules — independent, registered trace-safety passes.
+
+Each rule is a class with a stable code (``TPU0xx``), a default severity,
+and a scope:
+
+* ``traced`` rules run once per *traced function* (a ``hybrid_forward`` /
+  hybridized ``forward`` body, a ``jax.jit``-decorated function, or a
+  function handed to `mx.analysis.check`) with a `TaintTracker` seeded at
+  the array parameters;
+* ``module`` rules run once per file (retrace-hazard and concurrency
+  passes look at loops, decorators, and thread wiring anywhere).
+
+The registry mirrors TVM's pass infrastructure in spirit: rules are
+independent, individually selectable (CLI ``--rules``), and suppressible
+per-line (``# tpu-lint: disable=TPU001``). Adding a rule is registering a
+class — nothing else changes.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding, Severity
+from .taint import TaintTracker, UNTAINTED_CALLS
+
+__all__ = ["RULES", "register", "Rule", "rule_table", "LINT_VERSION"]
+
+# bump when rule logic changes — invalidates the per-file mtime cache
+LINT_VERSION = 6
+
+RULES = {}
+
+
+def register(cls):
+    inst = cls()
+    RULES[inst.code] = inst
+    return cls
+
+
+def rule_table():
+    """[(code, name, severity, scope, description)] for docs/CLI."""
+    return [(r.code, r.name, r.severity, r.scope, r.description)
+            for r in (RULES[c] for c in sorted(RULES))]
+
+
+def dotted(node):
+    """['jax', 'jit'] for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+class Rule:
+    code = "TPU000"
+    name = "base"
+    severity = Severity.WARNING
+    scope = "traced"          # 'traced' | 'module'
+    description = ""
+    hint = ""
+
+    def check_function(self, fn, mod):
+        """Yield findings for one traced function (scope == 'traced')."""
+        return iter(())
+
+    def check_module(self, mod):
+        """Yield findings for a whole file (scope == 'module')."""
+        return iter(())
+
+    def _finding(self, mod, node, message, hint=None, severity=None,
+                 symbol=""):
+        line = getattr(node, "lineno", 0)
+        src = mod.source_line(line)
+        return Finding(self.code, severity or self.severity, message,
+                       hint if hint is not None else self.hint,
+                       file=mod.filename, line=line,
+                       col=getattr(node, "col_offset", 0), symbol=symbol,
+                       source=src)
+
+
+# --------------------------------------------------------------------------
+# TPU001 — host syncs under trace
+# --------------------------------------------------------------------------
+_SYNC_METHODS = {
+    "asnumpy": "blocking device→host copy",
+    "asscalar": "blocking device→host copy",
+    "item": "blocking device→host copy",
+    "tolist": "blocking device→host copy",
+    "wait_to_read": "host-side barrier",
+    "wait_to_write": "host-side barrier",
+}
+_SYNC_BUILTINS = ("float", "int", "bool", "complex")
+
+
+@register
+class HostSyncUnderTrace(Rule):
+    code = "TPU001"
+    name = "host-sync-under-trace"
+    severity = Severity.ERROR
+    scope = "traced"
+    description = ("`.asnumpy()`/`.item()`/`float()`/`np.*` on a traced "
+                   "value forces the value to the host; under `jit` tracing "
+                   "there IS no value yet — this either aborts the trace or "
+                   "bakes a stale constant in.")
+    hint = ("keep the computation on-device with F.*/mx.nd ops; move host "
+            "reads outside the hybridized body")
+
+    def check_function(self, fn, mod):
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in _SYNC_METHODS and \
+                        fn.taint.is_tainted(func.value):
+                    yield self._finding(
+                        mod, node,
+                        ".%s() on a traced value is a %s under trace"
+                        % (func.attr, _SYNC_METHODS[func.attr]),
+                        symbol=fn.qualname)
+                    continue
+                chain = dotted(func)
+                if chain and chain[0] in mod.np_aliases:
+                    # np.random.* is TPU005's finding, not a sync
+                    if len(chain) > 1 and chain[1] == "random":
+                        continue
+                    if self._any_tainted(fn, node):
+                        yield self._finding(
+                            mod, node,
+                            "host numpy call %s() on a traced value pulls "
+                            "it off-device" % ".".join(chain),
+                            hint="use the F/mx.nd equivalent so the op "
+                                 "stays in the traced graph",
+                            symbol=fn.qualname)
+                    continue
+                if chain and chain[:2] == ["jax", "device_get"] and \
+                        self._any_tainted(fn, node):
+                    yield self._finding(
+                        mod, node,
+                        "jax.device_get() on a traced value under trace",
+                        symbol=fn.qualname)
+            elif isinstance(func, ast.Name):
+                if func.id in _SYNC_BUILTINS and len(node.args) == 1 and \
+                        fn.taint.is_tainted(node.args[0]):
+                    yield self._finding(
+                        mod, node,
+                        "%s() on a traced value concretizes it on the host"
+                        % func.id,
+                        hint="compare/convert on-device (F ops, astype); "
+                             "branch with F.where instead of bool()",
+                        symbol=fn.qualname)
+                elif func.id in mod.np_names and self._any_tainted(fn, node):
+                    yield self._finding(
+                        mod, node,
+                        "host numpy call %s() on a traced value" % func.id,
+                        symbol=fn.qualname)
+
+    @staticmethod
+    def _any_tainted(fn, call):
+        return any(fn.taint.is_tainted(a) for a in call.args) or \
+            any(fn.taint.is_tainted(kw.value) for kw in call.keywords)
+
+
+# --------------------------------------------------------------------------
+# TPU002 — Python side effects under trace
+# --------------------------------------------------------------------------
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "appendleft"}
+
+
+@register
+class SideEffectUnderTrace(Rule):
+    code = "TPU002"
+    name = "side-effect-under-trace"
+    severity = Severity.WARNING
+    scope = "traced"
+    description = ("`print`, `self.*` mutation, and global/closure writes "
+                   "inside a traced body run ONCE at trace time, then never "
+                   "again; tracer objects leaked into outer state outlive "
+                   "the trace and poison later code.")
+    hint = ("return values instead of mutating state; use "
+            "record_aux_update for moving statistics and jax.debug.print "
+            "for in-trace printing")
+
+    def check_function(self, fn, mod):
+        local_names = self._local_names(fn.node)
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                yield self._finding(
+                    mod, node,
+                    "print() under trace fires once at trace time, not "
+                    "per call",
+                    hint="use jax.debug.print for per-call printing",
+                    symbol=fn.qualname)
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        yield self._finding(
+                            mod, node,
+                            "assignment to self.%s under trace happens at "
+                            "trace time only (and leaks a tracer if the "
+                            "value is traced)" % t.attr,
+                            symbol=fn.qualname)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                yield self._finding(
+                    mod, node,
+                    "%s declaration inside a traced body — rebinding outer "
+                    "state under trace runs once at trace time"
+                    % ("global" if isinstance(node, ast.Global)
+                       else "nonlocal"),
+                    symbol=fn.qualname)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                base = node.func.value
+                leaked = any(fn.taint.is_tainted(a) for a in node.args) or \
+                    any(fn.taint.is_tainted(kw.value)
+                        for kw in node.keywords)
+                if not leaked:
+                    continue
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    yield self._finding(
+                        mod, node,
+                        "self.%s.%s(traced value) leaks a tracer into "
+                        "block state" % (base.attr, node.func.attr),
+                        symbol=fn.qualname)
+                elif isinstance(base, ast.Name) and \
+                        base.id not in local_names:
+                    yield self._finding(
+                        mod, node,
+                        "%s.%s(traced value) mutates closure/global state "
+                        "with a tracer" % (base.id, node.func.attr),
+                        symbol=fn.qualname)
+
+    @staticmethod
+    def _local_names(func):
+        names = {a.arg for a in func.args.args + func.args.kwonlyargs +
+                 func.args.posonlyargs}
+        if func.args.vararg:
+            names.add(func.args.vararg.arg)
+        if func.args.kwarg:
+            names.add(func.args.kwarg.arg)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    names.update(_target_names(t))
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                names.update(_target_names(node.target))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                names.update(_target_names(node.target))
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        names.update(_target_names(item.optional_vars))
+            elif isinstance(node, ast.NamedExpr):
+                names.update(_target_names(node.target))
+            elif isinstance(node, ast.comprehension):
+                names.update(_target_names(node.target))
+        return names
+
+
+def _target_names(t):
+    if isinstance(t, ast.Name):
+        return {t.id}
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = set()
+        for e in t.elts:
+            out |= _target_names(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return set()
+
+
+# --------------------------------------------------------------------------
+# TPU003 — data-dependent control flow
+# --------------------------------------------------------------------------
+@register
+class DataDependentControlFlow(Rule):
+    code = "TPU003"
+    name = "data-dependent-control-flow"
+    severity = Severity.ERROR
+    scope = "traced"
+    description = ("`if`/`while`/`assert` predicated on a traced value "
+                   "needs the value on the host — illegal under tracing. "
+                   "Branches on `x is None`, shapes, and dtypes are fine "
+                   "(static at trace time).")
+    hint = ("select with F.where/mx.nd.where, or structure the loop with "
+            "mx.nd.contrib.cond / while_loop / foreach "
+            "(ndarray/contrib_flow.py)")
+
+    def check_function(self, fn, mod):
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.If) and fn.taint.is_tainted(node.test):
+                early = any(isinstance(s, ast.Return)
+                            for s in ast.walk(node))
+                yield self._finding(
+                    mod, node,
+                    "if on a traced value%s — the predicate has no host "
+                    "value under trace"
+                    % (" (with early return)" if early else ""),
+                    symbol=fn.qualname)
+            elif isinstance(node, ast.While) and \
+                    fn.taint.is_tainted(node.test):
+                yield self._finding(
+                    mod, node,
+                    "while on a traced value — use "
+                    "mx.nd.contrib.while_loop (lax.while_loop) for "
+                    "on-device loops",
+                    symbol=fn.qualname)
+            elif isinstance(node, ast.IfExp) and \
+                    fn.taint.is_tainted(node.test):
+                yield self._finding(
+                    mod, node,
+                    "conditional expression on a traced value",
+                    hint="F.where(cond, a, b) keeps the select on-device",
+                    symbol=fn.qualname)
+            elif isinstance(node, ast.Assert) and \
+                    fn.taint.is_tainted(node.test):
+                yield self._finding(
+                    mod, node,
+                    "assert on a traced value cannot be evaluated under "
+                    "trace",
+                    hint="validate inputs before the hybridized call, or "
+                         "use jax.experimental.checkify",
+                    symbol=fn.qualname)
+            elif isinstance(node, (ast.For, ast.AsyncFor)) and \
+                    fn.taint.is_tainted(node.iter):
+                yield self._finding(
+                    mod, node,
+                    "Python for-loop over a traced array unrolls the loop "
+                    "into the graph (one copy per element)",
+                    hint="use mx.nd.contrib.foreach / while_loop for "
+                         "on-device iteration",
+                    severity=Severity.WARNING,
+                    symbol=fn.qualname)
+
+
+# --------------------------------------------------------------------------
+# TPU004 — retrace hazards (signature-cache churn)
+# --------------------------------------------------------------------------
+_CALLEE_SKIP = UNTAINTED_CALLS | {
+    "list", "dict", "set", "tuple", "str", "int", "float", "bool", "sorted",
+    "min", "max", "sum", "abs", "round", "divmod", "next", "iter", "map",
+    "filter", "any", "all", "hash", "ord", "chr",
+}
+_METHOD_SKIP = _MUTATORS | {
+    "format", "join", "get", "items", "keys", "values", "split", "strip",
+    "startswith", "endswith", "write", "info", "debug", "warning", "error",
+    "observe", "inc", "set", "record_span", "count", "index", "replace",
+    "encode", "decode", "copy",
+}
+
+
+# callee names that plausibly denote a compiled/hybridized callable —
+# the retrace-hazard pass only fires on these (plus file-local
+# jit-wrapped names), because "python scalar in a call inside a loop"
+# is ubiquitous and harmless in host-side code
+_TRACED_CALLEE_HINTS = (
+    "net", "model", "block", "module", "step", "cell", "layer", "encoder",
+    "decoder", "head", "fn", "func", "forward", "predict", "apply",
+    "backbone", "critic", "actor", "policy",
+)
+
+
+def _looks_traced_callee(callee, jit_names):
+    chain = dotted(callee)
+    if not chain:
+        return False
+    if chain[-1] in jit_names:
+        return True
+    last = chain[-1].lower().strip("_")
+    return any(last == h or last.endswith("_" + h) or last.endswith(h) or
+               last.startswith(h + "_") for h in _TRACED_CALLEE_HINTS)
+
+
+@register
+class RetraceHazard(Rule):
+    code = "TPU004"
+    name = "retrace-hazard"
+    severity = Severity.WARNING
+    scope = "module"
+    description = ("Python scalars/shape material that varies per hot-loop "
+                   "iteration, and dict/list literals in call signatures, "
+                   "defeat the CachedOp/jit signature cache — every new "
+                   "value is a silent recompile. Non-literal or mutable "
+                   "static_argnums material breaks jit hashing outright. "
+                   "Applies to model-like callees (net/model/step/... and "
+                   "jit-wrapped names); the runtime guard catches the "
+                   "rest.")
+    hint = ("pass loop-varying numbers as arrays (mx.nd.array / "
+            "jnp.asarray) so they land in the traced signature as shapes, "
+            "not values; keep static_argnums material literal and hashable")
+
+    def check_module(self, mod):
+        jit_names = mod.jit_wrapped_names
+        for func in mod.all_functions:
+            yield from self._check_loops(func, mod, jit_names)
+        yield from self._check_static_argnums(mod)
+
+    def _check_loops(self, func, mod, jit_names):
+        if func.name == "__init__" or func.name.startswith("_make"):
+            return  # build-time loops (layer stacking) run once, not hot
+        for loop in ast.walk(func):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            loop_scalars = set()
+            if isinstance(loop, ast.For) and \
+                    isinstance(loop.iter, ast.Call):
+                chain = dotted(loop.iter.func) or []
+                if chain and chain[-1] == "range":
+                    loop_scalars = _target_names(loop.target)
+                elif chain and chain[-1] == "enumerate" and \
+                        isinstance(loop.target, ast.Tuple) and \
+                        loop.target.elts:
+                    # only the counter is a python scalar; the yielded
+                    # item is ordinary (array) data
+                    loop_scalars = _target_names(loop.target.elts[0])
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = node.func
+                if isinstance(callee, ast.Name) and \
+                        callee.id in _CALLEE_SKIP:
+                    continue
+                if isinstance(callee, ast.Attribute) and \
+                        callee.attr in _METHOD_SKIP:
+                    continue
+                if not _looks_traced_callee(callee, jit_names):
+                    continue
+                # kw.arg None is **expansion — it lands as plain kwargs,
+                # not as a dict in the signature
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords
+                         if kw.arg is not None]:
+                    if loop_scalars and self._uses_scalar(arg, loop_scalars):
+                        yield self._finding(
+                            mod, node,
+                            "loop-varying Python scalar %r in a call "
+                            "signature inside a hot loop — a new "
+                            "CachedOp/jit signature (and recompile) every "
+                            "iteration"
+                            % "/".join(sorted(
+                                loop_scalars & _names_in(arg))),
+                            symbol=func.name)
+                        break
+                    if isinstance(arg, (ast.Dict, ast.List, ast.Set)):
+                        yield self._finding(
+                            mod, node,
+                            "dict/list literal in a call signature inside "
+                            "a loop — unhashable (for static args) and "
+                            "unstable signature material",
+                            symbol=func.name)
+                        break
+
+    @staticmethod
+    def _uses_scalar(arg, loop_scalars):
+        if isinstance(arg, ast.Name):
+            return arg.id in loop_scalars
+        if isinstance(arg, (ast.BinOp, ast.UnaryOp, ast.IfExp)):
+            return bool(_names_in(arg) & loop_scalars)
+        return False
+
+    def _check_static_argnums(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] not in ("jit", "pmap", "partial"):
+                continue
+            if chain[-1] == "partial":
+                inner = dotted(node.args[0]) if node.args else None
+                if not inner or inner[-1] not in ("jit", "pmap"):
+                    continue
+            for kw in node.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                if not self._is_literal(kw.value):
+                    yield self._finding(
+                        mod, node,
+                        "non-literal %s — computed static-arg selectors "
+                        "make the retrace key unstable and unreviewable"
+                        % kw.arg)
+
+    @staticmethod
+    def _is_literal(node):
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(isinstance(e, ast.Constant) for e in node.elts)
+        return False
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# --------------------------------------------------------------------------
+# TPU005 — host RNG under trace
+# --------------------------------------------------------------------------
+@register
+class HostRngUnderTrace(Rule):
+    code = "TPU005"
+    name = "host-rng-under-trace"
+    severity = Severity.ERROR
+    scope = "traced"
+    description = ("`random.*` / `np.random.*` inside a traced body draws "
+                   "ONE value at trace time and bakes it into the compiled "
+                   "graph as a constant — every subsequent call reuses it "
+                   "(dropout that never changes).")
+    hint = ("use the keyed device RNG: F.random_*/mx.nd.random (ops/"
+            "random_ops.py) — inside CachedOp traces keys are threaded "
+            "per call automatically")
+
+    def check_function(self, fn, mod):
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if not chain:
+                continue
+            if len(chain) == 1:
+                # from random import randint / from numpy.random import x
+                if chain[0] in mod.random_names:
+                    yield self._finding(
+                        mod, node,
+                        "stdlib random call %s() under trace is a "
+                        "trace-time constant" % chain[0],
+                        symbol=fn.qualname)
+                elif chain[0] in mod.np_random_names:
+                    yield self._finding(
+                        mod, node,
+                        "numpy RNG call %s() under trace is a trace-time "
+                        "constant" % chain[0],
+                        symbol=fn.qualname)
+            elif chain[0] in mod.random_aliases:
+                yield self._finding(
+                    mod, node,
+                    "stdlib random call %s() under trace is a trace-time "
+                    "constant" % ".".join(chain),
+                    symbol=fn.qualname)
+            elif chain[0] in mod.np_random_aliases or (
+                    chain[0] in mod.np_aliases and len(chain) >= 3 and
+                    chain[1] == "random"):
+                yield self._finding(
+                    mod, node,
+                    "numpy RNG call %s() under trace is a trace-time "
+                    "constant" % ".".join(chain),
+                    symbol=fn.qualname)
+
+
+# --------------------------------------------------------------------------
+# TPU006 — concurrency: module-level mutable state from threads, no lock
+# --------------------------------------------------------------------------
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter"}
+_LOCKISH_MARKERS = ("lock", "cond", "mutex", "sem", "_mu")
+
+
+def _is_lockish(expr):
+    chain = dotted(expr if not isinstance(expr, ast.Call) else expr.func)
+    if not chain:
+        return False
+    last = chain[-1].lower()
+    return any(m in last for m in _LOCKISH_MARKERS)
+
+
+@register
+class ThreadSharedStateLint(Rule):
+    code = "TPU006"
+    name = "thread-shared-state"
+    severity = Severity.WARNING
+    scope = "module"
+    description = ("module-level mutable state mutated from a "
+                   "thread-reachable function without holding a lock — "
+                   "the runtime's own telemetry/kvstore/watchdog threads "
+                   "must serialize through their registry locks.")
+    hint = ("wrap the mutation in `with <lock>:` (see telemetry.metrics."
+            "Registry) or hand the update to the owning thread")
+
+    def check_module(self, mod):
+        mutables = self._module_mutables(mod.tree)
+        if not mutables:
+            return
+        thread_fns = self._thread_reachable(mod)
+        if not thread_fns:
+            return
+        for func in thread_fns:
+            yield from self._check_mutations(func, mutables, mod)
+
+    @staticmethod
+    def _module_mutables(tree):
+        out = set()
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+            if isinstance(value, ast.Call):
+                chain = dotted(value.func) or []
+                mutable = bool(chain) and chain[-1] in _MUTABLE_CTORS
+            if mutable:
+                for t in targets:
+                    out |= _target_names(t)
+        return out
+
+    @staticmethod
+    def _thread_entries(mod):
+        entries = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                tchain = dotted(kw.value)
+                if tchain:
+                    entries.add(tchain[-1])
+        # Thread subclasses: their run() is the entry
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    (dotted(b) or [""])[-1] == "Thread" for b in node.bases):
+                entries.add("run")
+        return entries
+
+    def _thread_reachable(self, mod):
+        entries = self._thread_entries(mod)
+        if not entries:
+            return []
+        by_name = {}
+        for func in mod.all_functions:
+            by_name.setdefault(func.name, []).append(func)
+        seen = set()
+        work = sorted(entries)
+        for _ in range(3):  # bounded transitive closure
+            nxt = []
+            for name in work:
+                if name in seen or name not in by_name:
+                    continue
+                seen.add(name)
+                for func in by_name[name]:
+                    for node in ast.walk(func):
+                        if isinstance(node, ast.Call):
+                            chain = dotted(node.func)
+                            if chain:
+                                nxt.append(chain[-1])
+            work = nxt
+        out = []
+        for name in seen:
+            out.extend(by_name.get(name, []))
+        return out
+
+    def _check_mutations(self, func, mutables, mod):
+        yield from self._walk_body(func.body, func, mutables, mod,
+                                   under_lock=False)
+
+    def _walk_body(self, body, func, mutables, mod, under_lock):
+        for node in body:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locked = under_lock or any(
+                    _is_lockish(item.context_expr) for item in node.items)
+                yield from self._walk_body(node.body, func, mutables, mod,
+                                           locked)
+                continue
+            if not under_lock:
+                yield from self._check_stmt(node, func, mutables, mod)
+            # recurse into nested bodies preserving lock state
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(node, attr, None)
+                if sub and not isinstance(node, (ast.With, ast.AsyncWith)):
+                    yield from self._walk_body(sub, func, mutables, mod,
+                                               under_lock)
+            for handler in getattr(node, "handlers", []):
+                yield from self._walk_body(handler.body, func, mutables,
+                                           mod, under_lock)
+
+    def _check_stmt(self, node, func, mutables, mod):
+        mutated = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in mutables:
+                    mutated = t.value.id
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in mutables:
+                    mutated = t.value.id
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            callee = node.value.func
+            if isinstance(callee, ast.Attribute) and \
+                    callee.attr in _MUTATORS and \
+                    isinstance(callee.value, ast.Name) and \
+                    callee.value.id in mutables:
+                mutated = callee.value.id
+        if mutated is not None:
+            yield self._finding(
+                mod, node,
+                "module-level mutable %r mutated from thread-reachable "
+                "%s() without holding a lock" % (mutated, func.name),
+                symbol=func.name)

@@ -1,0 +1,171 @@
+"""Taint propagation over a traced function body.
+
+Under `jit` tracing every array argument is a tracer; anything computed from
+a tracer is a tracer. The rules need to know, for an arbitrary expression
+node, "is this a traced value?" — that is exactly a forward taint analysis
+seeded at the function parameters.
+
+Design choices (tuned for lint precision, not soundness):
+
+* **Monotone**: once a name is tainted it stays tainted for the whole
+  function. Rebinding `x = 0` after `x = F.relu(x)` is rare in forward
+  bodies and over-approximation only risks a warning, never a miss.
+* **Static attributes stay host-side**: `x.shape`, `x.dtype`, `x.ndim`,
+  `x.size`, `x.context` of a traced array are Python values fixed at trace
+  time — comparisons/branches on them are trace-safe and must NOT flag.
+* **Identity predicates are host-side**: `x is None` / `isinstance(x, T)`
+  are resolved at trace time regardless of taint.
+* Two propagation passes over the body approximate a fixpoint through
+  loops (a name tainted late in a loop body taints its earlier uses on the
+  second pass).
+"""
+from __future__ import annotations
+
+import ast
+
+__all__ = ["TaintTracker", "STATIC_ATTRS", "UNTAINTED_CALLS"]
+
+# attributes of a traced array whose value is static under trace
+STATIC_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "context", "ctx", "stype", "name",
+    "prefix", "params", "device", "sharding", "aval", "weak_type",
+})
+
+# builtins whose result is a host value independent of arg *values*
+# (len(x) == x.shape[0] is static under trace; type/isinstance are
+# resolved at trace time)
+UNTAINTED_CALLS = frozenset({
+    "isinstance", "issubclass", "hasattr", "callable", "len", "type", "id",
+    "repr", "format", "range", "enumerate", "zip", "getattr", "setattr",
+    "print", "super", "vars", "dir",
+})
+
+# methods on a traced value whose result is a host-side constant under
+# trace (flagged separately as host syncs by TPU001 where applicable)
+_HOST_RESULT_METHODS = frozenset({
+    "asnumpy", "item", "asscalar", "tolist", "astype_scalar",
+})
+
+
+class TaintTracker(ast.NodeVisitor):
+    """Computes the set of tainted names for one function, then answers
+    `is_tainted(expr_node)` queries on demand."""
+
+    def __init__(self, func_node, tainted_params):
+        self.func = func_node
+        self.tainted = set(tainted_params)
+        self._propagate()
+
+    # ------------------------------------------------------------- seeding
+    def _propagate(self):
+        # two passes ≈ fixpoint through loop-carried taint
+        for _ in range(2):
+            for stmt in ast.walk(self.func):
+                self._visit_stmt(stmt)
+
+    def _visit_stmt(self, node):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = node.value
+            if value is None:
+                return
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if self.is_tainted(value) or (
+                    isinstance(node, ast.AugAssign) and
+                    self.is_tainted(node.target)):
+                for t in targets:
+                    self._taint_target(t)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if self.is_tainted(node.iter):
+                self._taint_target(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None and \
+                        self.is_tainted(item.context_expr):
+                    self._taint_target(item.optional_vars)
+        elif isinstance(node, ast.NamedExpr):
+            if self.is_tainted(node.value):
+                self._taint_target(node.target)
+
+    def _taint_target(self, target):
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._taint_target(elt)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+        # Attribute/Subscript targets mutate an existing object; the base
+        # name's taint is unchanged by the write
+
+    # ------------------------------------------------------------- queries
+    def is_tainted(self, node):  # noqa: C901 — one dispatch table
+        """True when `node` evaluates to a traced (tracer-backed) value."""
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_tainted(node)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            # identity predicates are resolved at trace time
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in node.ops):
+                return False
+            return self.is_tainted(node.left) or \
+                any(self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_tainted(v) for v in node.values) or \
+                any(k is not None and self.is_tainted(k) for k in node.keys)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return any(self.is_tainted(g.iter) for g in node.generators) or \
+                self._comp_elt_tainted(node)
+        if isinstance(node, ast.DictComp):
+            return any(self.is_tainted(g.iter) for g in node.generators)
+        if isinstance(node, ast.NamedExpr):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return False  # f-string result is a host str (flagged elsewhere)
+        return False
+
+    def _comp_elt_tainted(self, node):
+        # approximate: the element expression references a tainted name
+        for sub in ast.walk(node.elt):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+        return False
+
+    def _call_tainted(self, node):
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in UNTAINTED_CALLS or func.id in (
+                    "float", "int", "bool", "complex", "str"):
+                # float(x) on a tracer is a host sync — TPU001's problem;
+                # its *result* is a host scalar
+                return False
+        if isinstance(func, ast.Attribute):
+            if func.attr in _HOST_RESULT_METHODS:
+                return False  # already a host value (and a TPU001 finding)
+            if self.is_tainted(func.value):
+                return True   # method on a traced value
+        if any(self.is_tainted(a) for a in node.args):
+            return True
+        return any(self.is_tainted(kw.value) for kw in node.keywords)

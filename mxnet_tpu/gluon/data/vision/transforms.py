@@ -171,22 +171,28 @@ class CropResize(HybridBlock):
         return out
 
 
+def _coin_flip_select(F, x, flipped):
+    """Per-call on-device coin flip between `x` and `flipped`. A Python
+    `random.random()` here would be baked in at trace time under
+    hybridize — every compiled call would flip (or not) identically
+    (tracelint TPU005); the device draw goes through the keyed trace RNG
+    so each call re-flips."""
+    take = (F.uniform(0, 1, shape=(1,)) < 0.5).astype(x.dtype)
+    return flipped * take + x * (1 - take)
+
+
 class RandomFlipLeftRight(HybridBlock):
     """reference: transforms.py (RandomFlipLeftRight)."""
 
     def hybrid_forward(self, F, x):
-        if random.random() < 0.5:
-            return F.reverse(x, axis=x.ndim - 2)
-        return x
+        return _coin_flip_select(F, x, F.reverse(x, axis=x.ndim - 2))
 
 
 class RandomFlipTopBottom(HybridBlock):
     """reference: transforms.py (RandomFlipTopBottom)."""
 
     def hybrid_forward(self, F, x):
-        if random.random() < 0.5:
-            return F.reverse(x, axis=x.ndim - 3)
-        return x
+        return _coin_flip_select(F, x, F.reverse(x, axis=x.ndim - 3))
 
 
 class RandomBrightness(Block):

@@ -92,7 +92,10 @@ class HybridSequential(HybridBlock):
             if isinstance(x, (tuple, list)):
                 args = x[1:]
                 x = x[0]
-        if args:
+        # `args` is always a python list here (rebound from x[1:] only
+        # under the isinstance(tuple/list) guard above) — its truthiness
+        # is a host-side length check, not a traced-value read
+        if args:  # tpu-lint: disable=TPU003
             x = tuple([x] + list(args))
         return x
 

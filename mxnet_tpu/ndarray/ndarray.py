@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from .. import base as _base
 from .. import telemetry as _telem
+from ..analysis import guard as _guard
 from ..base import np_dtype
 from ..context import Context, current_context
 from ..ops import registry as _reg
@@ -157,12 +158,20 @@ class NDArray:
             # the classic hidden stall under async dispatch: every forced
             # device→host copy shows up as a counter
             _telem.inc("ndarray.sync.asnumpy")
-        return _np.asarray(self._read())
+        raw = self._read()
+        if _guard.ACTIVE and _is_tracer(raw):
+            # MXNET_TPU_TRACE_GUARD: a host sync on a traced value has no
+            # value to sync — surface the mxnet-level API (and count it)
+            # before jax's generic concretization error
+            _guard.host_sync("asnumpy")
+        return _np.asarray(raw)
 
     def wait_to_read(self):
         if _telem.ENABLED:
             _telem.inc("ndarray.sync.wait_to_read")
         arr = self._read()
+        if _guard.ACTIVE and _is_tracer(arr):
+            _guard.host_sync("wait_to_read")
         jax.block_until_ready(arr)
         # Some PjRt transports (the axon tunnel, observed 2026-07-30) ack
         # block_until_ready before execution finishes. MXNet's WaitToRead

@@ -1,0 +1,742 @@
+"""mx.analysis (tracelint) tests: per-rule positive/negative fixtures,
+suppression comments, the programmatic check() API, CLI exit codes &
+formats, the runtime trace guard (host-sync + retrace under
+JAX_PLATFORMS=cpu), and the meta-test that mxnet_tpu/ itself is clean at
+error severity."""
+import json
+import logging
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import (Severity, TraceGuardError, check,
+                                check_source, set_guard_mode)
+from mxnet_tpu.analysis.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(src, rules=None):
+    return check_source(textwrap.dedent(src), filename="fixture.py",
+                        rules=rules)
+
+
+def codes(findings):
+    return sorted({f.code for f in findings})
+
+
+def only(findings, code):
+    return [f for f in findings if f.code == code]
+
+
+@pytest.fixture
+def guard_raise():
+    prev = set_guard_mode("raise")
+    yield
+    set_guard_mode(prev)
+
+
+@pytest.fixture
+def guard_warn():
+    prev = set_guard_mode("warn")
+    yield
+    set_guard_mode(prev)
+
+
+def _counter(name):
+    return mx.telemetry.snapshot()["counters"].get(name, 0)
+
+
+# ===========================================================================
+# TPU001 — host syncs under trace
+# ===========================================================================
+def test_tpu001_flags_asnumpy_and_item():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            a = x.asnumpy()
+            b = x.sum().item()
+            return F.relu(x)
+    """)
+    hits = only(f, "TPU001")
+    assert len(hits) == 2
+    assert all(h.severity == Severity.ERROR for h in hits)
+    assert hits[0].line == 4 and hits[1].line == 5
+    assert "hybrid_forward" in hits[0].symbol
+
+
+def test_tpu001_flags_float_and_np_call():
+    f = lint("""
+    import numpy as np
+    class Net:
+        def hybrid_forward(self, F, x):
+            s = float(x.sum())
+            e = np.exp(x)
+            return x * s + e
+    """)
+    assert len(only(f, "TPU001")) == 2
+
+
+def test_tpu001_passes_static_shape_reads():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            n = x.shape[0]
+            d = float(n)
+            return F.reshape(x, (n, -1))
+    """)
+    assert not only(f, "TPU001")
+
+
+def test_tpu001_passes_untraced_function():
+    # a plain function is not a traced region — eager asnumpy is fine
+    f = lint("""
+    def evaluate(net, x):
+        return net(x).asnumpy()
+    """)
+    assert not only(f, "TPU001")
+
+
+def test_tpu001_passes_np_on_host_values():
+    f = lint("""
+    import numpy as np
+    class Net:
+        def hybrid_forward(self, F, x):
+            scale = np.sqrt(2.0)
+            return x * scale
+    """)
+    assert not only(f, "TPU001")
+
+
+# ===========================================================================
+# TPU002 — side effects under trace
+# ===========================================================================
+def test_tpu002_flags_print_and_self_mutation():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            print("forward", x.shape)
+            self.last_input = x
+            return x
+    """)
+    hits = only(f, "TPU002")
+    assert len(hits) == 2
+    assert all(h.severity == Severity.WARNING for h in hits)
+
+
+def test_tpu002_flags_tracer_leak_into_closure():
+    f = lint("""
+    captured = []
+    class Net:
+        def hybrid_forward(self, F, x):
+            y = F.relu(x)
+            captured.append(y)
+            return y
+    """)
+    assert len(only(f, "TPU002")) == 1
+
+
+def test_tpu002_passes_local_container_use():
+    # appending tracers to a LOCAL list (concat pattern) is idiomatic
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            outs = []
+            for i in range(3):
+                outs.append(F.relu(x))
+            return F.concat(*outs, dim=0)
+    """)
+    assert not only(f, "TPU002")
+
+
+def test_tpu002_passes_side_effect_free_body():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x, weight):
+            return F.dot(x, weight)
+    """)
+    assert not only(f, "TPU002")
+
+
+# ===========================================================================
+# TPU003 — data-dependent control flow
+# ===========================================================================
+def test_tpu003_flags_if_and_while_on_traced():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            if x.sum() > 0:
+                return x
+            while x.max() > 1:
+                x = x * 0.5
+            return x
+    """)
+    hits = only(f, "TPU003")
+    assert len(hits) == 2
+    assert all(h.severity == Severity.ERROR for h in hits)
+    assert "early return" in hits[0].message
+
+
+def test_tpu003_flags_assert_and_ifexp():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            assert x.min() >= 0
+            y = x if x.sum() > 0 else -x
+            return y
+    """)
+    assert len(only(f, "TPU003")) == 2
+
+
+def test_tpu003_passes_none_shape_isinstance_checks():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x, bias=None):
+            if bias is not None:
+                x = x + bias
+            if x.shape[0] > 2:
+                x = x * 2
+            if isinstance(x, tuple):
+                x = x[0]
+            return x
+    """)
+    assert not only(f, "TPU003")
+
+
+def test_tpu003_passes_while_on_python_counter():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            n = 3
+            while n > 0:
+                x = F.relu(x)
+                n -= 1
+            return x
+    """)
+    assert not only(f, "TPU003")
+
+
+# ===========================================================================
+# TPU004 — retrace hazards
+# ===========================================================================
+def test_tpu004_flags_loop_scalar_in_signature():
+    f = lint("""
+    def train(net, batches):
+        for i in range(100):
+            out = net(batches, i)
+        return out
+    """)
+    hits = only(f, "TPU004")
+    assert len(hits) == 1 and hits[0].severity == Severity.WARNING
+    assert "'i'" in hits[0].message
+
+
+def test_tpu004_flags_dict_literal_and_nonliteral_static():
+    f = lint("""
+    import jax
+    def select():
+        return (0, 1)
+    def build(fn, xs):
+        for x in xs:
+            fn(x, {"mode": "train"})
+        return jax.jit(fn, static_argnums=select())
+    """)
+    hits = only(f, "TPU004")
+    assert len(hits) == 2
+    assert any("dict/list literal" in h.message for h in hits)
+    assert any("static_argnums" in h.message for h in hits)
+
+
+def test_tpu004_passes_stable_signatures():
+    f = lint("""
+    import jax
+    def train(net, batches):
+        for batch in batches:
+            out = net(batch)
+        return out
+    step = jax.jit(train, static_argnums=(0,))
+    """)
+    assert not only(f, "TPU004")
+
+
+def test_tpu004_passes_scalar_hoisted_out_of_loop():
+    f = lint("""
+    def train(net, x, n_layers):
+        y = net(x, n_layers)
+        for _ in range(10):
+            y = net(y)
+        return y
+    """)
+    assert not only(f, "TPU004")
+
+
+# ===========================================================================
+# TPU005 — host RNG under trace
+# ===========================================================================
+def test_tpu005_flags_stdlib_and_numpy_rng():
+    f = lint("""
+    import random
+    import numpy as np
+    class Net:
+        def hybrid_forward(self, F, x):
+            if random.random() < 0.5:
+                x = -x
+            noise = np.random.normal(size=(3,))
+            return x + noise
+    """)
+    hits = only(f, "TPU005")
+    assert len(hits) == 2
+    assert all(h.severity == Severity.ERROR for h in hits)
+    assert "trace-time constant" in hits[0].message
+
+
+def test_tpu005_flags_aliased_numpy_rng():
+    f = lint("""
+    import numpy as onp
+    class Net:
+        def hybrid_forward(self, F, x):
+            return x * onp.random.rand()
+    """)
+    assert len(only(f, "TPU005")) == 1
+
+
+def test_tpu005_flags_indirect_rng_imports():
+    # every spelling of "host RNG" import must be caught, not just np.*
+    f = lint("""
+    import numpy.random as npr
+    from numpy import random as nprand
+    from numpy.random import uniform
+    from random import randint
+    class Net:
+        def hybrid_forward(self, F, x):
+            a = npr.uniform()
+            b = nprand.normal()
+            c = uniform(0, 1)
+            d = randint(0, 9)
+            return x * (a + b + c + d)
+    """)
+    assert len(only(f, "TPU005")) == 4
+
+
+def test_tpu005_passes_keyed_device_rng():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            mask = F.uniform(0, 1, shape=(1,)) < 0.5
+            noise = F.random.normal(0, 1, shape=(3,))
+            return x + noise * mask
+    """)
+    assert not only(f, "TPU005")
+
+
+def test_tpu005_passes_rng_outside_trace():
+    f = lint("""
+    import random
+    def make_batch(n):
+        return [random.random() for _ in range(n)]
+    """)
+    assert not only(f, "TPU005")
+
+
+# ===========================================================================
+# TPU006 — thread-shared module state
+# ===========================================================================
+def test_tpu006_flags_lockfree_thread_mutation():
+    f = lint("""
+    import threading
+    _STATE = {}
+    _EVENTS = []
+    def worker():
+        _STATE["k"] = 1
+        _EVENTS.append("seen")
+    def start():
+        threading.Thread(target=worker, daemon=True).start()
+    """)
+    hits = only(f, "TPU006")
+    assert len(hits) == 2
+    assert all(h.severity == Severity.WARNING for h in hits)
+    assert "_STATE" in hits[0].message
+
+
+def test_tpu006_flags_transitively_reached_mutation():
+    f = lint("""
+    import threading
+    _STATE = {}
+    def helper():
+        _STATE["deep"] = 2
+    def worker():
+        helper()
+    def start():
+        threading.Thread(target=worker).start()
+    """)
+    assert len(only(f, "TPU006")) == 1
+
+
+def test_tpu006_passes_mutation_under_lock():
+    f = lint("""
+    import threading
+    _STATE = {}
+    _LOCK = threading.Lock()
+    def worker():
+        with _LOCK:
+            _STATE["k"] = 1
+    def start():
+        threading.Thread(target=worker).start()
+    """)
+    assert not only(f, "TPU006")
+
+
+def test_tpu006_passes_without_threads():
+    f = lint("""
+    _STATE = {}
+    def main():
+        _STATE["k"] = 1
+    """)
+    assert not only(f, "TPU006")
+
+
+# ===========================================================================
+# suppression comments
+# ===========================================================================
+def test_suppression_same_line_and_bare():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            a = x.asnumpy()  # tpu-lint: disable=TPU001
+            b = x.asscalar()  # tpu-lint: disable
+            c = x.item()
+            return x
+    """)
+    hits = only(f, "TPU001")
+    assert len(hits) == 1 and hits[0].line == 6
+
+
+def test_suppression_comment_above_line():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            # tpu-lint: disable=TPU001
+            a = x.asnumpy()
+            return x
+    """)
+    assert not only(f, "TPU001")
+
+
+def test_suppression_wrong_code_does_not_hide():
+    f = lint("""
+    class Net:
+        def hybrid_forward(self, F, x):
+            a = x.asnumpy()  # tpu-lint: disable=TPU003
+            return x
+    """)
+    assert len(only(f, "TPU001")) == 1
+
+
+def test_suppression_disable_file():
+    f = lint("""
+    # tpu-lint: disable-file=TPU001
+    class Net:
+        def hybrid_forward(self, F, x):
+            a = x.asnumpy()
+            if x.sum() > 0:
+                return -x
+            return x
+    """)
+    assert not only(f, "TPU001")
+    assert len(only(f, "TPU003")) == 1  # other rules unaffected
+
+
+# ===========================================================================
+# programmatic check() API
+# ===========================================================================
+class _BadBlock(mx.gluon.HybridBlock):
+    # intentionally trace-hostile — fixture for check() on live objects
+    def hybrid_forward(self, F, x):
+        peek = x.asnumpy()  # noqa — the finding under test
+        return F.relu(x) * peek.sum()
+
+
+class _GoodBlock(mx.gluon.HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.relu(x)
+
+
+def test_check_live_block_class_and_instance():
+    for target in (_BadBlock, _BadBlock()):
+        f = check(target)
+        assert "TPU001" in codes(f), f
+    assert check(_GoodBlock()) == []
+
+
+def test_check_live_function_is_traced_by_definition():
+    def step(params, batch):
+        loss = float(batch.sum())
+        return loss
+
+    f = check(step)
+    assert "TPU001" in codes(f)
+    assert all(x.line for x in f)
+
+
+def test_check_path_and_rule_selection():
+    path = os.path.join(REPO, "mxnet_tpu", "gluon", "loss.py")
+    f = check(path)
+    assert [x for x in f if x.severity == Severity.ERROR] == []
+    sel = analysis.lint_file(path, rules=["TPU006"])
+    assert all(x.code == "TPU006" for x in sel)
+
+
+def test_rule_registry_complete():
+    table = analysis.rule_table()
+    got = [row[0] for row in table]
+    assert got == ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
+                   "TPU006"]
+    assert all(row[4] for row in table)  # every rule documented
+
+
+# ===========================================================================
+# CLI
+# ===========================================================================
+_BAD_SRC = """
+class Net:
+    def hybrid_forward(self, F, x):
+        return x.asnumpy()
+"""
+_CLEAN_SRC = """
+class Net:
+    def hybrid_forward(self, F, x):
+        return F.relu(x)
+"""
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD_SRC)
+    clean = tmp_path / "clean.py"
+    clean.write_text(_CLEAN_SRC)
+
+    assert cli_main([str(clean), "--fail-on=error"]) == 0
+    capsys.readouterr()
+    assert cli_main([str(bad), "--fail-on=error"]) == 1
+    capsys.readouterr()
+    assert cli_main([str(bad), "--fail-on=never"]) == 0
+    capsys.readouterr()
+
+    rc = cli_main([str(bad), "--format", "json", "--fail-on=never"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["counts"]["error"] == 1
+    assert out["findings"][0]["code"] == "TPU001"
+    assert out["findings"][0]["line"] == 4
+
+    assert cli_main([]) == 2                       # no targets
+    capsys.readouterr()
+    assert cli_main([str(bad), "--rules", "TPU999"]) == 2
+    capsys.readouterr()
+    assert cli_main(["--list-rules"]) == 0
+    assert "TPU006" in capsys.readouterr().out
+
+
+def test_cli_module_name_target(capsys):
+    rc = cli_main(["mxnet_tpu.analysis", "--fail-on=error"])
+    assert rc == 0
+
+
+def test_cli_cache_reuses_and_invalidates(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    target.write_text(_CLEAN_SRC)
+    cache = tmp_path / "cache.json"
+    assert cli_main([str(target), "--cache-file", str(cache),
+                     "--fail-on=error"]) == 0
+    capsys.readouterr()
+    assert cache.exists()
+    # cached rerun stays clean; rewriting the file invalidates by mtime
+    assert cli_main([str(target), "--cache-file", str(cache),
+                     "--fail-on=error"]) == 0
+    capsys.readouterr()
+    os.utime(target, (1, 1))
+    target.write_text(_BAD_SRC)
+    assert cli_main([str(target), "--cache-file", str(cache),
+                     "--fail-on=error"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_end_to_end_subprocess(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD_SRC)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", str(bad),
+         "--fail-on=error", "--format", "json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env)
+    assert r.returncode == 1, r.stderr
+    out = json.loads(r.stdout)
+    assert out["counts"]["error"] == 1
+
+
+def test_parse_log_lint_mode(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD_SRC)
+    findings = analysis.lint_file(str(bad))
+    dump = tmp_path / "lint.json"
+    dump.write_text(json.dumps(
+        {"version": 1, "counts": {"error": len(findings)},
+         "findings": [f.to_dict() for f in findings]}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         str(dump), "--lint"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "| severity | code | location | symbol | message |" in r.stdout
+    assert "TPU001" in r.stdout
+
+
+# ===========================================================================
+# runtime trace guard
+# ===========================================================================
+def test_guard_off_by_default():
+    assert not analysis.guard_active() or \
+        os.environ.get("MXNET_TPU_TRACE_GUARD")
+
+
+def test_guard_host_sync_raises_inside_jitted_step(guard_raise):
+    class Bad(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.relu(x) * float(x.sum().asnumpy())
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    before = _counter("analysis.guard.host_sync")
+    with pytest.raises(TraceGuardError) as exc_info:
+        net(mx.nd.ones((2, 3)))
+    assert exc_info.value.kind == "host_sync"
+    assert exc_info.value.site == "asnumpy"
+    assert _counter("analysis.guard.host_sync") == before + 1
+    # eager (unhybridized) host reads stay allowed
+    net2 = Bad()
+    net2.initialize()
+    out = net2(mx.nd.ones((2, 3)))
+    assert out.shape == (2, 3)
+
+
+def test_guard_warn_mode_warns_before_jax_error(guard_warn):
+    class Bad(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return x * x.asnumpy().sum()
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    with pytest.warns(RuntimeWarning, match="trace guard"):
+        with pytest.raises(Exception):  # jax concretization error follows
+            net(mx.nd.ones((2, 2)))
+
+
+def test_guard_retrace_limit_and_reason(guard_raise, monkeypatch, caplog):
+    monkeypatch.setenv("MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT", "2")
+
+    class Net(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.relu(x)
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    before = _counter("analysis.guard.retrace")
+    with caplog.at_level(logging.DEBUG, logger="mxnet_tpu.gluon.cachedop"):
+        with pytest.raises(TraceGuardError) as exc_info:
+            for n in range(1, 8):
+                net(mx.nd.ones((n, 2)))
+    assert exc_info.value.kind == "retrace"
+    assert "shape" in str(exc_info.value)
+    assert _counter("analysis.guard.retrace") > before
+    # the debug channel carries the per-retrace reason (which arg moved)
+    assert any("arg0 shape" in rec.message for rec in caplog.records)
+
+
+def test_guard_allows_stable_hybrid_calls(guard_raise):
+    class Net(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.relu(x)
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 3))
+    for _ in range(5):
+        out = net(x)
+    assert out.shape == (2, 3)
+
+
+def test_guard_env_var_subprocess(tmp_path):
+    """Acceptance: MXNET_TPU_TRACE_GUARD=1 catches a runtime .asnumpy()
+    inside a jitted step (env wiring, not just set_guard_mode)."""
+    script = tmp_path / "guarded.py"
+    script.write_text(textwrap.dedent("""
+        import mxnet_tpu as mx
+        from mxnet_tpu.analysis import TraceGuardError
+
+        class Bad(mx.gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                return F.relu(x) * x.asnumpy().sum()
+
+        net = Bad(); net.initialize(); net.hybridize()
+        try:
+            net(mx.nd.ones((2, 3)))
+        except TraceGuardError as e:
+            assert e.site == "asnumpy", e.site
+            n = mx.telemetry.snapshot()["counters"][
+                "analysis.guard.host_sync"]
+            assert n == 1, n
+            print("GUARD_OK")
+        else:
+            raise SystemExit("guard did not fire")
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_TRACE_GUARD="1",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, timeout=180, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "GUARD_OK" in r.stdout
+
+
+def test_retrace_reason_formatting():
+    from mxnet_tpu.gluon.block import _retrace_reason
+    old = (False, (((2, 3), "float32"), "repr:7"))
+    new_shape = (False, (((4, 3), "float32"), "repr:7"))
+    assert "arg0 shape (2, 3)->(4, 3)" in _retrace_reason(new_shape, old)
+    new_dtype = (False, (((2, 3), "float16"), "repr:7"))
+    assert "dtype" in _retrace_reason(new_dtype, old)
+    new_train = (True, (((2, 3), "float32"), "repr:7"))
+    assert "train mode" in _retrace_reason(new_train, old)
+    new_val = (False, (((2, 3), "float32"), "repr:9"))
+    assert "value" in _retrace_reason(new_val, old)
+    assert _retrace_reason(new_val, None) == "first trace"
+
+
+# ===========================================================================
+# meta: the tree lints itself clean (tier-1 self-check, `lint` marker)
+# ===========================================================================
+@pytest.mark.lint
+def test_mxnet_tpu_is_error_clean():
+    findings = analysis.lint_paths([os.path.join(REPO, "mxnet_tpu")])
+    errors = [f for f in findings if f.severity == Severity.ERROR]
+    assert not errors, "tracelint errors in mxnet_tpu/:\n" + \
+        "\n".join(f.format() for f in errors)
+
+
+@pytest.mark.lint
+def test_run_tracelint_script():
+    r = subprocess.run(
+        ["sh", os.path.join(REPO, "tools", "run_tracelint.sh")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 error(s)" in r.stdout

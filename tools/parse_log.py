@@ -127,6 +127,50 @@ def _print_resilience(rows, fmt):
         print(line % r)
 
 
+# severity ordering for the lint table: errors first, then by location
+_LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
+
+
+def parse_lint(obj):
+    """Flatten tracelint JSON (`python -m mxnet_tpu.analysis --format
+    json`) into [(severity, code, location, symbol, message)] rows,
+    errors first."""
+    findings = obj.get("findings", [])
+    keyed = []
+    for f in findings:
+        fname = f.get("file", "?")
+        try:
+            line = int(f.get("line", 0))
+        except (TypeError, ValueError):
+            line = 0
+        row = (f.get("severity", "?"), f.get("code", "?"),
+               "%s:%d" % (fname, line), f.get("symbol", ""),
+               f.get("message", ""))
+        keyed.append(((_LINT_SEV_ORDER.get(row[0], 3), fname, line,
+                       row[1]), row))
+    keyed.sort(key=lambda kr: kr[0])
+    return [row for _, row in keyed]
+
+
+def _print_lint(rows, fmt):
+    if not rows:
+        print("no tracelint findings in this dump (clean tree)",
+              file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| severity | code | location | symbol | message |")
+        print("| --- | --- | --- | --- | --- |")
+        line = "| %s | %s | %s | %s | %s |"
+    else:
+        print("severity,code,location,symbol,message")
+        line = "%s,%s,%s,%s,%s"
+    for r in rows:
+        sev, code, loc, sym, msg = r
+        if fmt == "csv":
+            msg = msg.replace(",", ";")
+        print(line % (sev, code, loc, sym, msg))
+
+
 def _load_json(path):
     try:
         with open(path) as f:
@@ -150,8 +194,17 @@ def main():
                              "stalls/restores/faults from a telemetry JSON "
                              "dump — distinguishes a noisy-but-recovered "
                              "run from a clean one")
+    parser.add_argument("--lint", action="store_true",
+                        help="tracelint mode: table of findings from "
+                             "`python -m mxnet_tpu.analysis --format json` "
+                             "output, errors first")
     args = parser.parse_args()
     obj = _load_json(args.logfile)
+    if args.lint:
+        if obj is None:
+            sys.exit("--lint input is not a JSON object: %s" % args.logfile)
+        _print_lint(parse_lint(obj), args.format)
+        return
     if args.resilience:
         if obj is None:
             sys.exit("--resilience input is not a JSON object: %s"

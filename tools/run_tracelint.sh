@@ -1,0 +1,20 @@
+#!/bin/sh
+# tracelint self-check: lint mxnet_tpu/ for trace-safety hazards, failing
+# on error-severity findings. Part of the tier-1 gate (also run from
+# tests/test_analysis.py under the `lint` pytest marker).
+#
+# The per-file mtime cache keeps repeat runs well under the 10 s budget —
+# only files that changed since the last run are re-parsed.
+#
+# Usage: tools/run_tracelint.sh [extra tracelint args...]
+#        (e.g. tools/run_tracelint.sh --format json)
+set -e
+cd "$(dirname "$0")/.."
+# --cache uses the CLI's uid-scoped default path under $TMPDIR;
+# MXNET_TPU_TRACELINT_CACHE overrides it explicitly
+if [ -n "${MXNET_TPU_TRACELINT_CACHE:-}" ]; then
+    set -- --cache-file "$MXNET_TPU_TRACELINT_CACHE" "$@"
+else
+    set -- --cache "$@"
+fi
+exec python -m mxnet_tpu.analysis mxnet_tpu --fail-on=error "$@"
