@@ -395,6 +395,84 @@ def bench_fused_train_stage(on_accel):
     return results["pallas_fused"], results["xla_composed"]
 
 
+def resnet18_grad_shapes():
+    """resnet18 (classes=1000) parameter shapes: conv1 + 8 basic blocks
+    (2 convs + 2 BN pairs each, stage-transition downsamples) + fc — the
+    62-tensor gradient set the comm bench AND the acceptance test
+    (tests/test_comm_bucket.py) sync."""
+    shapes = [(64, 3, 7, 7), (64,), (64,)]
+    widths = [(64, 64), (64, 128), (128, 256), (256, 512)]
+    for cin, cout in widths:
+        for blk in range(2):
+            first_in = cin if blk == 0 else cout
+            shapes += [(cout, first_in, 3, 3), (cout,), (cout,),
+                       (cout, cout, 3, 3), (cout,), (cout,)]
+            if blk == 0 and cin != cout:
+                shapes += [(cout, cin, 1, 1), (cout,), (cout,)]
+    shapes += [(1000, 512), (1000,)]
+    return shapes
+
+
+def bench_comm(on_accel):
+    """BENCH=comm: gradient-sync microbench for the bucketed comm engine
+    (mx.engine). A resnet18-shaped gradient set (62 tensors, ~11.7M params)
+    rides one multi-key kvstore pushpull per step — first bucketed
+    (MXNET_TPU_COMM_BUCKET_MB or the 25 MB default), then the per-param
+    escape hatch (bucket=0) for the vs_baseline ratio. The JSON row carries
+    `collectives_per_step` and `comm_bucket_bytes` from telemetry — the
+    numbers that prove buckets, not per-param calls, hit the wire.
+
+    Reading the row: on an accelerator the win is per-launch latency (62
+    dispatches -> ~2), so vs_baseline > 1 is expected; the cpu smoke row
+    has near-zero launch cost and pays the pack/unpack memcpy instead, so
+    its vs_baseline < 1 — there the row is about `collectives_per_step`
+    dropping below `params_per_step`, not the time ratio."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, nd, telemetry
+
+    shapes = resnet18_grad_shapes()
+    steps = 20 if on_accel else 5
+    rng = _np.random.RandomState(0)
+    # two replicas per key: both paths then do a REAL per-key reduce (the
+    # 2-device aggregation shape), not a free store replace
+    grads = [[nd.array(rng.randn(*s).astype(_np.float32)) for _ in range(2)]
+             for s in shapes]
+    outs = [[nd.zeros(s) for _ in range(2)] for s in shapes]
+    nbytes = sum(g[0].size * 4 for g in grads)
+
+    def run(bucket_mb):
+        with engine.bucket_mb_scope(bucket_mb):
+            kv = mx.kv.create("device")
+            keys = list(range(len(shapes)))
+            for k, s in zip(keys, shapes):
+                kv.init(k, nd.zeros(s))
+            kv.pushpull(keys, grads, out=outs)  # warm the fused programs
+            _sync(outs[0][0].data_jax)
+            telemetry.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                kv.pushpull(keys, grads, out=outs)
+            _sync(outs[0][0].data_jax)
+            dt = (time.perf_counter() - t0) / steps
+            snap = telemetry.snapshot()["counters"]
+            return dt, snap
+
+    dt_bucket, snap = run(None)       # env/default cap
+    dt_flat, _ = run(0)               # per-param escape hatch
+    payload = {
+        "metric": ("comm_grad_sync_mb_per_sec" if on_accel
+                   else "comm_grad_sync_cpu_mb_per_sec"),
+        "value": round(nbytes / 1e6 / dt_bucket, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(dt_flat / dt_bucket, 4),  # speedup vs per-param
+        "params_per_step": len(shapes),
+        "collectives_per_step": snap.get("comm.collectives", 0) // steps,
+        "comm_bucket_bytes": snap.get("comm.bucket.bytes", 0) // steps,
+        "comm_bucket_count": snap.get("comm.bucket.count", 0) // steps,
+    }
+    return payload
+
+
 def _probe_backend(timeout=240):
     """Initialize the default backend with a hang guard. The axon PjRt
     tunnel blocks indefinitely in make_c_api_client when the relay is
@@ -471,6 +549,9 @@ def main():
             "unit": "img/s",
             "vs_baseline": round(fast / base, 4),   # vs XLA composed
         })
+        return
+    if which == "comm":
+        _emit(bench_comm(on_accel))
         return
     if which in ("bert", "bert_gluon"):
         tok_s, _ = (bench_bert if which == "bert"

@@ -119,8 +119,11 @@ def host_sync(site):
 
 
 def on_retrace(name, n_signatures, reason):
-    """Called from CachedOp telemetry on every retrace. Counts always;
-    warns/raises once past the distinct-signature limit."""
+    """Called on every retrace — from CachedOp telemetry AND the functional
+    compiled-step paths (gluon.FusedTrainStep / parallel.ShardedTrainStep),
+    so the retrace-reason log and the signature limit cover both execution
+    paths. Counts always; warns/raises once past the distinct-signature
+    limit."""
     from .. import telemetry as _telem
     _telem.inc("analysis.guard.retrace")
     limit = retrace_limit()
@@ -128,7 +131,7 @@ def on_retrace(name, n_signatures, reason):
         return
     _emit(
         "retrace_limit", name,
-        "trace guard: CachedOp %r retraced %d times (limit %d) — the call "
+        "trace guard: %r retraced %d times (limit %d) — the call "
         "signature keeps changing: %s. Stabilize shapes/dtypes and pass "
         "loop-varying Python scalars as arrays (tracelint rule TPU004). "
         "(MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT raises the limit)"

@@ -1,15 +1,57 @@
-"""`mx.engine` — execution-engine controls.
+"""`mx.engine` — execution-engine controls + the bucketed gradient-comm
+engine.
 
-reference: python/mxnet/engine.py (bulk, set_bulk_size): batches engine
-pushes into bulked segments. Under XLA the analog is a no-op-with-truth:
-dispatch is already fully async and fusion happens in the compiler, so the
-bulk size is recorded for API compat and `bulk()` remains a valid scope.
+reference: python/mxnet/engine.py (bulk, set_bulk_size) batches engine pushes
+into bulked segments so many small ops ride one engine dispatch, and the
+async dependency engine overlaps kvstore pushes with the tail of backward.
+Under XLA the *compute* half of that story is free (dispatch is async,
+fusion happens in the compiler) — but the *communication* half is not: one
+collective per parameter still pays per-launch latency N times, and
+small-tensor collectives can't saturate ICI/DCN.
+
+This module is the TPU-native analog of the reference's bulked engine
+segments for the gradient path:
+
+* `GradBucketer` packs gradients — callers feed them in reverse-registration
+  order, approximating backward completion order — into size-capped flat
+  buckets (`MXNET_TPU_COMM_BUCKET_MB`, default 25 MB; 0 restores the
+  per-parameter path). Buckets are single-dtype; a gradient at or above the
+  cap travels alone.
+* `fused_bucket_fn` compiles ONE flatten -> comm -> unflatten XLA program
+  per bucket signature, so a bucket costs one launch instead of one per
+  parameter. Callers dispatch each bucket as soon as it fills; JAX async
+  dispatch then overlaps bucket N's collective with bucket N+1's pack and
+  whatever backward work is still queued.
+* `reassociate_bucketed` is the trace-time variant for the jitted train-step
+  paths (`gluon.FusedTrainStep` / `parallel.ShardedTrainStep` `bucket_mb`
+  knob): a concat/split identity that hands XLA one fused flat tensor per
+  bucket, so cross-replica grad reductions combine bucket-wise instead of
+  per-leaf.
+
+Telemetry: every flushed bucket counts `comm.bucket.count`,
+`comm.bucket.bytes` and `comm.bucket.flush_reason.<reason>`; empty grads
+count `comm.bucket.skipped`. Comm call sites record per-bucket
+`comm.bucket` spans (cat `comm`) so the overlap is visible in
+`mx.telemetry.dump_trace()` chrome dumps, and count `comm.collectives`
+per launched comm program (per key on the unbucketed path) — the
+collectives-per-step number the bench reports.
+
+`bulk()` / `set_bulk_size()` remain the reference-compatible scope API.
 """
 from __future__ import annotations
 
 import contextlib
+import os
 
-__all__ = ["bulk", "set_bulk_size"]
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bulk", "set_bulk_size", "DEFAULT_BUCKET_MB", "bucket_bytes",
+           "set_bucket_mb", "bucket_mb_scope", "Bucket", "GradBucketer",
+           "bucketize", "fused_bucket_fn", "pack_bucket", "unpack_bucket",
+           "reassociate_bucketed"]
 
 _BULK_SIZE = 15  # the reference default (MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN)
 
@@ -29,3 +71,260 @@ def bulk(size):
         yield
     finally:
         set_bulk_size(prev)
+
+
+# ---------------------------------------------------------------------------
+# bucket-size policy
+# ---------------------------------------------------------------------------
+DEFAULT_BUCKET_MB = 25.0
+
+# process-wide override (set_bucket_mb / bucket_mb_scope); None -> env
+_BUCKET_MB_OVERRIDE = None
+
+
+def set_bucket_mb(mb):
+    """Override the comm bucket cap (megabytes; 0 disables bucketing,
+    None returns control to `MXNET_TPU_COMM_BUCKET_MB`). Returns the
+    previous override so callers can restore it."""
+    global _BUCKET_MB_OVERRIDE
+    prev = _BUCKET_MB_OVERRIDE
+    _BUCKET_MB_OVERRIDE = None if mb is None else float(mb)
+    return prev
+
+
+@contextlib.contextmanager
+def bucket_mb_scope(mb):
+    """Scope with a different comm bucket cap — the test/bench knob."""
+    prev = set_bucket_mb(mb)
+    try:
+        yield
+    finally:
+        set_bucket_mb(prev)
+
+
+def bucket_bytes(bucket_mb=None):
+    """Effective bucket cap in BYTES; 0 means bucketing is disabled (the
+    per-parameter escape hatch). Precedence: explicit `bucket_mb` arg >
+    `set_bucket_mb`/`bucket_mb_scope` override > `MXNET_TPU_COMM_BUCKET_MB`
+    env (default 25)."""
+    mb = bucket_mb
+    if mb is None:
+        mb = _BUCKET_MB_OVERRIDE
+    if mb is None:
+        try:
+            mb = float(os.environ.get("MXNET_TPU_COMM_BUCKET_MB",
+                                      DEFAULT_BUCKET_MB))
+        except (TypeError, ValueError):
+            mb = DEFAULT_BUCKET_MB
+    mb = float(mb)
+    if mb <= 0:
+        return 0
+    return int(mb * 1024 * 1024)
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+class Bucket:
+    """One flat comm unit: ordered (key, array) pairs of a single dtype."""
+
+    __slots__ = ("keys", "raws", "shapes", "dtype", "nbytes", "reason")
+
+    def __init__(self, items, reason):
+        self.keys = [k for k, _ in items]
+        self.raws = [r for _, r in items]
+        self.shapes = [tuple(r.shape) for r in self.raws]
+        self.dtype = _np.dtype(self.raws[0].dtype)
+        self.nbytes = sum(_nbytes(r) for r in self.raws)
+        self.reason = reason
+
+    def __len__(self):
+        return len(self.keys)
+
+    def key_range(self):
+        """Compact key span for error/span context ("k0..kN" or "k0")."""
+        if len(self.keys) == 1:
+            return str(self.keys[0])
+        return "%s..%s" % (self.keys[0], self.keys[-1])
+
+    def __repr__(self):
+        return ("Bucket(keys=[%s], %d arrays, %d bytes, %s, reason=%s)"
+                % (self.key_range(), len(self), self.nbytes, self.dtype,
+                   self.reason))
+
+
+def _nbytes(raw):
+    return int(raw.size) * _np.dtype(raw.dtype).itemsize
+
+
+class GradBucketer:
+    """Greedy size-capped packer. Feed gradients with `add` in the order
+    collectives should launch (the trainer feeds reverse-registration
+    order, approximating backward completion order); each call returns the
+    buckets that just became ready so the caller can dispatch them
+    immediately — overlap comes from launching bucket N's comm before
+    bucket N+1 is even packed.
+
+    Flush reasons (counted under `comm.bucket.flush_reason.*`):
+      full        adding the next grad would cross the cap
+      dtype_split buckets are single-dtype; the next grad's dtype differs
+      oversize    a single grad at/above the cap travels alone
+      final       end-of-grads flush of the last partial bucket
+    """
+
+    def __init__(self, cap_bytes=None):
+        self.cap = bucket_bytes() if cap_bytes is None else int(cap_bytes)
+        self._open = []
+        self._open_bytes = 0
+        self._dtype = None
+
+    def add(self, key, raw):
+        """Queue one gradient; returns the list of buckets (possibly empty)
+        that are now ready to launch. Empty/None grads are skipped (stale
+        grads a `grad_req` change left behind)."""
+        from . import telemetry as _telem
+        ready = []
+        if raw is None or int(raw.size) == 0:
+            _telem.inc("comm.bucket.skipped")
+            return ready
+        dt = _np.dtype(raw.dtype)
+        nbytes = _nbytes(raw)
+        if self._open and dt != self._dtype:
+            ready.append(self._flush("dtype_split"))
+        if self.cap and nbytes >= self.cap:
+            # at/above the cap: never merged, never split — its own bucket
+            if self._open:
+                ready.append(self._flush("full"))
+            ready.append(_count_bucket(Bucket([(key, raw)], "oversize")))
+            return ready
+        if self._open and self.cap and self._open_bytes + nbytes > self.cap:
+            ready.append(self._flush("full"))
+        self._open.append((key, raw))
+        self._open_bytes += nbytes
+        self._dtype = dt
+        return ready
+
+    def flush(self, reason="final"):
+        """Close the open bucket; returns it (or None if empty)."""
+        if not self._open:
+            return None
+        return self._flush(reason)
+
+    def _flush(self, reason):
+        b = Bucket(self._open, reason)
+        self._open = []
+        self._open_bytes = 0
+        self._dtype = None
+        return _count_bucket(b)
+
+
+def _count_bucket(bucket):
+    from . import telemetry as _telem
+    if _telem.ENABLED:
+        _telem.inc("comm.bucket.count")
+        _telem.inc("comm.bucket.bytes", bucket.nbytes)
+        _telem.inc("comm.bucket.flush_reason.%s" % bucket.reason)
+    return bucket
+
+
+def bucketize(entries, cap_bytes=None):
+    """Pack an iterable of (key, raw_array) into a list of Buckets."""
+    bucketer = GradBucketer(cap_bytes)
+    out = []
+    for key, raw in entries:
+        out.extend(bucketer.add(key, raw))
+    tail = bucketer.flush()
+    if tail is not None:
+        out.append(tail)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fused flatten -> comm -> unflatten programs
+# ---------------------------------------------------------------------------
+# (tag, n_slots, shapes, dtype) -> jitted program. `tag` names the comm_fn
+# BEHAVIOR (jax.jit caches by callable identity; the first comm_fn seen for a
+# tag+signature is baked into the cached program) — callers must use a
+# distinct tag per distinct comm semantics.
+_FUSED_CACHE = {}
+
+
+def _split_points(shapes):
+    sizes = [int(_np.prod(s, dtype=_np.int64)) for s in shapes]
+    return sizes, list(_np.cumsum(sizes)[:-1])
+
+
+def fused_bucket_fn(tag, comm_fn, shapes, dtype, n_slots=1):
+    """Compile (and cache) ONE program: flatten `n_slots` groups of arrays
+    with these shapes, run ``comm_fn(*flats)`` (flat vector per slot ->
+    one flat vector), and unflatten back to `shapes`. This is the bucket's
+    single launch — XLA fuses pack, comm, and scatter."""
+    key = (tag, int(n_slots), tuple(tuple(s) for s in shapes), str(dtype))
+    fn = _FUSED_CACHE.get(key)
+    if fn is not None:
+        return fn
+    nshapes = len(shapes)
+    _, splits = _split_points(shapes)
+
+    def run(*raws):
+        flats = []
+        for s in range(n_slots):
+            grp = raws[s * nshapes:(s + 1) * nshapes]
+            flats.append(jnp.concatenate([r.reshape(-1) for r in grp])
+                         if nshapes > 1 else grp[0].reshape(-1))
+        out = comm_fn(*flats)
+        parts = jnp.split(out, splits) if splits else [out]
+        return tuple(p.reshape(sh) for p, sh in zip(parts, shapes))
+
+    fn = jax.jit(run)
+    _FUSED_CACHE[key] = fn
+    return fn
+
+
+def _identity(flat):
+    return flat
+
+
+def pack_bucket(bucket):
+    """One jitted concat of the bucket's raveled arrays -> flat vector.
+    For comm that cannot run inside jit (cross-process exchanges) the
+    flow is pack_bucket -> exchange -> unpack_bucket: 2 launches per
+    bucket instead of 2 per parameter."""
+    if len(bucket.raws) == 1:
+        return bucket.raws[0].reshape(-1)
+    key = ("pack", tuple(tuple(s) for s in bucket.shapes), str(bucket.dtype))
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda *rs: jnp.concatenate(
+            [r.reshape(-1) for r in rs]))
+        _FUSED_CACHE[key] = fn
+    return fn(*bucket.raws)
+
+
+def unpack_bucket(bucket, flat):
+    """One jitted split of a flat vector back to the bucket's shapes."""
+    return fused_bucket_fn("unpack", _identity, bucket.shapes,
+                           bucket.dtype)(flat)
+
+
+def reassociate_bucketed(raws, bucket_mb=None):
+    """Trace-time regrouping for the jitted train-step paths: concat `raws`
+    into size-capped flat buckets and split back. Numerically this is the
+    identity (no arithmetic — bit-exact), but the lowered program carries
+    one fused flat tensor per bucket, so XLA's collective scheduling
+    combines the cross-replica grad reductions bucket-wise instead of
+    emitting one small all-reduce per leaf. Under jit the bucket telemetry
+    counts once per (re)trace — buckets-per-program, not per step."""
+    cap = bucket_bytes(bucket_mb)
+    if not cap or len(raws) < 2:
+        return list(raws)
+    out = list(raws)
+    for bucket in bucketize(enumerate(raws), cap):
+        if len(bucket) == 1:
+            continue  # nothing to fuse for a lone oversize grad
+        _, splits = _split_points(bucket.shapes)
+        flat = jnp.concatenate([r.reshape(-1) for r in bucket.raws])
+        parts = jnp.split(flat, splits)
+        for idx, part, shape in zip(bucket.keys, parts, bucket.shapes):
+            out[idx] = part.reshape(shape)
+    return out

@@ -49,10 +49,12 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import engine as _engine
 from .. import ndarray as nd
 from .. import telemetry as _telem
 from ..context import current_context
-from .block import _AUX_COLLECTOR, _TRACE_STATE, _flatten, _regroup
+from .block import (_AUX_COLLECTOR, _TRACE_STATE, _flatten, _regroup,
+                    _retrace_reason)
 
 __all__ = ["FusedTrainStep"]
 
@@ -385,14 +387,21 @@ class FusedTrainStep:
     """ % sorted(_FUSABLE)
 
     def __init__(self, net, loss, trainer, donate=True, mesh=None,
-                 rules=None, batch_spec=None):
+                 rules=None, batch_spec=None, bucket_mb=None):
         """mesh: a jax.sharding.Mesh makes the fused step SPMD — params and
         optimizer state are sharded by `rules` (a parallel.ShardingRules;
         default replicated = pure data parallel), the batch is sharded over
         the mesh's 'data'/'fsdp' axes (or `batch_spec`), and XLA inserts the
         gradient allreduce (reference: multi-device Trainer + KVStore
         'device', SURVEY.md §2.3 row 1 — here the whole DP step is one
-        GSPMD program over ICI instead of engine-overlapped push/pull)."""
+        GSPMD program over ICI instead of engine-overlapped push/pull).
+
+        bucket_mb: route the traced gradients through `mx.engine`'s
+        bucketed regrouping (`engine.reassociate_bucketed`) so the emitted
+        program carries one fused flat tensor per size-capped bucket and
+        GSPMD's cross-replica grad reductions combine bucket-wise.
+        Numerically the identity (bit-exact); None disables, 0 is the
+        explicit per-leaf escape hatch."""
         self._net = net
         self._loss = loss
         self._trainer = trainer
@@ -400,6 +409,9 @@ class FusedTrainStep:
         self._mesh = mesh
         self._rules = rules
         self._batch_spec = batch_spec
+        self._bucket_mb = bucket_mb
+        self._sig_seen = set()   # call signatures, for the retrace guard
+        self._sig_last = None
         self._built = False
 
     # ------------------------------------------------------------------
@@ -571,6 +583,11 @@ class FusedTrainStep:
 
                 (unused_total, (loss_mean, aux_new)), grads = \
                     jax.value_and_grad(loss_fn, has_aux=True)(train_raws)
+                if self._bucket_mb is not None:
+                    # bucket-wise grad regrouping (identity math; one fused
+                    # flat tensor per bucket in the lowered program)
+                    grads = tuple(_engine.reassociate_bucketed(
+                        list(grads), self._bucket_mb))
                 new_train, new_states = [], []
                 for j in range(len(train_raws)):
                     sc = {k: v[j] for k, v in scal.items()}
@@ -613,6 +630,28 @@ class FusedTrainStep:
         ctx = flat_data[0].context
         if not self._built:
             self._build(ctx, data, label)
+        # retrace guard (ROADMAP follow-on): the inner jit retraces silently
+        # on any input shape/dtype change — route every new signature after
+        # the first through analysis.guard.on_retrace so the retrace-reason
+        # log and MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT cover the functional
+        # path, not just CachedOp
+        sig = (repr(in_fmt), tuple(
+            (tuple(a.shape), str(a.dtype))
+            for a in list(flat_data) + [label]))
+        if sig not in self._sig_seen:
+            prev_sig = self._sig_last
+            self._sig_seen.add(sig)
+            self._sig_last = sig
+            if len(self._sig_seen) > 1:
+                _telem.inc("fused_step.retrace")
+                from ..analysis import guard as _guard
+                if _guard.ACTIVE:
+                    _guard.on_retrace(
+                        "FusedTrainStep",
+                        len(self._sig_seen),
+                        _retrace_reason((True, sig[1]),
+                                        (True, prev_sig[1])
+                                        if prev_sig else None))
         # programs are keyed by input nesting: a call with equal shapes but a
         # different pytree structure must not reuse a stale trace
         prog = self._programs.get(repr(in_fmt))

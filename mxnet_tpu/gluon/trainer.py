@@ -241,6 +241,30 @@ class Trainer:
     def _allreduce_grads(self):
         if not self._kvstore:
             return
+        from .. import engine as _engine
+        if _engine.bucket_bytes():
+            entries = [(i, p) for i, p in enumerate(self._params)
+                       if p.grad_req != "null"]
+            if len(entries) > 1 and all(p._stype == "default"
+                                        for _, p in entries):
+                # bucketed engine path: ONE multi-key call, gradients fed in
+                # reverse-registration order (approximating backward
+                # completion order — the last layers' grads are ready
+                # first), packed into flat buckets by mx.engine and synced
+                # one fused program per bucket. pushpull fuses the pull into
+                # the same program when the optimizer runs locally.
+                keys, grads = [], []
+                for i, param in reversed(entries):
+                    keys.append(self._param2idx[param.name])
+                    grads.append(param.list_grad())
+                if self._update_on_kvstore:
+                    self._kvstore.push(keys, grads, priority=0)
+                else:
+                    self._kvstore.pushpull(keys, grads, out=grads,
+                                           priority=0)
+                return
+        # per-parameter path (MXNET_TPU_COMM_BUCKET_MB=0 escape hatch,
+        # sparse params, or a single parameter)
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 idx = self._param2idx[param.name]

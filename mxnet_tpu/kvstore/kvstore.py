@@ -22,7 +22,9 @@ sum of all pushed values per key; pull broadcasts the merged value.
 from __future__ import annotations
 
 import pickle
+import time
 
+from .. import engine as _engine
 from .. import ndarray as nd
 from .. import optimizer as opt
 from .. import telemetry as _telem
@@ -200,7 +202,12 @@ class KVStoreLocal(KVStore):
         """Merge (sum) the pushed device values per key. Without an updater
         the merged value REPLACES the store; with an updater the store holds
         weights and the updater applies the merged gradient (reference:
-        KVStoreLocal::PushImpl — updater_ path vs CopyFromTo path)."""
+        KVStoreLocal::PushImpl — updater_ path vs CopyFromTo path).
+
+        Multi-key dense pushes ride the bucketed engine (`mx.engine`): one
+        fused flatten->sum->unflatten program per size-capped bucket instead
+        of one merge program per key. `MXNET_TPU_COMM_BUCKET_MB=0` restores
+        the per-key path."""
         from ..resilience import faults as _faults
         keys = _key_list(key)
         values = _val_list(value, len(keys))
@@ -208,11 +215,18 @@ class KVStoreLocal(KVStore):
         self._check_keys(keys)
         if _telem.ENABLED:
             _record_comm("push", values)
+        cap = _engine.bucket_bytes()
+        if cap and len(keys) > 1:
+            entries = self._bucketable_entries(keys, values)
+            if entries is not None:
+                self._push_bucketed(entries, cap)
+                return
         inject = _faults.active_plan() is not None
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             k = str(k)
             stored = self._store[k]
+            _telem.inc("comm.collectives")
             if inject:
                 # injection-only site (no retry: the updater below mutates
                 # the store, so replaying a half-applied push is NOT
@@ -228,6 +242,120 @@ class KVStoreLocal(KVStore):
             else:
                 stored._write(merged.as_in_context(
                     stored.context)._read().astype(stored.dtype))
+
+    # -- bucketed engine path -------------------------------------------
+    def _bucketable_entries(self, keys, values):
+        """[(str key, [dense replica NDArrays])] when every key is dense
+        with a uniform replica count — the precondition for packing into
+        flat buckets; None sends the call down the per-key path."""
+        from ..ndarray import sparse as _sp
+        entries, nrep = [], None
+        for k, v in zip(keys, values):
+            vals = list(v) if isinstance(v, (list, tuple)) else [v]
+            if not vals or any(not isinstance(x, nd.NDArray)
+                               or isinstance(x, _sp.BaseSparseNDArray)
+                               for x in vals):
+                return None
+            if nrep is None:
+                nrep = len(vals)
+            elif len(vals) != nrep:
+                return None
+            entries.append((str(k), vals))
+        return entries
+
+    def _launch_bucket_merge(self, bucket, raw_slots, nrep):
+        """ONE fused flatten->sum(replicas)->unflatten program for the
+        bucket (reference: CommDevice::Reduce, but one launch per bucket
+        rather than per key). Returns the per-key merged raw arrays.
+        `raw_slots` holds per-key replica payloads captured BEFORE any
+        store/out mutation — jax arrays are immutable, so a per-bucket
+        retry replays on identical inputs even when outs alias the pushed
+        values (pushpull)."""
+        tag = "kv.local.sum%d" % nrep
+        if nrep == 1:
+            comm_fn = _engine._identity
+        else:
+            def comm_fn(*flats):
+                acc = flats[0]
+                for f in flats[1:]:
+                    acc = acc + f
+                return acc
+        fn = _engine.fused_bucket_fn(tag, comm_fn, bucket.shapes,
+                                     bucket.dtype, n_slots=nrep)
+        raws = []
+        for r in range(nrep):
+            for k in bucket.keys:
+                raws.append(raw_slots[k][r])
+        _telem.inc("comm.collectives")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        parts = fn(*raws)
+        _telem.record_span("comm.bucket[%s]" % bucket.key_range(), "comm",
+                           ts, time.perf_counter() - t0)
+        return parts
+
+    def _push_bucketed(self, entries, cap, outs=None):
+        """Bucketed push (and fused pull when `outs` is given): buckets are
+        launched as soon as they fill, so bucket N's program overlaps the
+        packing of bucket N+1 under async dispatch. Per-key fault-site
+        semantics are preserved: `kvstore.push` checks fire per key with the
+        owning bucket named in the context, and (store-replace mode only —
+        the updater path mutates and must not replay) each bucket retries
+        as a unit on transient faults."""
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        out_map = dict(outs) if outs is not None else None
+        nrep = len(entries[0][1])
+        ctx = self._store_ctx_for(entries[0][1])
+        use_faults = _faults.active_plan() is not None
+        raw_slots = {}
+
+        def apply_bucket(bucket):
+            parts = self._launch_bucket_merge(bucket, raw_slots, nrep)
+            for k, part in zip(bucket.keys, parts):
+                if use_faults:
+                    _faults.check(
+                        "kvstore.push",
+                        context="key=%s bucket=[%s]" % (k,
+                                                        bucket.key_range()))
+                stored = self._store[k]
+                merged = nd.from_jax(part, ctx=ctx)
+                if self._updater is not None:
+                    idx = int(k) if k.isdigit() else k
+                    self._updater(idx, merged, stored)
+                else:
+                    stored._write(merged.as_in_context(
+                        stored.context)._read().astype(stored.dtype))
+                if out_map is not None:
+                    if use_faults:
+                        # the fused pull keeps its own fault site; a pull
+                        # fault here is recovered by the bucket-level retry
+                        _faults.check(
+                            "kvstore.pull",
+                            context="key=%s bucket=[%s]"
+                            % (k, bucket.key_range()))
+                    src = self._store[k]
+                    for t in out_map[k]:
+                        src.copyto(t)
+
+        retriable = self._updater is None and use_faults
+        bucketer = _engine.GradBucketer(cap)
+
+        def dispatch(bucket):
+            if not retriable:
+                return apply_bucket(bucket)
+            call_with_retry(
+                apply_bucket, bucket, site="kvstore.push",
+                context="bucket keys=[%s] %dB"
+                % (",".join(bucket.keys), bucket.nbytes))
+
+        for k, vals in entries:
+            raw_slots[k] = [v.as_in_context(ctx)._read() for v in vals]
+            for bucket in bucketer.add(k, raw_slots[k][0]):
+                dispatch(bucket)
+        tail = bucketer.flush()
+        if tail is not None:
+            dispatch(tail)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast merged value to all outs (reference:
@@ -264,6 +392,29 @@ class KVStoreLocal(KVStore):
             call_with_retry(broadcast, site="kvstore.pull", context=context)
 
     def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull: on the bucketed path the pull costs NOTHING
+        extra — each bucket's merged parts write the store and broadcast to
+        the outs in the same pass, so a whole grad-sync is one program per
+        bucket (the reference needed engine dependency edges between push
+        and pull ops to get this close)."""
+        cap = _engine.bucket_bytes()
+        keys = _key_list(key)
+        # gradient compression (dist subclass) carries per-key residual
+        # state — its pushes must stay per-key, same guard as dist push
+        if cap and out is not None and len(keys) > 1 \
+                and self._updater is None \
+                and getattr(self, "_gc", None) is None:
+            values = _val_list(value, len(keys))
+            outs = _val_list(out, len(keys))
+            entries = self._bucketable_entries(keys, values)
+            out_entries = self._bucketable_entries(keys, outs)
+            if entries is not None and out_entries is not None:
+                self._check_keys(keys)
+                if _telem.ENABLED:
+                    _record_comm("push", values)
+                    _record_comm("pull", outs)
+                self._push_bucketed(entries, cap, outs=out_entries)
+                return
         self.push(key, value, priority)
         if out is not None:
             self.pull(key, out=out, priority=priority)

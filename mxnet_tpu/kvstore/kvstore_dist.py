@@ -28,12 +28,14 @@ allreduced densely over touched rows only.
 """
 from __future__ import annotations
 
+import time
 import warnings
 
 import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from .. import engine as _engine
 from .. import ndarray as nd
 from ..parallel import dist
 from .kvstore import KVStoreLocal
@@ -168,6 +170,8 @@ class KVStoreDist(KVStoreLocal):
                 return raw
             return self._cross_worker(raw, _sum0)
 
+        from .. import telemetry as _telem
+        _telem.inc("comm.collectives")
         return call_with_retry(dispatch, site=site, context=context)
 
     def _allreduce_compressed(self, raw, key):
@@ -203,6 +207,8 @@ class KVStoreDist(KVStoreLocal):
             _faults.check("kvstore.push", context=context)
             return self._cross_worker(packed, fn)
 
+        from .. import telemetry as _telem
+        _telem.inc("comm.collectives")
         return call_with_retry(dispatch, site="kvstore.push",
                                context=context)
 
@@ -217,6 +223,14 @@ class KVStoreDist(KVStoreLocal):
         self._check_keys(keys)
         if _telem.ENABLED:
             _record_comm("push", values)
+        cap = _engine.bucket_bytes()
+        if cap and len(keys) > 1 and self._gc is None:
+            # 2-bit compression stays per-key: its error-feedback residual
+            # is keyed state and bucket membership may shift between steps
+            entries = self._bucketable_entries(keys, values)
+            if entries is not None:
+                self._push_bucketed(entries, cap)
+                return
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             k = str(k)
@@ -264,6 +278,87 @@ class KVStoreDist(KVStoreLocal):
         else:
             stored._write(merged.as_in_context(
                 stored.context)._read().astype(stored.dtype))
+
+    def _push_bucketed(self, entries, cap, outs=None):
+        """Bucketed cross-worker path (overrides the local-merge version the
+        inherited push/pushpull fast paths call): pack each size-capped
+        bucket flat (one launch), ONE allreduce over the worker mesh per
+        bucket — retried as a unit with the member keys in the error
+        context — then one unflatten, with per-key updater/store-write
+        semantics unchanged. Buckets launch as they fill, so bucket N's
+        collective overlaps bucket N+1's local merge + pack under async
+        dispatch (reference: engine-overlapped ZPush, SURVEY §3.4)."""
+        from .. import telemetry as _telem
+        from ..resilience import faults as _faults
+        from ..resilience.errors import (FatalTrainingError, ResilienceError,
+                                         TransportError, classify)
+        from ..resilience.retry import call_with_retry
+        out_map = dict(outs) if outs is not None else None
+        use_faults = _faults.active_plan() is not None
+
+        def apply_bucket(bucket):
+            context = ("bucket keys=[%s] %dB"
+                       % (",".join(bucket.keys), bucket.nbytes))
+            flat = _engine.pack_bucket(bucket)
+            ts = _telem.span_clock()
+            t0 = time.perf_counter()
+            summed = self._allreduce(flat, context=context)
+            _telem.record_span("comm.bucket[%s]" % bucket.key_range(),
+                               "comm", ts, time.perf_counter() - t0)
+            parts = _engine.unpack_bucket(bucket, summed)
+            for k, part in zip(bucket.keys, parts):
+                stored = self._store[k]
+                merged = nd.from_jax(part, ctx=stored.context)
+                if self._updater is not None:
+                    idx = int(k) if k.isdigit() else k
+                    self._updater(idx, merged, stored)
+                else:
+                    stored._write(merged.as_in_context(
+                        stored.context)._read().astype(stored.dtype))
+                if out_map is not None:
+                    src = self._store[k]
+                    targets = out_map[k]
+                    if not use_faults:
+                        for t in targets:
+                            src.copyto(t)
+                        continue
+                    # per-key pull fault site + retry, matching pull():
+                    # the local broadcast is idempotent
+                    pctx = "key=%s bucket=[%s]" % (k, bucket.key_range())
+
+                    def broadcast(src=src, targets=targets, pctx=pctx):
+                        _faults.check("kvstore.pull", context=pctx)
+                        for t in targets:
+                            src.copyto(t)
+
+                    call_with_retry(broadcast, site="kvstore.pull",
+                                    context=pctx)
+
+        bucketer = _engine.GradBucketer(cap)
+
+        def dispatch(bucket):
+            try:
+                apply_bucket(bucket)
+            except ResilienceError:
+                raise  # already carries bucket keys/attempt context
+            except Exception as exc:
+                detail = ("kvstore_dist bucketed push failed: keys=[%s] "
+                          "%dB worker=%d/%d: %s: %s"
+                          % (",".join(bucket.keys), bucket.nbytes,
+                             dist.rank(), dist.num_workers(),
+                             type(exc).__name__, exc))
+                if classify(exc) == "retriable":
+                    raise TransportError(detail, site="kvstore.push",
+                                         key=bucket.key_range()) from exc
+                raise FatalTrainingError(detail) from exc
+
+        for k, vals in entries:
+            merged = self._merge(vals)
+            for bucket in bucketer.add(k, merged._read()):
+                dispatch(bucket)
+        tail = bucketer.flush()
+        if tail is not None:
+            dispatch(tail)
 
     def barrier(self):
         nd.waitall()

@@ -23,13 +23,36 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
-           "barrier", "allreduce_bench"]
+           "psum_bucketed", "all_reduce_multi", "barrier", "allreduce_bench"]
 
 
 def all_reduce(x, axis_name):
     """Sum over a mesh axis (inside shard_map/jit). reference semantics:
     KVStore push+pull of a dense key == allreduce."""
     return lax.psum(x, axis_name)
+
+
+def psum_bucketed(xs, axis_name, bucket_mb=None):
+    """Sum a LIST of arrays over a mesh axis as few fused flat psums
+    (inside shard_map/jit): arrays are packed into size-capped single-dtype
+    buckets (`mx.engine`, `MXNET_TPU_COMM_BUCKET_MB`) and each bucket is
+    one `lax.psum` over its concatenation — the in-trace analog of the
+    kvstore's bucketed push. Returns the reduced arrays in input order;
+    with bucketing disabled this is one psum per array."""
+    from .. import engine as _engine
+    cap = _engine.bucket_bytes(bucket_mb)
+    if not cap or len(xs) < 2:
+        return [lax.psum(x, axis_name) for x in xs]
+    out = list(xs)
+    for bucket in _engine.bucketize(enumerate(xs), cap):
+        flat = jnp.concatenate([r.reshape(-1) for r in bucket.raws]) \
+            if len(bucket) > 1 else bucket.raws[0].reshape(-1)
+        red = lax.psum(flat, axis_name)
+        _, splits = _engine._split_points(bucket.shapes)
+        parts = jnp.split(red, splits) if splits else [red]
+        for idx, part, shape in zip(bucket.keys, parts, bucket.shapes):
+            out[idx] = part.reshape(shape)
+    return out
 
 
 def all_gather(x, axis_name, axis=0, tiled=True):
@@ -72,6 +95,7 @@ def barrier(mesh=None):
 
 
 def _eager_allreduce(arr, mesh, axis):
+    from .. import telemetry as _telem
     from ..resilience import faults as _faults
     from ..resilience.retry import call_with_retry
     spec = P(axis)
@@ -83,7 +107,92 @@ def _eager_allreduce(arr, mesh, axis):
                       context="shape=%s axis=%s" % (tuple(arr.shape), axis))
         return jax.jit(f)(arr)
 
+    _telem.inc("comm.collectives")
     return call_with_retry(dispatch, site="collective.all_reduce")
+
+
+# fused eager multi-allreduce programs, one per (mesh, axis, signature)
+_MULTI_AR_CACHE = {}
+
+
+def _multi_allreduce_fn(mesh, axis, shapes, dtype):
+    key = (mesh, axis, tuple(tuple(s) for s in shapes), str(dtype))
+    fn = _MULTI_AR_CACHE.get(key)
+    if fn is not None:
+        return fn
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    sizes = [int(_np.prod(s, dtype=_np.int64)) // n for s in shapes]
+    splits = list(_np.cumsum(sizes)[:-1])
+
+    def run(*raws):
+        # each (n*k_i, ...) array contributes its per-shard flat row; the
+        # concatenated (n, K) matrix reduces in ONE psum over the axis
+        flats = [r.reshape(n, -1) for r in raws]
+        flat = jnp.concatenate(flats, axis=1) if len(flats) > 1 else flats[0]
+        red = shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
+                        in_specs=P(axis), out_specs=P())(flat)
+        row = red.reshape(-1)
+        parts = jnp.split(row, splits) if splits else [row]
+        return tuple(
+            p.reshape((s[0] // n,) + tuple(s[1:]))
+            for p, s in zip(parts, shapes))
+
+    fn = jax.jit(run)
+    _MULTI_AR_CACHE[key] = fn
+    return fn
+
+
+def all_reduce_multi(arrays, mesh=None, axis=None, bucket_mb=None):
+    """Eager fused multi-tensor allreduce: sum each array's leading-dim
+    shards over `axis` (the `_eager_allreduce` contract) but batched —
+    arrays pack into size-capped buckets (`mx.engine`) and each bucket is
+    ONE jitted flatten->psum->unflatten program, launched as soon as it
+    fills so bucket N's collective overlaps bucket N+1's pack. Each
+    array's leading dim must divide by the axis size. Returns the reduced
+    arrays in input order."""
+    from .. import engine as _engine
+    from .. import telemetry as _telem
+    from ..resilience import faults as _faults
+    from ..resilience.retry import call_with_retry
+    if mesh is None:
+        from .mesh import current_mesh, local_mesh
+        mesh = current_mesh() or local_mesh()
+    axis = axis or mesh.axis_names[0]
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    for a in arrays:
+        if a.shape[0] % n:
+            raise ValueError(
+                "all_reduce_multi: leading dim %d of shape %s does not "
+                "divide the %r axis size %d"
+                % (a.shape[0], tuple(a.shape), axis, n))
+    cap = _engine.bucket_bytes(bucket_mb)
+    if not cap or len(arrays) < 2:
+        return [_eager_allreduce(a, mesh, axis) for a in arrays]
+    out = [None] * len(arrays)
+    for bucket in _engine.bucketize(enumerate(arrays), cap):
+        fn = _multi_allreduce_fn(mesh, axis, bucket.shapes, bucket.dtype)
+        context = "bucket tensors=[%s] %dB" % (bucket.key_range(),
+                                               bucket.nbytes)
+
+        def dispatch(fn=fn, bucket=bucket, context=context):
+            _faults.check("collective.all_reduce", context=context)
+            return fn(*bucket.raws)
+
+        _telem.inc("comm.collectives")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        parts = call_with_retry(dispatch, site="collective.all_reduce",
+                                context=context)
+        _telem.record_span("comm.bucket[%s]" % bucket.key_range(), "comm",
+                           ts, time.perf_counter() - t0)
+        for idx, part in zip(bucket.keys, parts):
+            out[idx] = part
+    for i, a in enumerate(arrays):
+        if out[i] is None:  # zero-size arrays skip the bucketer; their
+            # reduction is an empty array of the shard shape
+            out[i] = jnp.zeros((a.shape[0] // n,) + tuple(a.shape[1:]),
+                               a.dtype)
+    return out
 
 
 def allreduce_bench(size_mb=64, iters=20, mesh=None, dtype=jnp.float32):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import engine as _engine
 from .. import telemetry as _telem
 from .sharding import ShardingRules, shard_pytree
 
@@ -103,7 +104,7 @@ class ShardedTrainStep:
 
     def __init__(self, loss_fn, params, mesh, rules=None, optimizer="adamw",
                  lr=1e-3, batch_spec=None, grad_accum=1, donate=True,
-                 remat=False, **opt_kwargs):
+                 remat=False, bucket_mb=None, **opt_kwargs):
         self.loss_fn = loss_fn
         self._init_params = params
         self.mesh = mesh
@@ -115,6 +116,12 @@ class ShardedTrainStep:
         self.lr = lr
         self.opt_kwargs = opt_kwargs
         self.grad_accum = grad_accum
+        # bucket_mb: regroup traced grads through mx.engine's size-capped
+        # buckets (identity math) so GSPMD emits bucketed cross-replica
+        # reductions; None disables, 0 is the per-leaf escape hatch
+        self.bucket_mb = bucket_mb
+        self._sig_seen = set()   # batch signatures, for the retrace guard
+        self._sig_last = None
         data_axes = tuple(a for a in ("data", "fsdp")
                           if a in mesh.axis_names and
                           dict(zip(mesh.axis_names,
@@ -162,6 +169,9 @@ class ShardedTrainStep:
         opt_update = self._opt_update
         opt_kwargs = self.opt_kwargs
         accum = self.grad_accum
+        bucket_mb = self.bucket_mb
+        bucket_cap = (0 if bucket_mb is None
+                      else _engine.bucket_bytes(bucket_mb))
 
         def step_fn(params, opt_state, batch, step_num):
             if accum > 1:
@@ -178,6 +188,13 @@ class ShardedTrainStep:
                 grads = _tmap(lambda g: g / accum, grads)
             else:
                 loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            if bucket_cap:
+                # bucket-wise grad regrouping (identity math): the lowered
+                # program carries one fused flat tensor per bucket, so the
+                # GSPMD-inserted cross-replica reductions combine bucket-wise
+                leaves, tree = jax.tree_util.tree_flatten(grads)
+                leaves = _engine.reassociate_bucketed(leaves, bucket_mb)
+                grads = jax.tree_util.tree_unflatten(tree, leaves)
             cur_lr = lr(step_num) if callable(lr) else lr
             new_params, new_state = opt_update(
                 params, grads, opt_state, cur_lr, **opt_kwargs)
@@ -216,6 +233,25 @@ class ShardedTrainStep:
     def _step(self, params, opt_state, batch, step_num):
         from ..resilience import faults as _faults
         _faults.check("train.step")  # injection-only; resilience.run recovers
+        # retrace guard (ROADMAP follow-on): the compiled jit silently
+        # retraces on any batch shape/dtype change — route new signatures
+        # through analysis.guard.on_retrace so the retrace-reason log and
+        # MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT cover the functional path
+        sig = tuple((tuple(x.shape), str(x.dtype))
+                    for x in jax.tree_util.tree_leaves(batch))
+        if sig not in self._sig_seen:
+            prev = self._sig_last
+            self._sig_seen.add(sig)
+            self._sig_last = sig
+            if prev is not None:
+                _telem.inc("train_step.compile")  # jit retrace = recompile
+                _telem.inc("train_step.retrace")
+                from ..analysis import guard as _guard
+                if _guard.ACTIVE:
+                    from ..gluon.block import _retrace_reason
+                    _guard.on_retrace(
+                        "ShardedTrainStep", len(self._sig_seen),
+                        _retrace_reason((True, sig), (True, prev)))
         if self._compiled is None:
             _telem.inc("train_step.compile")
             self._batch_proto = batch

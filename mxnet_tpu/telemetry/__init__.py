@@ -12,6 +12,14 @@ reports through it). Instrumented hot paths:
   (the classic hidden stall under async PjRt dispatch);
 * `kvstore` — `kvstore.push_calls` / `pull_calls` and payload
   `push_bytes` / `pull_bytes`;
+* bucketed comm engine (`mx.engine`) — `comm.collectives` (launched comm
+  programs: per bucket when bucketing, per key on the escape hatch),
+  `comm.bucket.count` / `comm.bucket.bytes` /
+  `comm.bucket.flush_reason.{full,dtype_split,oversize,final}` /
+  `comm.bucket.skipped`, plus one `comm.bucket[k0..kN]` span per launch
+  (cat `comm`) so overlap is visible in chrome-trace dumps;
+* dataloader — `dataloader.batchify.syncs_saved` (device→host syncs
+  avoided by the batched collate);
 * train steps — `trainer.step_ms`, `fused_step.step_ms`,
   `train_step.step_ms` histograms + compile counters;
 * memory — best-effort `memory.*.bytes_in_use` watermark gauges from the

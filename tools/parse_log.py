@@ -127,6 +127,55 @@ def _print_resilience(rows, fmt):
         print(line % r)
 
 
+def parse_comm(obj):
+    """Extract the gradient-comm story from a telemetry snapshot: bucket
+    counters (`comm.bucket.*`), launched collectives (`comm.collectives`),
+    kvstore payload counters, and derived ratios — was the sync bucketed
+    (few big launches) or per-param (many small ones)?
+    Returns [(metric, value)] rows."""
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    counters = obj.get("counters", {})
+    rows = []
+    ordered = ("comm.collectives", "comm.bucket.count", "comm.bucket.bytes",
+               "comm.bucket.skipped", "kvstore.push_calls",
+               "kvstore.push_bytes", "kvstore.pull_calls",
+               "kvstore.pull_bytes")
+    for name in ordered:
+        if name in counters:
+            rows.append((name, counters[name]))
+    for name in sorted(counters):
+        if name.startswith("comm.bucket.flush_reason."):
+            rows.append((name, counters[name]))
+    buckets = counters.get("comm.bucket.count", 0)
+    if buckets:
+        rows.append(("avg_bucket_kb",
+                     round(counters.get("comm.bucket.bytes", 0)
+                           / buckets / 1024.0, 1)))
+    pushes = counters.get("kvstore.push_calls", 0)
+    if pushes:
+        rows.append(("collectives_per_push",
+                     round(counters.get("comm.collectives", 0)
+                           / float(pushes), 2)))
+    return rows
+
+
+def _print_comm(rows, fmt):
+    if not rows:
+        print("no comm.*/kvstore.* counters in this dump (no gradient "
+              "sync ran, or telemetry disabled)", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| metric | value |")
+        print("| --- | --- |")
+        line = "| %s | %s |"
+    else:
+        print("metric,value")
+        line = "%s,%s"
+    for r in rows:
+        print(line % r)
+
+
 # severity ordering for the lint table: errors first, then by location
 _LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
 
@@ -198,8 +247,18 @@ def main():
                         help="tracelint mode: table of findings from "
                              "`python -m mxnet_tpu.analysis --format json` "
                              "output, errors first")
+    parser.add_argument("--comm", action="store_true",
+                        help="gradient-comm mode: table of bucket/collective"
+                             " counters from a telemetry JSON dump — was the"
+                             " sync bucketed (few big launches) or per-param"
+                             " (many small ones)?")
     args = parser.parse_args()
     obj = _load_json(args.logfile)
+    if args.comm:
+        if obj is None:
+            sys.exit("--comm input is not a JSON object: %s" % args.logfile)
+        _print_comm(parse_comm(obj), args.format)
+        return
     if args.lint:
         if obj is None:
             sys.exit("--lint input is not a JSON object: %s" % args.logfile)
