@@ -652,6 +652,7 @@ class CachedOp:
             self._sig_seen.add(sig)
             _telem.inc("cachedop.cache_miss")
             _telem.inc("cachedop.compile")
+            _telem.note_compile("cachedop:%s" % name)
             if len(self._sig_seen) > 1:
                 _telem.inc("cachedop.retrace")
                 # the retrace REASON: which arg's shape/dtype/value moved

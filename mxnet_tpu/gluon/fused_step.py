@@ -414,6 +414,18 @@ class FusedTrainStep:
         self._sig_last = None
         self._built = False
 
+    def rebuild_for_mesh(self, mesh):
+        """A fresh, unbuilt FusedTrainStep over the same net/loss/trainer
+        targeting `mesh` — the elastic-recovery rebuild after the device
+        set changed. Its `_build` re-reads the (restored) params off the
+        net and re-places them per the step's ShardingRules; the caller
+        (`ResilientRunner.for_fused_step`) carries the optimizer states
+        across."""
+        return FusedTrainStep(
+            self._net, self._loss, self._trainer, donate=self._donate,
+            mesh=mesh, rules=self._rules, batch_spec=self._batch_spec,
+            bucket_mb=self._bucket_mb)
+
     # ------------------------------------------------------------------
     def _build(self, ctx, data, label):
         trainer = self._trainer
@@ -657,6 +669,8 @@ class FusedTrainStep:
         prog = self._programs.get(repr(in_fmt))
         if prog is None:
             _telem.inc("fused_step.compile")
+            _telem.note_compile(
+                "fused_step:%s" % getattr(self._net, "name", "net"))
             prog = self._make_program(in_fmt)
             self._programs[repr(in_fmt)] = prog
         jitted, holder = prog

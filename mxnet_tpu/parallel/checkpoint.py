@@ -12,7 +12,15 @@ jax-ecosystem standard:
   latest_step(path)
 
 Checkpoint/resume policy matches the reference (§5.3): periodic epoch/step
-saves + explicit resume; no elastic membership.
+saves + explicit resume. Pod coordination (resilience v2): with
+``coordinated=True`` the LATEST marker only flips after a fleet-wide
+min-step election over the jax.distributed coordinator
+(`resilience.commit`), and `restore_sharded(coordinated=True)` restores
+the *elected* step on every rank — a rank that crashed mid-commit a step
+ahead rejoins at the step the rest of the fleet agreed on.
+`latest_committed_step` is the strict marker-only view. The
+``checkpoint.save`` / ``checkpoint.restore`` fault sites make the
+mid-commit crash injectable (`MXNET_TPU_FAULT_PLAN`).
 """
 from __future__ import annotations
 
@@ -24,7 +32,8 @@ from jax.sharding import NamedSharding
 from .sharding import ShardingRules
 
 __all__ = ["save_sharded", "restore_sharded", "latest_step",
-           "save_train_state", "restore_train_state"]
+           "latest_committed_step", "save_train_state",
+           "restore_train_state"]
 
 
 def _mgr(path, keep=None):
@@ -49,21 +58,40 @@ def _commit_latest_marker(path, step):
     write_latest_marker(os.path.abspath(path), step)
 
 
-def save_sharded(path, tree, step=0, wait=True, keep=None):
+def save_sharded(path, tree, step=0, wait=True, keep=None,
+                 coordinated=False):
     """Write one step of a (possibly sharded) pytree. Every process must
     call this (multi-host collective); single-process works as-is.
 
     keep=N retains only the newest N steps (unbounded growth killed real
     disks before it ever killed a run); the LATEST marker commits via
-    write-then-rename strictly after the step's payload is durable."""
+    write-then-rename strictly after the step's payload is durable.
+
+    coordinated=True runs the two-phase commit: after the payload is
+    durable, the fleet elects min(every rank's step) over the
+    jax.distributed coordinator and the marker names the ELECTED step —
+    never a step some rank does not have. The ``checkpoint.save`` fault
+    site sits exactly at the mid-commit point (payload durable, marker
+    not yet moved)."""
+    from ..resilience import faults as _faults
     import orbax.checkpoint as ocp
     mgr = _mgr(path, keep=keep)
-    mgr.save(int(step), args=ocp.args.StandardSave(tree))
-    if wait:
-        mgr.wait_until_finished()
-        if jax.process_index() == 0:
-            _commit_latest_marker(path, step)
-    mgr.close()
+    try:
+        mgr.save(int(step), args=ocp.args.StandardSave(tree))
+        if wait:
+            mgr.wait_until_finished()
+            _faults.check("checkpoint.save",
+                          context="step=%d mid-commit" % step)
+            marked = int(step)
+            if coordinated:
+                from ..resilience.commit import elect_step
+                elected = elect_step(marked, kind="save")
+                if elected is not None:
+                    marked = elected
+            if jax.process_index() == 0:
+                _commit_latest_marker(path, marked)
+    finally:
+        mgr.close()
 
 
 def latest_step(path):
@@ -85,37 +113,65 @@ def latest_step(path):
     return max(candidates) if candidates else None
 
 
-def restore_sharded(path, step=None, mesh=None, rules=None, template=None):
+def latest_committed_step(path):
+    """The strict COMMITTED view: the step the LATEST marker names (when
+    its payload exists), else None. Under the coordinated protocol this is
+    the fleet-agreed step — a newer prepared-but-unelected payload is
+    deliberately invisible here, unlike `latest_step`'s scan fallback."""
+    from ..util import read_latest_marker
+    root = os.path.abspath(path)
+    marked = read_latest_marker(root)
+    if marked is not None and os.path.isdir(os.path.join(root, str(marked))):
+        return marked
+    return None
+
+
+def restore_sharded(path, step=None, mesh=None, rules=None, template=None,
+                    coordinated=False):
     """Restore a step. With mesh+rules (or an explicit template tree of
     jax.ShapeDtypeStruct/arrays), arrays come back with the target
-    NamedShardings — each host reads only its shards."""
+    NamedShardings — each host reads only its shards.
+
+    coordinated=True (step=None): every rank reports its local newest
+    committed step and all restore the elected minimum — ranks always
+    agree, even after a mid-commit crash left one rank's disk a step
+    ahead."""
+    from ..resilience import faults as _faults
     import orbax.checkpoint as ocp
     mgr = _mgr(path)
-    if step is None:
-        step = mgr.latest_step()
+    try:
+        if step is None and coordinated:
+            local = latest_committed_step(path)
+            if local is None:
+                local = mgr.latest_step()
+            from ..resilience.commit import elect_step
+            step = elect_step(local, kind="restore")
         if step is None:
-            mgr.close()
-            raise FileNotFoundError("no checkpoint under %s" % path)
-    if template is None and mesh is not None:
-        meta = mgr.item_metadata(int(step))
-        tree_meta = getattr(meta, "item_metadata", meta)
-        rules = rules or ShardingRules([])
-        flat, treedef = jax.tree_util.tree_flatten_with_path(tree_meta)
-        outs = []
-        for keypath, leaf in flat:
-            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                            for k in keypath)
-            spec = rules.spec_for(name, tuple(leaf.shape), mesh)
-            outs.append(jax.ShapeDtypeStruct(
-                tuple(leaf.shape), leaf.dtype,
-                sharding=NamedSharding(mesh, spec)))
-        template = jax.tree_util.tree_unflatten(treedef, outs)
-    # StandardRestore(None) restores host-resident arrays with the saved
-    # topology — still explicit args, which a fresh manager requires
-    restored = mgr.restore(
-        int(step), args=ocp.args.StandardRestore(template))
-    mgr.close()
-    return restored
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError("no checkpoint under %s" % path)
+        _faults.check("checkpoint.restore", context="step=%d" % int(step))
+        if template is None and mesh is not None:
+            meta = mgr.item_metadata(int(step))
+            tree_meta = getattr(meta, "item_metadata", meta)
+            rules = rules or ShardingRules([])
+            flat, treedef = jax.tree_util.tree_flatten_with_path(tree_meta)
+            outs = []
+            for keypath, leaf in flat:
+                name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                                for k in keypath)
+                spec = rules.spec_for(name, tuple(leaf.shape), mesh)
+                outs.append(jax.ShapeDtypeStruct(
+                    tuple(leaf.shape), leaf.dtype,
+                    sharding=NamedSharding(mesh, spec)))
+            template = jax.tree_util.tree_unflatten(treedef, outs)
+        # StandardRestore(None) restores host-resident arrays with the
+        # saved topology — still explicit args, which a fresh manager
+        # requires
+        return mgr.restore(
+            int(step), args=ocp.args.StandardRestore(template))
+    finally:
+        mgr.close()
 
 
 def save_train_state(path, params, opt_state, step, keep=None):

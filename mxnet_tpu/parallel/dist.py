@@ -16,7 +16,7 @@ import os
 import jax
 
 __all__ = ["initialize", "is_initialized", "rank", "num_workers",
-           "env_spec_from_dmlc"]
+           "env_spec_from_dmlc", "coordinator_client"]
 
 _STATE = {"initialized": False, "rank": 0, "num": 1}
 
@@ -81,6 +81,20 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
 
 def is_initialized():
     return _STATE["initialized"]
+
+
+def coordinator_client():
+    """The jax.distributed coordination-service client (key-value store +
+    barriers), or None when this process never rendezvoused. The
+    resilience commit protocol runs its min-step elections over it —
+    the same channel the runtime's own heartbeats ride, so no side
+    server. (jax-internal accessor isolated here; the fallback path in
+    `resilience.commit` rides a DCN allgather instead.)"""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
 
 
 def rank():

@@ -15,7 +15,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingRules", "LLAMA_RULES", "BERT_RULES", "named_sharding",
-           "shard_pytree", "replicate_pytree", "logical_to_spec"]
+           "shard_pytree", "replicate_pytree", "reshard_pytree",
+           "logical_to_spec"]
 
 P = PartitionSpec
 
@@ -128,6 +129,19 @@ def shard_pytree(params, rules, mesh):
 def replicate_pytree(params, mesh):
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, NamedSharding(mesh, P())), params)
+
+
+def reshard_pytree(params, rules, mesh):
+    """Re-lay a pytree that may already live on a DIFFERENT (possibly
+    partially dead) mesh onto `mesh`: every leaf is pulled to host first,
+    then placed per `rules`. The elastic-recovery variant of
+    `shard_pytree` — device_put straight from an array whose source
+    devices vanished raises; a host bounce always works, and restored
+    snapshots are host arrays anyway (free)."""
+    import numpy as _np
+    host = jax.tree_util.tree_map(lambda x: _np.asarray(x), params)
+    return shard_pytree(
+        jax.tree_util.tree_map(jax.numpy.asarray, host), rules, mesh)
 
 
 # flax-style logical axis mapping: model code annotates with logical names,

@@ -122,6 +122,7 @@ class ShardedTrainStep:
         self.bucket_mb = bucket_mb
         self._sig_seen = set()   # batch signatures, for the retrace guard
         self._sig_last = None
+        self._batch_spec_arg = batch_spec  # user-given (None = derive)
         data_axes = tuple(a for a in ("data", "fsdp")
                           if a in mesh.axis_names and
                           dict(zip(mesh.axis_names,
@@ -156,6 +157,40 @@ class ShardedTrainStep:
             else:
                 out[key] = self.rules.tree_specs(val, self.mesh)
         return out
+
+    # ------------------------------------------------------------------
+    # elastic re-layout (resilience: the device set changed under the run)
+    # ------------------------------------------------------------------
+    def place(self, params, opt_state):
+        """Re-lay existing (params, opt_state) trees onto THIS step's mesh:
+        rules-derived NamedShardings + device_put — `init()` for state that
+        already has values. The elastic-recovery primitive: a restored
+        snapshot (host arrays) or a live tree from a partially-dead mesh
+        lands sharded across the current device set (every leaf bounces
+        through host — `sharding.reshard_pytree` — because device_put
+        straight off vanished source devices raises)."""
+        import numpy as _np
+        from .sharding import reshard_pytree
+        params = reshard_pytree(params, self.rules, self.mesh)
+        self._param_specs = self.rules.tree_specs(params, self.mesh)
+        opt_state = _tmap(lambda x: jnp.asarray(_np.asarray(x)), opt_state)
+        opt_specs = self._state_specs(opt_state)
+        opt_state = _tmap(
+            lambda x, s: jax.device_put(
+                x, NamedSharding(self.mesh, s)), opt_state, opt_specs)
+        return params, opt_state
+
+    def rebuild_for_mesh(self, mesh):
+        """A fresh step (empty compile cache, re-derived batch spec)
+        targeting `mesh`, with the same loss/rules/optimizer/knobs — the
+        `ResilientRunner` elastic path rebuilds through this after a mesh
+        shrink or grow-back, then re-lays state via `place`."""
+        return ShardedTrainStep(
+            self.loss_fn, self._init_params, mesh, rules=self.rules,
+            optimizer=(self._opt_init, self._opt_update), lr=self.lr,
+            batch_spec=self._batch_spec_arg, grad_accum=self.grad_accum,
+            donate=self.donate, remat=self._remat, bucket_mb=self.bucket_mb,
+            **self.opt_kwargs)
 
     # ------------------------------------------------------------------
     def _build(self, params, opt_state):
@@ -246,6 +281,7 @@ class ShardedTrainStep:
             if prev is not None:
                 _telem.inc("train_step.compile")  # jit retrace = recompile
                 _telem.inc("train_step.retrace")
+                _telem.note_compile("ShardedTrainStep(retrace)")
                 from ..analysis import guard as _guard
                 if _guard.ACTIVE:
                     from ..gluon.block import _retrace_reason
@@ -254,6 +290,7 @@ class ShardedTrainStep:
                         _retrace_reason((True, sig), (True, prev)))
         if self._compiled is None:
             _telem.inc("train_step.compile")
+            _telem.note_compile("ShardedTrainStep")
             self._batch_proto = batch
             self._compiled = self._build(params, opt_state)
         return self._compiled(params, opt_state, batch,

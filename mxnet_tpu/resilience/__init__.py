@@ -17,12 +17,24 @@ fault kills the whole run. The subsystem has four parts, each usable alone:
               of silence (knob: ``MXNET_TPU_STEP_DEADLINE_S``);
 ``run``       `ResilientRunner` — periodic atomic checkpoints, catch
               retriable faults, restore ``latest_step`` and replay, with a
-              max-restart budget and graceful degradation to a smaller
-              mesh when the device set shrinks.
+              max-restart budget and automatic elastic re-layout (rebuild
+              the step + re-shard the state) when the device set shrinks
+              or grows back;
+``commit``    `CommitCoordinator` — two-phase coordinated commit for pod
+              runs: payload first, fleet-wide min-step election over the
+              jax.distributed coordinator, THEN the LATEST marker — every
+              rank restores the same elected step even after a
+              mid-commit crash;
+``preempt``   `PreemptionListener` — SIGTERM + maintenance-event poller
+              (``MXNET_TPU_PREEMPT_POLL_S``) turned into proactive
+              checkpoints: resume replays zero steps instead of a
+              ckpt_every window.
 
 Everything reports through `mx.telemetry`: ``resilience.faults_injected`` /
-``retries`` / ``stalls`` / ``restores`` / ``checkpoints`` counters plus
-chrome-trace spans for backoffs, checkpoints, restores, and stalls.
+``retries`` / ``stalls`` / ``restores`` / ``checkpoints`` /
+``proactive_checkpoints`` / ``mesh_shrinks`` / ``mesh_grows`` /
+``commit.elections`` / ``preempt.notices`` counters plus chrome-trace
+spans for backoffs, checkpoints, restores, and stalls.
 
 Quick start::
 
@@ -34,7 +46,7 @@ Quick start::
         max_restarts=3, step_deadline_s=120)
     report = runner.run(num_steps)
 """
-from . import errors, faults, retry, watchdog, run  # noqa: F401
+from . import errors, faults, retry, watchdog, run, commit, preempt  # noqa: F401
 
 from .errors import (ResilienceError, RetriableError, TransportError,  # noqa: F401
                      InjectedFault, PreemptionError, StallError,
@@ -44,12 +56,17 @@ from .faults import FaultPlan, FaultSpec, inject  # noqa: F401
 from .retry import RetryPolicy, call_with_retry, retriable  # noqa: F401
 from .run import ResilientRunner, RunReport, SnapshotCheckpointer  # noqa: F401
 from .watchdog import Watchdog, guard, heartbeat  # noqa: F401
+from .commit import CommitCoordinator, elect_step  # noqa: F401
+from .preempt import PreemptionListener, PreemptionNotice  # noqa: F401
 
-__all__ = ["errors", "faults", "retry", "watchdog", "run",
+__all__ = ["errors", "faults", "retry", "watchdog", "run", "commit",
+           "preempt",
            "ResilienceError", "RetriableError", "TransportError",
            "InjectedFault", "PreemptionError", "StallError",
            "RetryExhausted", "FatalTrainingError", "classify",
            "is_retriable", "FaultPlan", "FaultSpec", "inject",
            "RetryPolicy", "call_with_retry", "retriable",
            "ResilientRunner", "RunReport", "SnapshotCheckpointer",
-           "Watchdog", "guard", "heartbeat"]
+           "Watchdog", "guard", "heartbeat",
+           "CommitCoordinator", "elect_step",
+           "PreemptionListener", "PreemptionNotice"]

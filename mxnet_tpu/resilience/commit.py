@@ -1,0 +1,174 @@
+"""Coordinated commit: fleet-wide agreement on the latest committed step.
+
+A pod run has one checkpoint stream per rank (a `SnapshotCheckpointer` on
+each host's local disk, or per-host shards of one orbax step dir). Without
+coordination, a rank that crashes *mid-commit* leaves the fleet disagreeing
+about which step is "latest": the crashed rank's disk says N+1, everyone
+else says N, and a naive restore replays different steps on different ranks
+— the collectives that follow deadlock or, worse, silently mix step-N and
+step-N+1 parameters.
+
+The fix is a two-phase protocol, the dynamic analog of a distributed
+transaction commit:
+
+1. **prepare** — every rank makes its snapshot for step N durable
+   (`SnapshotCheckpointer.prepare` / orbax save). The LATEST marker does
+   NOT move yet; a crash here is harmless (the payload is invisible).
+2. **elect + commit** — every rank reports its newest *durable* step and
+   the fleet elects the **minimum** over the `jax.distributed` coordinator
+   (KV store + barrier). Only then does each rank flip its LATEST marker —
+   to the *elected* step, which every rank is guaranteed to have. Restore
+   runs the same election over the ranks' newest committed steps, so even
+   a rank that died between prepare and commit rejoins at the step the
+   rest of the fleet agreed on.
+
+`CommitCoordinator.elect(step)` is the election; it is a collective (every
+rank must call it in lockstep, like a barrier). Single-process runs elect
+trivially (the step itself), so the protocol costs nothing off-pod. For
+unit tests the fleet exchange is injectable via ``gather=`` — hand it a
+callable returning every rank's step and the election logic is testable on
+one process.
+
+Telemetry: ``resilience.commit.elections`` counts rounds,
+``resilience.commit.rank_ahead`` counts rounds where THIS rank had
+prepared past the elected step (the mid-commit-crash shape), and the
+``resilience.commit.elected_step`` gauge tracks the agreed frontier.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+__all__ = ["CommitCoordinator", "elect_step"]
+
+_LOG = logging.getLogger("mxnet_tpu.resilience")
+
+# one election namespace per process; the round counter makes coordinator
+# KV keys unique across successive elections (ranks call elect in lockstep,
+# so a local counter stays globally consistent)
+_ROUND_LOCK = threading.Lock()
+_ROUND = [0]
+
+
+def _next_round():
+    with _ROUND_LOCK:
+        _ROUND[0] += 1
+        return _ROUND[0]
+
+
+def _coordinator_client():
+    """The jax.distributed coordination-service client, or None when this
+    process never rendezvoused (single-process run)."""
+    from ..parallel.dist import coordinator_client
+    return coordinator_client()
+
+
+def _num_processes():
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:  # pragma: no cover - backend not initialized
+        return 1
+
+
+class CommitCoordinator:
+    """Min-step election over the multi-controller runtime.
+
+    gather:     override the fleet exchange — ``gather(step, round_id) ->
+                list[int]`` of every rank's step (testing / custom fabrics).
+    timeout_s:  per-phase coordinator deadline. A rank that dies before the
+                barrier surfaces as a retriable timeout instead of a hang.
+    namespace:  KV-store key prefix (two concurrent checkpoint streams in
+                one job must not share election rounds).
+    """
+
+    def __init__(self, gather=None, timeout_s=60.0,
+                 namespace="mxnet_tpu.commit"):
+        self._gather = gather
+        self.timeout_s = float(timeout_s)
+        self.namespace = namespace
+
+    # ------------------------------------------------------------------
+    def elect(self, step, kind="save"):
+        """Collective: returns the fleet-wide committed step (min over every
+        rank's `step`). `step` may be None (nothing durable on this rank
+        yet) — the election then returns None only if NO rank has a step.
+
+        `kind` tags telemetry AND namespaces the coordinator keys/barrier:
+        every rank must call the same sequence of elections in lockstep
+        (on a pod the faults that trigger restore elections are fleet-wide
+        — a dead collective fails on every rank — so lockstep holds; a
+        rank-local skew, e.g. SIGTERM delivered to one host only, makes a
+        save-election and a restore-election meet at DIFFERENT barrier ids
+        and surface as a loud coordinator timeout instead of silently
+        electing across mismatched rounds)."""
+        from .. import telemetry as _telem
+        round_id = _next_round()
+        steps = self._exchange(step, kind, round_id)
+        present = [s for s in steps if s is not None]
+        elected = min(present) if present else None
+        _telem.inc("resilience.commit.elections")
+        _telem.inc("resilience.commit.elections.%s" % kind)
+        if elected is not None:
+            _telem.set_gauge("resilience.commit.elected_step", elected)
+            if step is not None and step > elected:
+                # this rank prepared past the fleet frontier — exactly the
+                # crashed-mid-commit shape the protocol guards against
+                _telem.inc("resilience.commit.rank_ahead")
+                _LOG.warning(
+                    "commit: this rank prepared step %s but the fleet "
+                    "elected %s — committing the elected step", step, elected)
+        return elected
+
+    # ------------------------------------------------------------------
+    def _exchange(self, step, kind, round_id):
+        if self._gather is not None:
+            return list(self._gather(step, round_id))
+        if _num_processes() <= 1:
+            return [step]
+        client = _coordinator_client()
+        if client is not None:
+            try:
+                return self._exchange_kv(client, step, kind, round_id)
+            except Exception as exc:  # noqa: BLE001 - fall through to DCN
+                _LOG.warning("commit: coordinator KV election failed (%s); "
+                             "falling back to allgather", exc)
+        return self._exchange_allgather(step)
+
+    def _exchange_kv(self, client, step, kind, round_id):
+        """Election over the coordination service: set rank keys, barrier,
+        read every rank's key. The barrier guarantees all writes landed;
+        `kind` in the barrier id makes mis-paired election sequences
+        (one rank saving, another restoring) time out loudly."""
+        import jax
+        rank = jax.process_index()
+        num = jax.process_count()
+        prefix = "%s/%s/round_%d" % (self.namespace, kind, round_id)
+        timeout_ms = int(self.timeout_s * 1000)
+        client.key_value_set("%s/rank_%d" % (prefix, rank),
+                             "none" if step is None else str(int(step)))
+        client.wait_at_barrier("%s/barrier" % prefix, timeout_ms)
+        steps = []
+        for r in range(num):
+            raw = client.blocking_key_value_get(
+                "%s/rank_%d" % (prefix, r), timeout_ms)
+            steps.append(None if raw == "none" else int(raw))
+        return steps
+
+    @staticmethod
+    def _exchange_allgather(step):
+        """Fallback fleet exchange over one DCN allgather (the
+        telemetry.aggregate mechanism) when the coordination-service client
+        is unavailable. None travels as -1."""
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        local = _np.asarray([-1 if step is None else int(step)], _np.int64)
+        gathered = _np.asarray(
+            multihost_utils.process_allgather(local)).reshape(-1)
+        return [None if s < 0 else int(s) for s in gathered]
+
+
+def elect_step(step, kind="save", timeout_s=60.0):
+    """One-shot election with a default coordinator (module-level
+    convenience for the checkpoint layers)."""
+    return CommitCoordinator(timeout_s=timeout_s).elect(step, kind=kind)

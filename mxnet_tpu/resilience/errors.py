@@ -70,22 +70,55 @@ class StallError(RetriableError):
 
     Raised by `resilience.watchdog` *instead of hanging forever* — the
     structured replacement for a run that sits in a dead collective until
-    an operator kills it. Carries the site, the deadline, and a dump of
-    the most recent telemetry spans so the post-mortem starts with data.
+    an operator kills it. Carries the site, the deadline, and a full
+    post-mortem: the most recent telemetry spans (host-side story), the
+    per-device PjRt state (live buffer counts/bytes, allocator watermarks
+    — the device-side story), and the last-compiled executables (what was
+    most recently handed to the device). `format_report()` renders all
+    three as one structured dump.
     """
 
-    def __init__(self, message, site=None, deadline_s=None, span_dump=None):
+    def __init__(self, message, site=None, deadline_s=None, span_dump=None,
+                 device_dump=None, compile_dump=None):
         super().__init__(message)
         self.site = site
         self.deadline_s = deadline_s
         # list of (name, cat, ts_s, dur_s, tid) — telemetry.span_events tail
         self.span_dump = list(span_dump or [])
+        # list of per-device dicts — telemetry.device_report()
+        self.device_dump = list(device_dump or [])
+        # list of (executable_name, ts_s) — telemetry.recent_compiles()
+        self.compile_dump = list(compile_dump or [])
 
     def format_spans(self, limit=20):
         lines = ["recent spans (newest last):"]
         for name, cat, ts_s, dur_s, _tid in self.span_dump[-limit:]:
             lines.append("  %10.3fs %-8s %s (%.3f ms)"
                          % (ts_s, cat, name, dur_s * 1e3))
+        return "\n".join(lines)
+
+    def format_devices(self):
+        if not self.device_dump:
+            return "device state: unavailable"
+        lines = ["device state:"]
+        for entry in self.device_dump:
+            parts = ["  %-8s" % entry.get("device", "?")]
+            for key in ("live_buffers", "live_bytes", "bytes_in_use",
+                        "peak_bytes_in_use", "num_allocs"):
+                if key in entry:
+                    parts.append("%s=%s" % (key, entry[key]))
+            lines.append(" ".join(parts))
+        return "\n".join(lines)
+
+    def format_report(self, span_limit=20):
+        """The one-stop post-mortem: host spans, device state, and the
+        last-compiled executables."""
+        lines = [str(self), "", self.format_spans(limit=span_limit), "",
+                 self.format_devices()]
+        if self.compile_dump:
+            lines.append("last compiled executables (newest last):")
+            for name, ts_s in self.compile_dump[-10:]:
+                lines.append("  %10.3fs %s" % (ts_s, name))
         return "\n".join(lines)
 
 

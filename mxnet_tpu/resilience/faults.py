@@ -1,10 +1,31 @@
 """Deterministic fault injection for comm and train-step call sites.
 
 Every recovery path in this framework must be testable on one chip, with no
-fleet and no luck involved. The instrumented hot paths (kvstore push/pull,
-eager collectives, fused train steps, the resilient runner) call
+fleet and no luck involved. The instrumented hot paths call
 ``faults.check(site)``; when a fault plan is active and one of its entries
-matches (site, nth-call-at-that-site), the harness injects the fault:
+matches (site, nth-call-at-that-site), the harness injects the fault.
+
+Instrumented sites:
+
+``kvstore.push`` / ``kvstore.pull``   per-key store traffic (local + dist)
+``collective.all_reduce`` / ``collective.barrier``   eager collectives
+``train.step``                        inside the fused/sharded step
+``run.step``                          the runner's pre-mutation boundary
+``dist.initialize``                   coordinator rendezvous
+``checkpoint.save``                   AFTER the step payload is durable,
+                                      BEFORE the LATEST marker moves — an
+                                      injected crash here IS the
+                                      "crashed mid-commit a step ahead"
+                                      scenario the commit election guards
+                                      against (SnapshotCheckpointer and
+                                      the orbax path both carry it)
+``checkpoint.restore``                on the way into a restore
+``preempt.poll``                      the maintenance-event poller; a
+                                      ``preempt`` fault here simulates a
+                                      TPU-VM preemption NOTICE (proactive
+                                      checkpoint), not a crash
+
+Fault kinds:
 
 ``error``    raise `InjectedFault` (a TransportError — retriable)
 ``latency``  sleep `arg` seconds, then continue (models a slow endpoint)

@@ -21,14 +21,27 @@ full recovery loop a preemptible fleet needs:
 * **hang watchdog** — each step runs under ``watchdog.guard`` with
   ``step_deadline_s`` (default env ``MXNET_TPU_STEP_DEADLINE_S``), so a dead
   collective becomes a recoverable `StallError` instead of a silent hang;
-* **mesh degradation** — an optional ``mesh_factory`` is re-polled after
-  every restore; when the visible device set shrank (preempted hosts), the
-  ``on_shrink`` hook rebuilds the step for the smaller mesh and training
-  continues degraded instead of dying.
+* **elastic re-layout** — an optional ``mesh_factory`` is re-polled after
+  every restore (and, for grow-back, at every checkpoint boundary); when
+  the visible device set changed, the runner re-lays the restored (or
+  live) training state onto the new mesh and rebuilds the step
+  automatically — the ``for_sharded_step`` / ``for_fused_step`` adapters
+  wire the re-layout; an ``on_shrink`` / ``on_grow`` hook overrides it;
+* **coordinated commit** — with ``commit=`` (a `commit.CommitCoordinator`,
+  or True), checkpoints run the two-phase protocol: payload first, then a
+  fleet-wide min-step election over the jax.distributed coordinator, and
+  only then the LATEST marker — every rank of a pod restores the same
+  *elected* step even when one rank crashed mid-commit a step ahead;
+* **proactive preemption** — with ``preempt_listener=`` (a
+  `preempt.PreemptionListener`, or True), SIGTERM / maintenance-event
+  notices trigger an *immediate* coordinated checkpoint at the next step
+  boundary, so resume replays zero steps instead of a whole
+  ``ckpt_every`` window.
 
-Telemetry: ``resilience.checkpoints`` / ``restores`` / ``mesh_shrinks``
-counters and ``checkpoint`` / ``restore`` chrome-trace spans (retries and
-stalls are counted by their own modules).
+Telemetry: ``resilience.checkpoints`` / ``restores`` / ``mesh_shrinks`` /
+``mesh_grows`` / ``proactive_checkpoints`` counters and ``checkpoint`` /
+``restore`` chrome-trace spans (retries, stalls, elections, and notices
+are counted by their own modules).
 """
 from __future__ import annotations
 
@@ -65,6 +78,15 @@ class SnapshotCheckpointer:
     ``step_N.ckpt`` → rewrite the ``LATEST`` marker the same way. A crash at
     any point leaves either the previous committed state or the new one,
     never a torn file.
+
+    The two phases are also exposed separately for pod-coordinated runs:
+    ``prepare(step, tree)`` makes the payload durable WITHOUT moving the
+    marker, ``commit(step)`` flips the marker — the runner's
+    `commit.CommitCoordinator` election sits between them, so the marker
+    only ever names a step the whole fleet has. Both phases carry fault
+    sites: ``checkpoint.save`` fires after the payload is durable and
+    before the marker moves (the crashed-mid-commit shape), and
+    ``checkpoint.restore`` fires on the way into a restore.
     """
 
     _STEP_RE = re.compile(r"^step_(\d+)\.ckpt$")
@@ -77,13 +99,41 @@ class SnapshotCheckpointer:
     def _file(self, step):
         return os.path.join(self.path, "step_%d.ckpt" % int(step))
 
-    def save(self, step, tree):
-        from ..util import atomic_write, write_latest_marker
+    def prepare(self, step, tree):
+        """Phase 1: make the step's payload durable. The LATEST marker does
+        not move — an uncommitted payload is invisible to `latest_step`
+        (marker precedence) and to any fleet that elects over committed
+        steps. Ends at the ``checkpoint.save`` fault site: an injected
+        crash here IS the mid-commit crash."""
+        from ..util import atomic_write
         atomic_write(self._file(step),
                      pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL))
+        faults.check("checkpoint.save", context="step=%d mid-commit" % step)
+        return self._file(step)
+
+    def commit(self, step):
+        """Phase 2: flip LATEST to `step` and apply retention. Refuses
+        (False) when `step`'s payload is not durable here — an elected
+        step that predates this rank's retention window must not produce
+        a marker pointing at nothing."""
+        from ..util import write_latest_marker
+        if not os.path.exists(self._file(step)):
+            _LOG.warning(
+                "checkpoint: not committing step %s — payload missing "
+                "under %s (marker unchanged)", step, self.path)
+            return False
         write_latest_marker(self.path, step)
         self._retain()
+        return True
+
+    def save(self, step, tree):
+        self.prepare(step, tree)
+        self.commit(step)
         return self._file(step)
+
+    def prepared_steps(self):
+        """Every durable payload, committed or not (directory scan)."""
+        return self.steps()
 
     def steps(self):
         out = []
@@ -109,6 +159,7 @@ class SnapshotCheckpointer:
             if step is None:
                 raise FileNotFoundError(
                     "no checkpoint under %s" % self.path)
+        faults.check("checkpoint.restore", context="step=%d" % step)
         with open(self._file(step), "rb") as f:
             return step, pickle.load(f)
 
@@ -138,14 +189,21 @@ class RunReport:
         self.restarts = 0
         self.retries = 0
         self.steps_executed = 0     # includes replayed steps
+        self.replayed_steps = 0     # re-executed after a restore rewind
         self.checkpoints = 0
+        self.proactive_ckpts = 0    # checkpoints forced by a preempt notice
         self.mesh_shrinks = 0
+        self.mesh_grows = 0
+        self.recovery_time_s = 0.0  # wall time spent inside restores
 
     def __repr__(self):
-        return ("RunReport(steps=%d, executed=%d, restarts=%d, retries=%d, "
-                "checkpoints=%d, mesh_shrinks=%d)"
-                % (len(self.losses), self.steps_executed, self.restarts,
-                   self.retries, self.checkpoints, self.mesh_shrinks))
+        return ("RunReport(steps=%d, executed=%d, replayed=%d, restarts=%d, "
+                "retries=%d, checkpoints=%d, proactive=%d, mesh_shrinks=%d, "
+                "mesh_grows=%d, recovery_time_s=%.3f)"
+                % (len(self.losses), self.steps_executed,
+                   self.replayed_steps, self.restarts, self.retries,
+                   self.checkpoints, self.proactive_ckpts, self.mesh_shrinks,
+                   self.mesh_grows, self.recovery_time_s))
 
 
 class ResilientRunner:
@@ -156,12 +214,26 @@ class ResilientRunner:
     state_get() -> pytree       (host-resident snapshot of ALL mutable
                                  training state)
     state_set(tree)             (restore that snapshot in place)
+
+    relayout(mesh) -> step_fn   (optional: re-lay the CURRENT training
+                                 state onto `mesh` and return the rebuilt
+                                 step — the elastic path; the
+                                 `for_sharded_step` / `for_fused_step`
+                                 adapters provide it automatically, so a
+                                 mesh shrink/grow-back needs no user code.
+                                 `on_shrink` / `on_grow` override it.)
+    commit                      (True or a `commit.CommitCoordinator`:
+                                 two-phase fleet-agreed checkpoints)
+    preempt_listener            (True or a `preempt.PreemptionListener`:
+                                 proactive checkpoint on SIGTERM /
+                                 maintenance notices)
     """
 
     def __init__(self, step_fn, state_get, state_set, ckpt_dir=None,
                  checkpointer=None, ckpt_every=1, keep=2, max_restarts=3,
                  step_deadline_s=None, retry_policy=None, mesh_factory=None,
-                 on_shrink=None, on_stall=None):
+                 on_shrink=None, on_grow=None, relayout=None, on_stall=None,
+                 commit=None, preempt_listener=None):
         if checkpointer is None and ckpt_dir is not None:
             checkpointer = SnapshotCheckpointer(ckpt_dir, keep=keep)
         self.step_fn = step_fn
@@ -176,7 +248,18 @@ class ResilientRunner:
         self.retry_policy = retry_policy or RetryPolicy()
         self.mesh_factory = mesh_factory
         self.on_shrink = on_shrink
+        self.on_grow = on_grow
+        self.relayout = relayout
         self.on_stall = on_stall
+        if commit is True:
+            from .commit import CommitCoordinator
+            commit = CommitCoordinator()
+        self.commit = commit or None
+        self._own_listener = preempt_listener is True
+        if preempt_listener is True:
+            from .preempt import PreemptionListener
+            preempt_listener = PreemptionListener()
+        self.preempt_listener = preempt_listener or None
         self._mesh_size = None
         if mesh_factory is not None:
             mesh = mesh_factory()
@@ -184,55 +267,117 @@ class ResilientRunner:
                                       "size", None)
 
     # ------------------------------------------------------------------
-    def _save(self, step, report):
+    def _save(self, step, report, proactive=False):
         if self.ckpt is None:
             return
         from .. import telemetry as _telem
         with _telem.span("checkpoint", "resilience"):
-            self.ckpt.save(step, self.state_get())
+            tree = self.state_get()
+            if self.commit is not None:
+                # two-phase: payload durable everywhere BEFORE any marker
+                # moves; the marker then names the fleet-elected step
+                self.ckpt.prepare(step, tree)
+                elected = self.commit.elect(step, kind="save")
+                self.ckpt.commit(step if elected is None else elected)
+            else:
+                self.ckpt.save(step, tree)
         _telem.inc("resilience.checkpoints")
         report.checkpoints += 1
+        if proactive:
+            _telem.inc("resilience.proactive_checkpoints")
+            report.proactive_ckpts += 1
 
     def _restore(self, report, cause):
         if self.ckpt is None:
             raise cause
         from .. import telemetry as _telem
+        t0 = time.monotonic()
         with _telem.span("restore", "resilience"):
-            try:
-                step, tree = self.ckpt.restore()
-            except FileNotFoundError:
+            step = self.ckpt.latest_step()
+            if self.commit is not None:
+                # restore election: every rank rewinds to the step the
+                # FLEET committed, not to its own (possibly ahead) marker
+                step = self.commit.elect(step, kind="restore")
+            if step is None:
                 # nothing saved yet (e.g. start_step off the ckpt cadence):
                 # the original fault is the story, not the empty dir
+                raise cause from None
+            try:
+                step, tree = self.ckpt.restore(step)
+            except FileNotFoundError:
                 raise cause from None
             self.state_set(tree)
         _telem.inc("resilience.restores")
         report.restarts += 1
+        report.recovery_time_s += time.monotonic() - t0
         _LOG.warning("resilience: restored step %d after %s: %s",
                      step, type(cause).__name__, cause)
-        self._maybe_shrink(report)
+        self._maybe_relayout(report)
         return step
 
-    def _maybe_shrink(self, report):
-        """Poll the device set; a shrink means preempted hosts — rebuild for
-        the smaller mesh via on_shrink instead of dying on the next
-        collective."""
+    def _maybe_relayout(self, report, grow_only=False):
+        """Poll the device set; on a change, re-lay the current training
+        state onto the new mesh. A shrink means preempted hosts (rebuild
+        smaller instead of dying on the next collective); a grow means
+        capacity returned (rebuild bigger instead of running degraded
+        forever). The `relayout` adapter does the re-laying; `on_shrink` /
+        `on_grow` hooks override it. With grow_only=True (the periodic
+        checkpoint-boundary poll over LIVE state) a shrink is left for the
+        fault path — live arrays on vanished devices must go through
+        restore, not relayout."""
         if self.mesh_factory is None:
             return
         mesh = self.mesh_factory()
         size = getattr(getattr(mesh, "devices", None), "size", None)
-        if (size is not None and self._mesh_size is not None
-                and size < self._mesh_size):
-            from .. import telemetry as _telem
+        if size is None or self._mesh_size is None or size == self._mesh_size:
+            if not grow_only:
+                self._mesh_size = size
+            return
+        from .. import telemetry as _telem
+        if size < self._mesh_size:
+            if grow_only:
+                return
             _telem.inc("resilience.mesh_shrinks")
             report.mesh_shrinks += 1
+            hook = self.on_shrink
             _LOG.warning(
                 "resilience: device set shrank %d -> %d; degrading to the "
                 "smaller mesh", self._mesh_size, size)
-            if self.on_shrink is not None:
-                new_step_fn = self.on_shrink(mesh)
-                if new_step_fn is not None:
-                    self.step_fn = new_step_fn
+        else:
+            _telem.inc("resilience.mesh_grows")
+            report.mesh_grows += 1
+            hook = self.on_grow
+            _LOG.warning(
+                "resilience: device set grew %d -> %d; re-laying state "
+                "back onto the larger mesh", self._mesh_size, size)
+        if hook is None:
+            hook = self.relayout
+        if hook is not None:
+            new_step_fn = hook(mesh)
+            if new_step_fn is not None:
+                self.step_fn = new_step_fn
         self._mesh_size = size
+
+    def _check_preempt(self, step, report):
+        """Step-boundary preemption check: a pending notice triggers an
+        immediate (coordinated, off-cadence) checkpoint, then surfaces as
+        the `PreemptionError` the recovery path already understands —
+        resume replays zero steps instead of a ckpt_every window."""
+        listener = self.preempt_listener
+        if listener is None:
+            return
+        notice = listener.pending()
+        if notice is None:
+            return
+        from .errors import PreemptionError
+        self._save(step, report, proactive=True)
+        listener.clear()
+        raise PreemptionError(
+            "preemption notice (%s): %s%s"
+            % (notice.source, notice.reason,
+               " — proactive checkpoint committed at step %d" % step
+               if self.ckpt is not None
+               else " (no checkpointer configured — nothing saved)"))
 
     # ------------------------------------------------------------------
     def _boundary_check(self, step):
@@ -263,29 +408,46 @@ class ResilientRunner:
         report = RunReport()
         report.losses = [None] * num_steps
         step = start_step
-        if resume and self.ckpt is not None \
-                and self.ckpt.latest_step() is not None:
-            step = self._restore(report, RetriableError("process resume"))
-            report.restarts -= 1  # a requested resume is not a failure
-        last_saved = None
-        while step < num_steps:
-            if (self.ckpt is not None and step % self.ckpt_every == 0
-                    and last_saved != step):
-                self._save(step, report)
-                last_saved = step
-            try:
-                loss = self._run_one(step, report)
-            except RetriableError as exc:
-                if report.restarts >= self.max_restarts:
-                    _LOG.error(
-                        "resilience: restart budget (%d) exhausted",
-                        self.max_restarts)
-                    raise
-                step = self._restore(report, exc)
-                last_saved = step  # that snapshot is already on disk
-                continue
-            report.losses[step] = self._to_float(loss)
-            step += 1
+        if self.preempt_listener is not None:
+            self.preempt_listener.start()
+        try:
+            if resume and self.ckpt is not None \
+                    and self.ckpt.latest_step() is not None:
+                step = self._restore(report,
+                                     RetriableError("process resume"))
+                report.restarts -= 1  # a requested resume is not a failure
+            frontier = step  # first never-executed step (replay detection)
+            last_saved = None
+            while step < num_steps:
+                if (self.ckpt is not None and step % self.ckpt_every == 0
+                        and last_saved != step):
+                    self._save(step, report)
+                    last_saved = step
+                    # grow-back poll: capacity may have returned; re-lay
+                    # the live state onto the larger mesh at a safe
+                    # (just-checkpointed) boundary
+                    self._maybe_relayout(report, grow_only=True)
+                try:
+                    self._check_preempt(step, report)
+                    loss = self._run_one(step, report)
+                except RetriableError as exc:
+                    if report.restarts >= self.max_restarts:
+                        _LOG.error(
+                            "resilience: restart budget (%d) exhausted",
+                            self.max_restarts)
+                        raise
+                    step = self._restore(report, exc)
+                    last_saved = step  # that snapshot is already on disk
+                    continue
+                if step < frontier:
+                    report.replayed_steps += 1
+                else:
+                    frontier = step + 1
+                report.losses[step] = self._to_float(loss)
+                step += 1
+        finally:
+            if self._own_listener and self.preempt_listener is not None:
+                self.preempt_listener.stop()
         return report
 
     @staticmethod
@@ -306,35 +468,67 @@ class ResilientRunner:
         (num_update / per-index counts / schedules), and the mx.random key
         table — kill-and-resume replays the uninterrupted trajectory
         exactly. ``batch_fn(step_idx) -> (data, label)`` must be
-        deterministic per index (re-fetchable for replay)."""
-        data, label = batch_fn(0)
-        if not fused._built:
-            from ..gluon.fused_step import _flatten
-            flat, _ = _flatten(data, "input")
-            fused._build(flat[0].context, data, label)
+        deterministic per index (re-fetchable for replay).
+
+        Elastic: with a ``mesh_factory``, a mesh shrink/grow-back rebuilds
+        the fused step for the new mesh automatically (`rebuild_for_mesh`)
+        — the restored params re-place onto the surviving devices on the
+        rebuilt step's first build. ``on_shrink``/``on_grow`` still
+        override."""
+        from ..gluon.fused_step import _flatten
+
+        def build(f):
+            if not f._built:
+                data, label = batch_fn(0)
+                flat, _ = _flatten(data, "input")
+                f._build(flat[0].context, data, label)
+            return f
+
+        active = {"fused": build(fused)}
 
         def step_fn(i):
             d, l = batch_fn(i)
-            return fused(d, l)
+            return active["fused"](d, l)
 
-        return cls(step_fn,
-                   state_get=lambda: fused_step_state(fused),
-                   state_set=lambda tree: restore_fused_step_state(
-                       fused, tree),
-                   **kwargs)
+        def relayout(mesh):
+            # capture the full (just-restored, or live on grow-back)
+            # training state off the current step, rebuild for the new
+            # mesh, and write the state back — a fresh _build would
+            # otherwise reinitialize the optimizer states it owns
+            tree = fused_step_state(active["fused"])
+            active["fused"] = build(active["fused"].rebuild_for_mesh(mesh))
+            restore_fused_step_state(active["fused"], tree)
+            return step_fn
+
+        kwargs.setdefault("relayout", relayout)
+        runner = cls(step_fn,
+                     state_get=lambda: fused_step_state(active["fused"]),
+                     state_set=lambda tree: restore_fused_step_state(
+                         active["fused"], tree),
+                     **kwargs)
+        runner.active = active
+        return runner
 
     @classmethod
     def for_sharded_step(cls, step, params, opt_state, batch_fn, **kwargs):
         """Wrap a `parallel.ShardedTrainStep` (functional path): the runner
         owns the (params, opt_state) pytrees; read the final values off the
-        returned runner via ``runner.holder``."""
+        returned runner via ``runner.holder``.
+
+        Elastic: with a ``mesh_factory``, a mesh shrink/grow-back is
+        handled automatically — the step is rebuilt for the new mesh
+        (`ShardedTrainStep.rebuild_for_mesh`) and the current
+        params/optimizer trees are re-laid onto it (`place`: fresh
+        rules-derived NamedShardings + device_put). No ``on_shrink`` user
+        code required; the hook remains an override."""
         import jax
         import numpy as _np
         holder = {"params": params, "opt_state": opt_state}
+        active = {"step": step}
 
         def step_fn(i):
-            p, o, loss = step(holder["params"], holder["opt_state"],
-                              batch_fn(i), i)
+            p, o, loss = active["step"](holder["params"],
+                                        holder["opt_state"], batch_fn(i), i)
             holder["params"], holder["opt_state"] = p, o
             return loss
 
@@ -351,8 +545,17 @@ class ResilientRunner:
             holder["opt_state"] = jax.tree_util.tree_map(
                 jnp.asarray, tree["opt_state"])
 
+        def relayout(mesh):
+            new_step = active["step"].rebuild_for_mesh(mesh)
+            holder["params"], holder["opt_state"] = new_step.place(
+                holder["params"], holder["opt_state"])
+            active["step"] = new_step
+            return step_fn
+
+        kwargs.setdefault("relayout", relayout)
         runner = cls(step_fn, state_get, state_set, **kwargs)
         runner.holder = holder
+        runner.active = active
         return runner
 
 

@@ -13,7 +13,11 @@ A single daemon monitor thread tracks every armed guard (one per guarded
 thread). When a deadline passes it:
 
 1. increments ``resilience.stalls`` (+ per-site counter),
-2. snapshots the telemetry span tail — the post-mortem's first page,
+2. snapshots the post-mortem — the telemetry span tail (host story), the
+   per-device PjRt state (live buffer counts/bytes + allocator watermarks,
+   probed while the thread is still stuck in the op), and the
+   last-compiled executables; `StallError.format_report()` renders all of
+   it as one structured dump,
 3. raises `StallError` *asynchronously inside the guarded thread* via
    ``PyThreadState_SetAsyncExc``, and
 4. invokes the guard's ``on_stall`` callback (fleet integration point:
@@ -64,6 +68,29 @@ def _async_raise(tid, exctype):
 
 def _async_clear(tid):
     ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
+
+
+def _probe_devices(timeout_s=2.0):
+    """telemetry.device_report() under a hard timeout: the probe rides a
+    throwaway daemon thread and is abandoned (empty dump) if the PjRt
+    runtime is too wedged to answer — the caller (the watchdog monitor)
+    must never block on it."""
+    from .. import telemetry as _telem
+    result = []
+
+    def probe():
+        try:
+            result.extend(_telem.device_report())
+        except Exception:  # noqa: BLE001 - post-mortem is best-effort
+            pass
+
+    t = threading.Thread(target=probe, name="mxnet_tpu_device_probe",
+                         daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return []  # abandoned; the daemon thread dies with the process
+    return list(result)
 
 
 class _AsyncStall(BaseException):
@@ -135,12 +162,24 @@ class Watchdog:
     def _fire(self, tid, entry):
         """Called without the lock; entry.fired was claimed under it."""
         from .. import telemetry as _telem
+        # the device-side half of the post-mortem: per-device live-buffer
+        # counts/bytes + allocator stats, and the executables most recently
+        # handed to the device. Probed while the stalled thread is still
+        # stuck inside the op — this IS the state of the hang, not of the
+        # cleanup after it. The probe runs on ITS OWN bounded thread: a
+        # runtime wedged hard enough to block memory_stats() must not hang
+        # the single monitor thread (that would silence every other
+        # guard's deadline — the watchdog hanging is the one unacceptable
+        # failure mode).
+        device_dump = _probe_devices(timeout_s=2.0)
         stall = StallError(
             "watchdog: %r exceeded its %.3gs deadline (no heartbeat) — "
             "raising instead of hanging forever"
             % (entry.site, entry.deadline_s),
             site=entry.site, deadline_s=entry.deadline_s,
-            span_dump=_telem.span_events(limit=64))
+            span_dump=_telem.span_events(limit=64),
+            device_dump=device_dump,
+            compile_dump=_telem.recent_compiles(limit=10))
         with self._cond:
             if self._entries.get(tid) is not entry:
                 # the op completed between deadline-claim and now: its guard

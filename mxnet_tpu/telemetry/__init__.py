@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from contextlib import contextmanager
 
@@ -52,6 +53,7 @@ __all__ = ["enabled", "enable", "disable", "registry", "counter", "gauge",
            "snapshot", "reset", "dumps", "dump", "dump_trace", "span_events",
            "aggregate_snapshot", "merge_snapshots",
            "sample_memory", "maybe_sample_memory",
+           "note_compile", "recent_compiles", "device_report",
            "Counter", "Gauge", "Histogram", "Registry"]
 
 # the ONLY state instrumented code reads on the disabled fast path
@@ -169,7 +171,46 @@ def span_events(limit=None):
     return events
 
 
+# ---------------------------------------------------------------- compiles
+# ring of the most recent compiled executables (name, epoch-relative ts) —
+# a stall post-mortem wants "what did we last hand the device", not just a
+# compile *count*. Bounded; guarded by its own lock (the compile paths run
+# on whatever thread dispatched).
+_COMPILE_RING_LIMIT = 32
+_compiles = []
+_compiles_lock = threading.Lock()
+
+
+def note_compile(name):
+    """Record that executable `name` was just (re)compiled — called by
+    CachedOp / FusedTrainStep / ShardedTrainStep next to their `*.compile`
+    counters; surfaces in `recent_compiles()` and stall post-mortems."""
+    if not ENABLED:
+        return
+    ts = _trace.now()
+    with _compiles_lock:
+        _compiles.append((str(name), ts))
+        if len(_compiles) > _COMPILE_RING_LIMIT:
+            del _compiles[:-_COMPILE_RING_LIMIT]
+
+
+def recent_compiles(limit=None):
+    """The newest compiled executables as (name, ts_s) tuples, oldest
+    first."""
+    with _compiles_lock:
+        events = list(_compiles)
+    if limit is not None and len(events) > limit:
+        events = events[-limit:]
+    return events
+
+
 # ---------------------------------------------------------------- memory
+def device_report():
+    """Best-effort per-device PjRt state (allocator stats + live-buffer
+    attribution) for post-mortems — see telemetry.memory.device_report."""
+    return _memory.device_report()
+
+
 def sample_memory():
     """Force one device-memory gauge sample; returns #devices reporting."""
     if not ENABLED:
@@ -190,9 +231,12 @@ def snapshot():
 
 
 def reset():
-    """Drop all metrics and recorded spans (does not change ENABLED)."""
+    """Drop all metrics, recorded spans, and the compile ring (does not
+    change ENABLED)."""
     registry.reset()
     _trace.clear()
+    with _compiles_lock:
+        del _compiles[:]
 
 
 def dumps(format="table"):

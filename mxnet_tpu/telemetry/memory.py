@@ -10,12 +10,18 @@ gauges:
 
 `maybe_sample` rate-limits to one device query per MIN_INTERVAL_S so
 per-step instrumentation can call it unconditionally.
+
+`device_report()` is the post-mortem variant: instead of gauges it returns
+one structured dict per device — platform, allocator stats, and live
+buffer count/bytes attributed per device from `jax.live_arrays()` — the
+PjRt state the resilience watchdog staples onto a `StallError` next to the
+host span dump.
 """
 from __future__ import annotations
 
 import time
 
-__all__ = ["sample", "maybe_sample"]
+__all__ = ["sample", "maybe_sample", "device_report"]
 
 MIN_INTERVAL_S = 1.0
 _last_sample = [0.0]
@@ -54,3 +60,52 @@ def maybe_sample(registry):
         return 0
     _last_sample[0] = now
     return sample(registry)
+
+
+def device_report():
+    """Best-effort per-device PjRt state for post-mortems.
+
+    Returns a list of dicts, one per jax device:
+    ``{"device": "tpu0", "platform": "tpu", "bytes_in_use": ...,
+    "peak_bytes_in_use": ..., "num_allocs": ..., "live_buffers": N,
+    "live_bytes": B}`` — allocator stats from `Device.memory_stats()`
+    (absent keys omitted), live buffers attributed from
+    `jax.live_arrays()` shard placement. Every probe is best-effort: a
+    backend that exposes none of it still yields a row with the device
+    name, so the report never raises."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:
+        return []
+    live_count = {}
+    live_bytes = {}
+    try:
+        for arr in jax.live_arrays():
+            shards = getattr(arr, "addressable_shards", None) or []
+            for shard in shards:
+                dev = shard.device
+                live_count[dev] = live_count.get(dev, 0) + 1
+                data = getattr(shard, "data", None)
+                nbytes = getattr(data, "nbytes", None)
+                if nbytes is not None:
+                    live_bytes[dev] = live_bytes.get(dev, 0) + int(nbytes)
+    except Exception:  # live-array walk is diagnostic only
+        pass
+    report = []
+    for d in devices:
+        entry = {"device": "%s%d" % (d.platform, d.id),
+                 "platform": d.platform}
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        for key in ("bytes_in_use", "peak_bytes_in_use", "num_allocs"):
+            val = (stats or {}).get(key)
+            if val is not None:
+                entry[key] = int(val)
+        if d in live_count:
+            entry["live_buffers"] = live_count[d]
+            entry["live_bytes"] = live_bytes.get(d, 0)
+        report.append(entry)
+    return report

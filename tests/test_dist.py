@@ -197,6 +197,88 @@ def test_dist_bucketed_pushpull_parity_two_workers(tmp_path):
     assert len(sums) == 1
 
 
+COMMIT_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import resilience as rz
+from mxnet_tpu.resilience import commit
+
+kv = mx.kv.create("dist_sync")  # rendezvous: brings up the coordinator
+rank, nw = kv.rank, kv.num_workers
+
+# each rank checkpoints to its OWN directory (per-host local disk shape)
+ck = rz.SnapshotCheckpointer(
+    os.path.join(os.environ["CKPT_ROOT"], "rank_%d" % rank), keep=None)
+for step in (1, 2, 3, 4):
+    ck.save(step, {"w": np.full((2,), float(step)), "step": step})
+# rank 1 "crashed mid-commit a step ahead": step-5 payload durable, marker
+# never flipped
+if rank == 1:
+    ck.prepare(5, {"w": np.full((2,), 5.0), "step": 5})
+
+# restore election over the real jax.distributed coordinator: every rank
+# reports its newest DURABLE step; the fleet restores the elected min
+durable = max(ck.prepared_steps())
+coord = commit.CommitCoordinator()
+elected = coord.elect(durable, kind="restore")
+step, tree = ck.restore(elected)
+
+# a second election round (the save path) proves round ids do not collide
+elected2 = coord.elect(step, kind="save")
+
+out = {"rank": rank, "nw": nw, "durable": durable, "elected": elected,
+       "restored_step": step, "restored_payload": int(tree["step"]),
+       "elected2": elected2}
+with open(os.environ["RESULT_FILE_PREFIX"] + str(rank) + ".json", "w") as f:
+    json.dump(out, f)
+"""
+
+
+@pytest.mark.slow
+def test_dist_commit_election_rank_ahead_by_one(tmp_path):
+    """ISSUE 5 satellite: a rank that crashed mid-commit one step ahead —
+    step-5 payload durable on rank 1 only, marker still at 4 — restores
+    the ELECTED min step (4) on every rank, over the real jax.distributed
+    coordinator."""
+    n = 2
+    script = tmp_path / "commit_worker.py"
+    script.write_text(COMMIT_WORKER)
+    env = dict(os.environ)
+    env.update({
+        "RESULT_FILE_PREFIX": str(tmp_path / "result_"),
+        "CKPT_ROOT": str(tmp_path / "ckpts"),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_FAULT_PLAN", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "--root-port", str(_free_port()),
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    results = []
+    for r in range(n):
+        with open(str(tmp_path / ("result_%d.json" % r))) as f:
+            results.append(json.load(f))
+    by_rank = {res["rank"]: res for res in results}
+    assert by_rank[0]["durable"] == 4
+    assert by_rank[1]["durable"] == 5, "rank 1 must be a step ahead"
+    for res in results:
+        assert res["nw"] == n
+        assert res["elected"] == 4, \
+            "every rank must elect the fleet min: %r" % (res,)
+        assert res["restored_step"] == 4
+        assert res["restored_payload"] == 4
+        assert res["elected2"] == 4
+
+
 # ---------------------------------------------------------------------------
 # 2-bit compression wire format (unit; reference: gradient_compression.cc)
 # ---------------------------------------------------------------------------

@@ -692,3 +692,527 @@ def test_parse_log_resilience_mode(tmp_path):
         capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
     assert "event,site,count" in r.stdout
+
+
+def test_parse_log_resilience_v2_event_rows(tmp_path):
+    """Satellite: elastic/commit/preempt events surface as table rows —
+    shrink, grow-back, commit elections (+ elected-step gauge), proactive
+    checkpoints, preemption notices."""
+    telemetry.reset()
+    telemetry.inc("resilience.mesh_shrinks")
+    telemetry.inc("resilience.mesh_grows")
+    telemetry.inc("resilience.commit.elections", 3)
+    telemetry.inc("resilience.commit.elections.save", 2)
+    telemetry.inc("resilience.commit.elections.restore")
+    telemetry.inc("resilience.commit.rank_ahead")
+    telemetry.inc("resilience.proactive_checkpoints")
+    telemetry.inc("resilience.preempt.notices")
+    telemetry.inc("resilience.preempt.notices.poll")
+    telemetry.set_gauge("resilience.commit.elected_step", 42)
+    dump = str(tmp_path / "telemetry.json")
+    telemetry.dump(dump)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         dump, "--resilience"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    for row in ("| mesh_shrinks | total | 1 |",
+                "| mesh_grows | total | 1 |",
+                "| commit.elections | total | 3 |",
+                "| commit.elections | save | 2 |",
+                "| commit.elections | restore | 1 |",
+                "| commit.rank_ahead | total | 1 |",
+                "| commit.elected_step | latest | 42 |",
+                "| proactive_checkpoints | total | 1 |",
+                "| preempt.notices | total | 1 |",
+                "| preempt.notices | poll | 1 |"):
+        assert row in r.stdout, "missing row %r in:\n%s" % (row, r.stdout)
+
+
+# ---------------------------------------------------------------------------
+# coordinated commit (resilience v2)
+# ---------------------------------------------------------------------------
+def test_commit_election_min_and_rank_ahead_counter():
+    from mxnet_tpu.resilience import commit
+    ahead0 = _counter("resilience.commit.rank_ahead")
+    coord = commit.CommitCoordinator(gather=lambda step, rnd: [step, step - 1])
+    assert coord.elect(5) == 4
+    assert _counter("resilience.commit.rank_ahead") == ahead0 + 1
+    assert _counter("resilience.commit.elections") >= 1
+    snap = telemetry.snapshot()["gauges"]
+    assert snap["resilience.commit.elected_step"]["value"] == 4
+
+
+def test_commit_election_single_process_identity_and_none():
+    from mxnet_tpu.resilience import commit
+    assert commit.elect_step(7) == 7
+    assert commit.CommitCoordinator().elect(None) is None
+    # a rank with nothing durable does not drag the fleet to None
+    coord = commit.CommitCoordinator(gather=lambda step, rnd: [step, None, 3])
+    assert coord.elect(5) == 3
+
+
+def test_checkpointer_two_phase_prepare_commit(tmp_path):
+    """prepare makes the payload durable without moving a committed marker;
+    commit refuses a step whose payload is missing."""
+    ck = rz.SnapshotCheckpointer(str(tmp_path / "ck"), keep=None)
+    ck.save(2, {"w": 2})               # committed baseline
+    ck.prepare(3, {"w": 3})            # durable, NOT committed
+    assert ck.latest_step() == 2, \
+        "an uncommitted payload must not win over the committed marker"
+    assert 3 in ck.prepared_steps()
+    assert ck.commit(9) is False       # no payload -> marker unchanged
+    assert ck.latest_step() == 2
+    assert ck.commit(3) is True
+    assert ck.latest_step() == 3
+
+
+def test_mid_commit_crash_resumes_at_committed_step(tmp_path):
+    """checkpoint.save fault site (satellite): a crash AFTER the payload is
+    durable but BEFORE the marker moves (the rank-ahead shape) resumes at
+    the last COMMITTED step; the stray newer payload is invisible."""
+    batch_fn = _six_batches()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    clean = [float(fused_a(*batch_fn(i)).asnumpy()) for i in range(6)]
+
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    # saves land at steps 0, 2, 4: the 3rd save (step 4) dies mid-commit
+    with faults.inject("checkpoint.save:preempt:3"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=2)
+        with pytest.raises(PreemptionError):
+            runner.run(6)
+    ck = rz.SnapshotCheckpointer(str(tmp_path / "ck"))
+    assert 4 in ck.prepared_steps(), "step-4 payload must be durable"
+    assert ck.latest_step() == 2, "marker must still name the committed step"
+
+    # relaunch: resumes from the committed step and reproduces the clean run
+    runner2 = rz.ResilientRunner.for_fused_step(
+        fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=2)
+    report = runner2.run(6, resume=True)
+    tail = [l for l in report.losses if l is not None]
+    np.testing.assert_allclose(clean[-len(tail):], tail,
+                               rtol=1e-5, atol=1e-6)
+    for (ka, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                 sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=ka)
+
+
+def test_sharded_checkpoint_coordinated_mid_commit_crash(tmp_path):
+    """Orbax path: `coordinated=True` + the checkpoint.save fault site —
+    a crash between payload-durable and marker-flip leaves the committed
+    view at the previous step, and `restore_sharded(coordinated=True)`
+    restores it (the stray newer payload stays invisible)."""
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    path = str(tmp_path / "ck")
+    ckpt.save_sharded(path, {"w": np.ones((2,))}, step=1, coordinated=True)
+    assert ckpt.latest_committed_step(path) == 1
+    with faults.inject("checkpoint.save:preempt:1"):
+        with pytest.raises(PreemptionError):
+            ckpt.save_sharded(path, {"w": np.ones((2,)) * 2}, step=2,
+                              coordinated=True)
+    # the step-2 payload is durable (scan sees it) but NOT committed
+    assert ckpt.latest_step(path) == 2
+    assert ckpt.latest_committed_step(path) == 1
+    restored = ckpt.restore_sharded(path, coordinated=True)
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.ones((2,)))
+
+
+def test_commit_restore_election_agrees_across_simulated_ranks(tmp_path):
+    """Two simulated ranks, rank1 a prepared step ahead (crashed
+    mid-commit): the restore election lands every rank on the elected min
+    step."""
+    from mxnet_tpu.resilience import commit
+    cks = [rz.SnapshotCheckpointer(str(tmp_path / ("rank%d" % r)))
+           for r in range(2)]
+    for step in (1, 2, 3, 4):
+        for ck in cks:
+            ck.save(step, {"w": np.full((2,), float(step)), "step": step})
+    cks[1].prepare(5, {"w": np.full((2,), 5.0), "step": 5})  # rank1 ahead
+
+    # the fleet exchange: every rank reports its newest DURABLE step
+    durable = [max(ck.prepared_steps()) for ck in cks]
+    assert durable == [4, 5]
+    fleet = {}
+
+    def gather_for(rank):
+        def gather(step, rnd):
+            fleet[rank] = step
+            return [durable[0], durable[1]]
+        return gather
+
+    restored = []
+    for rank, ck in enumerate(cks):
+        coord = commit.CommitCoordinator(gather=gather_for(rank))
+        elected = coord.elect(durable[rank], kind="restore")
+        step, tree = ck.restore(elected)
+        restored.append((step, tree["step"]))
+    assert restored == [(4, 4), (4, 4)], restored
+
+
+def test_runner_coordinated_save_commits_elected_step(tmp_path):
+    """_save under a CommitCoordinator: the marker names the fleet-elected
+    min, not this rank's (newer) prepared step."""
+    from mxnet_tpu.resilience import commit
+    state = {"w": 0.0}
+    runner = rz.ResilientRunner(
+        lambda i: 0.0, state_get=lambda: dict(state),
+        state_set=lambda t: state.update(t),
+        ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+        commit=commit.CommitCoordinator(
+            gather=lambda step, rnd: [step, max(0, step - 1)]))
+    report = runner.run(3)
+    ck = runner.ckpt
+    # last save prepared step 2; the fleet's laggard was at 1 -> marker 1
+    assert 2 in ck.prepared_steps()
+    assert ck.latest_step() == 1
+    assert report.checkpoints == 3
+
+
+# ---------------------------------------------------------------------------
+# proactive preemption (resilience v2)
+# ---------------------------------------------------------------------------
+def test_preempt_listener_poll_notice_via_fault_plan():
+    from mxnet_tpu.resilience.preempt import PreemptionListener
+    notices0 = _counter("resilience.preempt.notices")
+    with faults.inject("preempt.poll:preempt:1"):
+        listener = PreemptionListener(poll_interval_s=0.01)
+        listener.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while listener.pending() is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            notice = listener.pending()
+        finally:
+            listener.stop()
+    assert notice is not None, "poller never observed the planned event"
+    assert notice.source == "poll"
+    assert "preemption" in notice.reason
+    assert _counter("resilience.preempt.notices") == notices0 + 1
+    assert _counter("resilience.preempt.notices.poll") >= 1
+
+
+def test_preempt_listener_sigterm_notice():
+    import signal
+    from mxnet_tpu.resilience.preempt import PreemptionListener
+    seen = []
+    listener = PreemptionListener(poll_fn=False,
+                                  on_notice=lambda n: seen.append(n))
+    listener.start()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while listener.pending() is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        notice = listener.pending()
+    finally:
+        listener.stop()
+    assert notice is not None and notice.source == "sigterm"
+    assert seen and seen[0] is notice
+    # handler restored: a second listener can install again
+    assert signal.getsignal(signal.SIGTERM) not in (listener._handle_sigterm,)
+
+
+def test_runner_proactive_checkpoint_zero_replay(tmp_path):
+    """ISSUE acceptance: a simulated preemption notice produces a proactive
+    checkpoint — resume replays ZERO steps (vs up to ckpt_every-1 for a
+    periodic-snapshot-only recovery) and the trajectory still matches the
+    fault-free run."""
+    from mxnet_tpu.resilience.preempt import PreemptionListener
+    batch_fn = _six_batches()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    clean = [float(fused_a(*batch_fn(i)).asnumpy()) for i in range(6)]
+
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    proactive0 = _counter("resilience.proactive_checkpoints")
+    with faults.inject("preempt.poll:preempt:1"):
+        listener = PreemptionListener(poll_interval_s=0.01).start()
+        try:
+            # deterministic: the notice is pending BEFORE the run begins
+            deadline = time.monotonic() + 5.0
+            while listener.pending() is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert listener.pending() is not None
+            # ckpt_every=5: without the proactive save, recovery would
+            # rewind to step 0 and replay
+            runner = rz.ResilientRunner.for_fused_step(
+                fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"),
+                ckpt_every=5, max_restarts=2, preempt_listener=listener)
+            report = runner.run(6)
+        finally:
+            listener.stop()
+    assert report.proactive_ckpts == 1
+    assert report.replayed_steps == 0, \
+        "proactive checkpoint must make the preemption replay-free"
+    assert report.restarts == 1
+    assert _counter("resilience.proactive_checkpoints") == proactive0 + 1
+    np.testing.assert_allclose(clean, report.losses, rtol=1e-5, atol=1e-6)
+
+
+def test_runner_reactive_preemption_replays_for_contrast(tmp_path):
+    """The ledger distinguishes reactive from proactive: a hard preemption
+    off the checkpoint cadence replays completed steps."""
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    with faults.inject("run.step:preempt:5"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused, _six_batches(), ckpt_dir=str(tmp_path / "ck"),
+            ckpt_every=3, max_restarts=2)
+        report = runner.run(6)
+    # preempt at step 4; last snapshot at step 3 -> step 3 replays? no:
+    # steps 0..3 completed, preempt at step 4, restore to 3, steps 3,4
+    # re-run — step 3 was completed before, so exactly 1 replay
+    assert report.replayed_steps == 1
+    assert report.recovery_time_s > 0.0
+
+
+# ---------------------------------------------------------------------------
+# device-aware stall post-mortems (resilience v2)
+# ---------------------------------------------------------------------------
+def test_stall_post_mortem_includes_device_state():
+    """ISSUE acceptance: StallError carries per-device PjRt state (live
+    buffer counts/bytes) and the last-compiled executables next to the
+    host span dump — one structured report."""
+    import jax.numpy as jnp
+    keep_alive = jnp.ones((16, 16))  # a live buffer the report must see
+    telemetry.note_compile("test_executable")
+    with pytest.raises(StallError) as ei:
+        with faults.inject("pm.site:hang:1:30"):
+            with watchdog.guard("pm.site", deadline_s=0.25):
+                faults.check("pm.site")
+    err = ei.value
+    assert err.device_dump, "StallError must carry the device dump"
+    entry = err.device_dump[0]
+    assert "device" in entry and "platform" in entry
+    assert any("live_buffers" in e for e in err.device_dump), \
+        "at least one device must report live buffers: %r" % err.device_dump
+    total_bufs = sum(e.get("live_buffers", 0) for e in err.device_dump)
+    assert total_bufs >= 1
+    assert any(name == "test_executable" for name, _ in err.compile_dump)
+    report = err.format_report()
+    assert "recent spans" in report
+    assert "device state:" in report
+    assert "live_buffers=" in report
+    assert "test_executable" in report
+    del keep_alive
+
+
+def test_telemetry_device_report_shape():
+    report = telemetry.device_report()
+    assert isinstance(report, list) and report
+    for entry in report:
+        assert "device" in entry and "platform" in entry
+
+
+def test_telemetry_recent_compiles_ring():
+    telemetry.reset()
+    for i in range(40):
+        telemetry.note_compile("exe_%d" % i)
+    events = telemetry.recent_compiles()
+    assert len(events) <= 32
+    assert events[-1][0] == "exe_39"
+    assert telemetry.recent_compiles(limit=3)[0][0] == "exe_37"
+
+
+# ---------------------------------------------------------------------------
+# elastic re-sharding (resilience v2 tentpole)
+# ---------------------------------------------------------------------------
+def _exact_sharded_fixture(steps=6):
+    """Binary data + dyadic hyperparameters: every sum in the train step is
+    exactly representable in fp32, so ANY reduction order — any mesh —
+    produces bit-identical results. That turns cross-mesh parity into an
+    equality assertion instead of a tolerance."""
+    import jax.numpy as jnp
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(3)
+    X = rng.randint(0, 2, (steps, 16, 4)).astype(np.float32)
+    Y = rng.randint(0, 2, (steps, 16, 2)).astype(np.float32)
+
+    def batch_fn(i):
+        return {"x": jnp.asarray(X[i]), "y": jnp.asarray(Y[i])}
+
+    def make(n):
+        from mxnet_tpu.parallel import ShardedTrainStep, create_mesh
+        import jax.numpy as jnp
+        mesh = create_mesh(data=n)
+        params = {"w": jnp.zeros((4, 2))}
+        step = ShardedTrainStep(loss_fn, params, mesh, optimizer="sgd",
+                                lr=0.5, momentum=0.5, donate=False)
+        return step, step.init()
+
+    return batch_fn, make
+
+
+def test_runner_elastic_reshard_on_mesh_shrink(tmp_path):
+    """ISSUE acceptance: mesh-shrink fault -> the runner re-shards the
+    restored snapshot onto the smaller mesh automatically (NO on_shrink
+    callback) and the final params are bit-identical to an uninterrupted
+    run on that mesh."""
+    from mxnet_tpu.parallel import create_mesh
+    batch_fn, make = _exact_sharded_fixture()
+
+    # the acceptance reference: uninterrupted run entirely on the small mesh
+    step_a, (pa, oa) = make(1)
+    clean = []
+    for i in range(6):
+        pa, oa, l = step_a(pa, oa, batch_fn(i), i)
+        clean.append(float(l))
+
+    sizes = {"n": 2}
+
+    def mesh_factory():
+        return create_mesh(data=sizes["n"])
+
+    shrinks0 = _counter("resilience.mesh_shrinks")
+    step_b, (pb, ob) = make(2)
+    with faults.inject("run.step:preempt:4"):
+        runner = rz.ResilientRunner.for_sharded_step(
+            step_b, pb, ob, batch_fn, ckpt_dir=str(tmp_path / "ck"),
+            ckpt_every=1, max_restarts=2, mesh_factory=mesh_factory)
+        sizes["n"] = 1  # the preemption takes half the fleet
+        report = runner.run(6)
+    assert report.restarts == 1 and report.mesh_shrinks == 1
+    assert _counter("resilience.mesh_shrinks") == shrinks0 + 1
+    # params update through LINEAR gradient math (exact on binary data);
+    # the loss itself squares the residual, which can round differently
+    # per mesh — so params get the bit-equality assertion, losses a tight
+    # tolerance
+    np.testing.assert_allclose(clean, report.losses, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(runner.holder["params"]["w"]),
+                                  np.asarray(pa["w"]))
+    # the state really lives on the smaller mesh now
+    assert len(runner.holder["params"]["w"].sharding.device_set) == 1
+    # and the rebuilt step targets it
+    assert runner.active["step"].mesh.devices.size == 1
+
+
+def test_runner_elastic_grow_back(tmp_path):
+    """Capacity returns mid-run: the checkpoint-boundary poll re-lays the
+    LIVE state back onto the larger mesh (no fault, no restore) and the
+    trajectory is unchanged."""
+    from mxnet_tpu.parallel import create_mesh
+    batch_fn, make = _exact_sharded_fixture()
+
+    step_a, (pa, oa) = make(1)
+    clean = []
+    for i in range(6):
+        pa, oa, l = step_a(pa, oa, batch_fn(i), i)
+        clean.append(float(l))
+
+    sizes = {"n": 1}
+
+    def mesh_factory():
+        return create_mesh(data=sizes["n"])
+
+    grows0 = _counter("resilience.mesh_grows")
+    step_b, (pb, ob) = make(1)
+    runner = rz.ResilientRunner.for_sharded_step(
+        step_b, pb, ob, batch_fn, ckpt_dir=str(tmp_path / "ck"),
+        ckpt_every=2, mesh_factory=mesh_factory)
+    sizes["n"] = 2  # capacity comes back; the step-2 boundary poll sees it
+    report = runner.run(6)
+    assert report.mesh_grows == 1 and report.restarts == 0
+    assert _counter("resilience.mesh_grows") == grows0 + 1
+    np.testing.assert_allclose(clean, report.losses, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(runner.holder["params"]["w"]),
+                                  np.asarray(pa["w"]))
+    assert len(runner.holder["params"]["w"].sharding.device_set) == 2
+    assert runner.active["step"].mesh.devices.size == 2
+
+
+def test_on_shrink_hook_still_overrides_auto_reshard(tmp_path):
+    """Back-compat: a user on_shrink hook wins over the automatic
+    relayout."""
+    from mxnet_tpu.parallel import create_mesh
+    batch_fn, make = _exact_sharded_fixture()
+    sizes = {"n": 2}
+
+    def mesh_factory():
+        return create_mesh(data=sizes["n"])
+
+    called = []
+    step_b, (pb, ob) = make(2)
+    with faults.inject("run.step:preempt:3"):
+        runner = rz.ResilientRunner.for_sharded_step(
+            step_b, pb, ob, batch_fn, ckpt_dir=str(tmp_path / "ck"),
+            ckpt_every=1, max_restarts=2, mesh_factory=mesh_factory,
+            on_shrink=lambda mesh: called.append(mesh.devices.size) or None)
+        sizes["n"] = 1
+        report = runner.run(4)
+    assert called == [1]
+    assert report.mesh_shrinks == 1
+
+
+def test_fused_step_elastic_rebuild_on_shrink(tmp_path):
+    """Gluon path: a mesh-aware FusedTrainStep is rebuilt for the smaller
+    mesh automatically, optimizer state carried across; the run completes
+    and matches the fault-free trajectory."""
+    from mxnet_tpu.parallel import create_mesh
+    batch_fn = _six_batches()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a,
+                                   mesh=create_mesh(data=2))
+    clean = [float(fused_a(*batch_fn(i)).asnumpy()) for i in range(6)]
+
+    sizes = {"n": 2}
+
+    def mesh_factory():
+        return create_mesh(data=sizes["n"])
+
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b,
+                                   mesh=create_mesh(data=2))
+    with faults.inject("run.step:preempt:4"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+            max_restarts=2, mesh_factory=mesh_factory)
+        sizes["n"] = 1
+        report = runner.run(6)
+    assert report.restarts == 1 and report.mesh_shrinks == 1
+    assert runner.active["fused"] is not fused_b, "step must be rebuilt"
+    assert runner.active["fused"]._mesh.devices.size == 1
+    np.testing.assert_allclose(clean, report.losses, rtol=1e-4, atol=1e-5)
+    for (ka, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                 sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=ka)
+
+
+def test_sharded_step_place_and_rebuild_unit():
+    """ShardedTrainStep.place re-lays host trees onto the step's mesh with
+    rules-derived shardings; rebuild_for_mesh preserves knobs."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import ShardedTrainStep, create_mesh
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"]) ** 2)
+
+    mesh2 = create_mesh(data=2)
+    step = ShardedTrainStep(loss_fn, {"w": jnp.zeros((4, 2))}, mesh2,
+                            optimizer="sgd", lr=0.5, momentum=0.5,
+                            donate=False, grad_accum=1)
+    params, opt = step.init()
+    mesh1 = create_mesh(data=1)
+    rebuilt = step.rebuild_for_mesh(mesh1)
+    assert rebuilt.mesh is mesh1
+    assert rebuilt.lr == step.lr and rebuilt.donate == step.donate
+    assert rebuilt.opt_kwargs == step.opt_kwargs
+    p2, o2 = rebuilt.place({"w": np.ones((4, 2), np.float32)},
+                           {"mom": {"w": np.zeros((4, 2), np.float32)}})
+    assert len(p2["w"].sharding.device_set) == 1
+    np.testing.assert_array_equal(np.asarray(p2["w"]), np.ones((4, 2)))
+    assert len(o2["mom"]["w"].sharding.device_set) == 1

@@ -78,9 +78,15 @@ def _print_telemetry(rows, fmt):
 
 
 # the headline resilience events, in narrative order; per-site counters
-# (resilience.retries.kvstore.push, ...) list after their total
+# (resilience.retries.kvstore.push, ...) list after their total. The v2
+# events tell the elastic/commit/preempt story: shrink and grow-back,
+# commit elections (+ rank_ahead = mid-commit-crash recoveries), and
+# proactive (notice-triggered) checkpoints.
 _RESILIENCE_EVENTS = ("faults_injected", "retries", "retry_exhausted",
-                      "stalls", "restores", "checkpoints", "mesh_shrinks")
+                      "stalls", "restores", "checkpoints",
+                      "proactive_checkpoints", "mesh_shrinks", "mesh_grows",
+                      "commit.elections", "commit.rank_ahead",
+                      "preempt.notices")
 
 
 def parse_resilience(obj):
@@ -99,6 +105,10 @@ def parse_resilience(obj):
         for name in sorted(counters):
             if name.startswith(prefix):
                 rows.append((event, name[len(prefix):], counters[name]))
+    # the commit-elected step rides a gauge (it is a frontier, not a count)
+    elected = obj.get("gauges", {}).get("resilience.commit.elected_step")
+    if elected is not None:
+        rows.append(("commit.elected_step", "latest", elected.get("value")))
     # unknown resilience.* counters (future events) still surface
     known = {"resilience.%s" % e for e in _RESILIENCE_EVENTS}
     for name in sorted(counters):
