@@ -125,7 +125,11 @@ def on_retrace(name, n_signatures, reason):
     paths. Counts always; warns/raises once past the distinct-signature
     limit."""
     from .. import telemetry as _telem
+    from ..telemetry import flight as _flight
     _telem.inc("analysis.guard.retrace")
+    # the reason feeds the crash flight recorder: a retrace storm right
+    # before a hang/OOM is the single most common post-mortem headline
+    _flight.note_retrace(name, reason)
     limit = retrace_limit()
     if n_signatures <= limit:
         return

@@ -631,6 +631,8 @@ class FusedTrainStep:
             _telem.observe("fused_step.step_ms", dur * 1e3)
             _telem.record_span("fused_step", "step", ts, dur)
             _telem.maybe_sample_memory()
+            # telemetry v2: anomaly detection + crash flight recorder
+            _telem.step_event("fused_step", dur * 1e3)
 
     def _step(self, data, label):
         # injection-only resilience site (hang/preempt/latency testable on

@@ -204,6 +204,8 @@ class Trainer:
             _telem.observe("trainer.step_ms", dur * 1e3)
             _telem.record_span("trainer.step", "step", ts, dur)
             _telem.maybe_sample_memory()
+            # telemetry v2: anomaly detection + crash flight recorder
+            _telem.step_event("trainer", dur * 1e3)
 
     def _step_impl(self, batch_size, ignore_stale_grad):
         rescale_grad = self._scale / batch_size

@@ -264,6 +264,8 @@ class ShardedTrainStep:
             _telem.observe("train_step.step_ms", dur * 1e3)
             _telem.record_span("train_step", "step", ts, dur)
             _telem.maybe_sample_memory()
+            # telemetry v2: anomaly detection + crash flight recorder
+            _telem.step_event("train_step", dur * 1e3)
 
     def _step(self, params, opt_state, batch, step_num):
         from ..resilience import faults as _faults

@@ -29,9 +29,21 @@ unit tests the fleet exchange is injectable via ``gather=`` — hand it a
 callable returning every rank's step and the election logic is testable on
 one process.
 
+Election rounds write per-rank keys into the coordinator KV store; a
+months-long run with a checkpoint every few minutes would grow that store
+without bound. After every KV election the coordinator therefore runs a
+**cleanup round**: it deletes this rank's keys from every *earlier* round
+it wrote — including rounds whose own election died in the barrier (a
+flaky coordinator must not leak one key per failed election). Lockstep
+makes that safe: a rank passes round N's barrier only after every rank
+entered round N, i.e. finished reading every earlier round, so no reader
+can still want a deleted key. Steady state is ≤ 2 rounds of keys live
+per rank, regardless of run length.
+
 Telemetry: ``resilience.commit.elections`` counts rounds,
 ``resilience.commit.rank_ahead`` counts rounds where THIS rank had
-prepared past the elected step (the mid-commit-crash shape), and the
+prepared past the elected step (the mid-commit-crash shape),
+``resilience.commit.cleanups`` counts reclaimed rounds, and the
 ``resilience.commit.elected_step`` gauge tracks the agreed frontier.
 """
 from __future__ import annotations
@@ -87,6 +99,14 @@ class CommitCoordinator:
         self._gather = gather
         self.timeout_s = float(timeout_s)
         self.namespace = namespace
+        # (kind, round_id) of every KV round this instance WROTE a key for
+        # and has not yet reclaimed. Recorded at write time (not after the
+        # reads) so a round whose barrier times out still gets cleaned by
+        # the next successful election instead of leaking forever.
+        self._cleanup_lock = threading.Lock()
+        self._pending_rounds = []
+
+    _PENDING_ROUNDS_CAP = 64  # bound the ledger under a flaky coordinator
 
     # ------------------------------------------------------------------
     def elect(self, step, kind="save"):
@@ -147,13 +167,53 @@ class CommitCoordinator:
         timeout_ms = int(self.timeout_s * 1000)
         client.key_value_set("%s/rank_%d" % (prefix, rank),
                              "none" if step is None else str(int(step)))
+        with self._cleanup_lock:
+            # recorded BEFORE the barrier: a round that dies in the
+            # barrier/reads below still gets reclaimed by the next
+            # successful election
+            self._pending_rounds.append((kind, round_id))
+            del self._pending_rounds[:-self._PENDING_ROUNDS_CAP]
         client.wait_at_barrier("%s/barrier" % prefix, timeout_ms)
         steps = []
         for r in range(num):
             raw = client.blocking_key_value_get(
                 "%s/rank_%d" % (prefix, r), timeout_ms)
             steps.append(None if raw == "none" else int(raw))
+        self.cleanup_round(client, rank, kind, round_id)
         return steps
+
+    def cleanup_round(self, client, rank, kind, round_id):
+        """Reclaim this rank's keys from every earlier round it wrote
+        (including rounds whose election failed mid-way). Safe because we
+        run AFTER passing the CURRENT round's barrier: a rank can only be
+        at that barrier once every rank entered this round, i.e. finished
+        reading every earlier round — the deletes can race nothing.
+        Best-effort: a coordinator without delete support just grows (the
+        pre-cleanup behavior), it does not fail the checkpoint; failed
+        deletes stay on the ledger for the next election. Returns the
+        number of rounds reclaimed."""
+        from .. import telemetry as _telem
+        with self._cleanup_lock:
+            stale = [rd for rd in self._pending_rounds
+                     if rd != (kind, round_id)]
+        reclaimed = []
+        for old_kind, old_round in stale:
+            key = "%s/%s/round_%d/rank_%d" % (self.namespace, old_kind,
+                                              old_round, rank)
+            try:
+                client.key_value_delete(key)
+            except Exception as exc:  # noqa: BLE001 — cleanup must never
+                # fail the election that triggered it
+                _LOG.debug("commit: cleanup of %s failed: %s", key, exc)
+                continue
+            reclaimed.append((old_kind, old_round))
+            _telem.inc("resilience.commit.cleanups")
+        if reclaimed:
+            with self._cleanup_lock:
+                self._pending_rounds = [
+                    rd for rd in self._pending_rounds
+                    if rd not in reclaimed]
+        return len(reclaimed)
 
     @staticmethod
     def _exchange_allgather(step):

@@ -73,13 +73,14 @@ class StallError(RetriableError):
     an operator kills it. Carries the site, the deadline, and a full
     post-mortem: the most recent telemetry spans (host-side story), the
     per-device PjRt state (live buffer counts/bytes, allocator watermarks
-    — the device-side story), and the last-compiled executables (what was
-    most recently handed to the device). `format_report()` renders all
-    three as one structured dump.
+    — the device-side story), the last-compiled executables (what was
+    most recently handed to the device), and the flight-recorder ring
+    (the last N steps' ledger — what the run was DOING when it died).
+    `format_report()` renders all of it as one structured dump.
     """
 
     def __init__(self, message, site=None, deadline_s=None, span_dump=None,
-                 device_dump=None, compile_dump=None):
+                 device_dump=None, compile_dump=None, flight_dump=None):
         super().__init__(message)
         self.site = site
         self.deadline_s = deadline_s
@@ -89,6 +90,8 @@ class StallError(RetriableError):
         self.device_dump = list(device_dump or [])
         # list of (executable_name, ts_s) — telemetry.recent_compiles()
         self.compile_dump = list(compile_dump or [])
+        # list of per-step dicts — telemetry.flight_records() tail
+        self.flight_dump = list(flight_dump or [])
 
     def format_spans(self, limit=20):
         lines = ["recent spans (newest last):"]
@@ -110,15 +113,21 @@ class StallError(RetriableError):
             lines.append(" ".join(parts))
         return "\n".join(lines)
 
+    def format_flight(self, limit=10):
+        from ..telemetry.flight import format_records
+        return format_records(self.flight_dump, limit=limit)
+
     def format_report(self, span_limit=20):
-        """The one-stop post-mortem: host spans, device state, and the
-        last-compiled executables."""
+        """The one-stop post-mortem: host spans, device state, the
+        last-compiled executables, and the flight-recorder step ledger."""
         lines = [str(self), "", self.format_spans(limit=span_limit), "",
                  self.format_devices()]
         if self.compile_dump:
             lines.append("last compiled executables (newest last):")
             for name, ts_s in self.compile_dump[-10:]:
                 lines.append("  %10.3fs %s" % (ts_s, name))
+        lines.append("")
+        lines.append(self.format_flight())
         return "\n".join(lines)
 
 

@@ -37,7 +37,7 @@ import threading
 import time
 
 __all__ = ["PreemptionNotice", "PreemptionListener", "default_poll",
-           "default_poll_interval_s", "POLL_SITE"]
+           "default_poll_interval_s", "default_grace_s", "POLL_SITE"]
 
 _LOG = logging.getLogger("mxnet_tpu.resilience")
 
@@ -53,15 +53,34 @@ def default_poll_interval_s():
         return 5.0
 
 
+def default_grace_s():
+    """The announced grace window: how long after the notice the host is
+    expected to survive. TPU-VM maintenance SIGTERMs give ~30 s; override
+    with MXNET_TPU_PREEMPT_GRACE_S for other fabrics."""
+    try:
+        return float(os.environ.get("MXNET_TPU_PREEMPT_GRACE_S", "30"))
+    except (TypeError, ValueError):
+        return 30.0
+
+
 class PreemptionNotice:
-    """One pending preemption announcement."""
+    """One pending preemption announcement, with the hard deadline it
+    implies (`received_at + grace`) — the runner budgets its proactive
+    checkpoint against `remaining_s()`."""
 
-    __slots__ = ("reason", "source", "received_at")
+    __slots__ = ("reason", "source", "received_at", "deadline")
 
-    def __init__(self, reason, source):
+    def __init__(self, reason, source, grace_s=None):
         self.reason = reason
         self.source = source          # "sigterm" | "poll" | custom
         self.received_at = time.time()
+        if grace_s is None:
+            grace_s = default_grace_s()
+        self.deadline = self.received_at + float(grace_s)
+
+    def remaining_s(self):
+        """Seconds left in the announced grace window (can go negative)."""
+        return self.deadline - time.time()
 
     def __repr__(self):
         return "PreemptionNotice(%r, source=%r)" % (self.reason, self.source)
@@ -117,7 +136,7 @@ class PreemptionListener:
     """
 
     def __init__(self, poll_fn=None, poll_interval_s=None, sigterm=True,
-                 on_notice=None):
+                 on_notice=None, grace_s=None):
         # poll_fn: None = the default (fault plan + metadata server),
         # False = signal-only listener, callable = custom fabric
         if poll_fn is None:
@@ -125,6 +144,8 @@ class PreemptionListener:
         elif poll_fn is False:
             poll_fn = None
         self._poll_fn = poll_fn
+        self.grace_s = (default_grace_s() if grace_s is None
+                        else float(grace_s))
         self._poll_interval_s = (default_poll_interval_s()
                                  if poll_interval_s is None
                                  else float(poll_interval_s))
@@ -199,7 +220,7 @@ class PreemptionListener:
         with self._lock:
             if self._notice is not None:
                 return self._notice
-            notice = PreemptionNotice(reason, source)
+            notice = PreemptionNotice(reason, source, grace_s=self.grace_s)
             self._notice = notice
         _LOG.warning("preempt: %s notice — %s (checkpointing at the next "
                      "step boundary)", source, reason)

@@ -53,7 +53,7 @@ import shutil
 import time
 
 from . import faults, watchdog
-from .errors import RetriableError, TransportError
+from .errors import ResilienceError, RetriableError, TransportError
 from .retry import RetryPolicy, call_with_retry
 
 __all__ = ["SnapshotCheckpointer", "ResilientRunner", "RunReport",
@@ -260,6 +260,10 @@ class ResilientRunner:
             from .preempt import PreemptionListener
             preempt_listener = PreemptionListener()
         self.preempt_listener = preempt_listener or None
+        # last few save durations (rolling, this runner's own saves) —
+        # the SIGTERM budgeter's evidence
+        from collections import deque
+        self._save_ms_window = deque(maxlen=8)
         self._mesh_size = None
         if mesh_factory is not None:
             mesh = mesh_factory()
@@ -271,6 +275,8 @@ class ResilientRunner:
         if self.ckpt is None:
             return
         from .. import telemetry as _telem
+        from ..telemetry import flight as _flight
+        t0 = time.monotonic()
         with _telem.span("checkpoint", "resilience"):
             tree = self.state_get()
             if self.commit is not None:
@@ -281,11 +287,35 @@ class ResilientRunner:
                 self.ckpt.commit(step if elected is None else elected)
             else:
                 self.ckpt.save(step, tree)
+        # the save-cost ledger the SIGTERM budgeter reads: skipping a
+        # proactive save is only safe when we KNOW how slow saves run
+        save_ms = (time.monotonic() - t0) * 1e3
+        _telem.observe("ckpt.save_ms", save_ms)
+        self._save_ms_window.append(save_ms)
         _telem.inc("resilience.checkpoints")
+        _flight.note_event("proactive_ckpt" if proactive else "checkpoint",
+                           "step=%d" % step)
         report.checkpoints += 1
         if proactive:
             _telem.inc("resilience.proactive_checkpoints")
             report.proactive_ckpts += 1
+
+    def _worst_save_ms(self):
+        """Max save time over the last few saves THIS runner made, or —
+        before its first save — the process-local ckpt.save_ms histogram's
+        max as a coarse prior (a previous runner in this process, or a
+        caller-seeded estimate; the registry does not survive a process
+        relaunch). None with no history at all. A rolling window, not the
+        lifetime max: one cold-compile outlier save must not disable
+        proactive checkpoints for the rest of a long run once saves are
+        fast again."""
+        if self._save_ms_window:
+            return max(self._save_ms_window)
+        from .. import telemetry as _telem
+        hist = _telem.registry.get("ckpt.save_ms")
+        if hist is None:
+            return None
+        return hist.snapshot().get("max")
 
     def _restore(self, report, cause):
         if self.ckpt is None:
@@ -308,6 +338,9 @@ class ResilientRunner:
                 raise cause from None
             self.state_set(tree)
         _telem.inc("resilience.restores")
+        from ..telemetry import flight as _flight
+        _flight.note_event("restore", "step=%d cause=%s"
+                           % (step, type(cause).__name__))
         report.restarts += 1
         report.recovery_time_s += time.monotonic() - t0
         _LOG.warning("resilience: restored step %d after %s: %s",
@@ -358,26 +391,68 @@ class ResilientRunner:
                 self.step_fn = new_step_fn
         self._mesh_size = size
 
+    # margin over the rolling max save time when deciding whether a
+    # proactive save still fits the announced grace window
+    _SAVE_BUDGET_MARGIN = 1.5
+
     def _check_preempt(self, step, report):
         """Step-boundary preemption check: a pending notice triggers an
         immediate (coordinated, off-cadence) checkpoint, then surfaces as
         the `PreemptionError` the recovery path already understands —
-        resume replays zero steps instead of a ckpt_every window."""
+        resume replays zero steps instead of a ckpt_every window.
+
+        Deadline awareness: the notice carries the announced grace window
+        (~30 s SIGTERM contract). When the remaining window cannot fit the
+        rolling max save time (`ckpt.save_ms` × margin), the save is
+        SKIPPED — a checkpoint the host dies in the middle of is worse
+        than replaying from the last good one — and recovery falls back to
+        restore-and-replay."""
         listener = self.preempt_listener
         if listener is None:
             return
         notice = listener.pending()
         if notice is None:
             return
+        from .. import telemetry as _telem
+        from ..telemetry import flight as _flight
         from .errors import PreemptionError
-        self._save(step, report, proactive=True)
+        _flight.note_event("preempt_notice",
+                           "%s: %s" % (notice.source, notice.reason))
+        saved = False
+        if self.ckpt is not None:
+            remaining_s = notice.remaining_s()
+            worst_ms = self._worst_save_ms()
+            # no budget at all (stale/late notice, or a long step ate the
+            # window) means skip even with no save history — starting a
+            # save with zero budget GUARANTEES the torn-write outcome
+            over_budget = remaining_s <= 0 or (
+                worst_ms is not None and
+                worst_ms * self._SAVE_BUDGET_MARGIN / 1e3 > remaining_s)
+            if over_budget:
+                _telem.inc("resilience.preempt.save_skipped")
+                _flight.note_event("preempt_save_skipped",
+                                   "worst=%sms remaining=%.1fs"
+                                   % ("%.0f" % worst_ms
+                                      if worst_ms is not None else "?",
+                                      remaining_s))
+                _LOG.warning(
+                    "preempt: SKIPPING the proactive save — worst save "
+                    "%s ms (×%.1f margin) does not fit the %.1f s left "
+                    "in the grace window; will restore-and-replay instead",
+                    "%.0f" % worst_ms if worst_ms is not None else "?",
+                    self._SAVE_BUDGET_MARGIN, remaining_s)
+            else:
+                self._save(step, report, proactive=True)
+                saved = True
         listener.clear()
         raise PreemptionError(
             "preemption notice (%s): %s%s"
             % (notice.source, notice.reason,
                " — proactive checkpoint committed at step %d" % step
-               if self.ckpt is not None
-               else " (no checkpointer configured — nothing saved)"))
+               if saved
+               else (" (proactive save skipped — grace window too short)"
+                     if self.ckpt is not None
+                     else " (no checkpointer configured — nothing saved)")))
 
     # ------------------------------------------------------------------
     def _boundary_check(self, step):
@@ -445,6 +520,18 @@ class ResilientRunner:
                     frontier = step + 1
                 report.losses[step] = self._to_float(loss)
                 step += 1
+        except ResilienceError as exc:
+            # the run is dying on a fault recovery could not absorb
+            # (restart budget spent, fatal classification, mid-commit
+            # wreckage): drop the flight recorder's step ledger to disk
+            # BEFORE the exception unwinds the evidence
+            from ..telemetry import flight as _flight
+            path = _flight.dump_on_crash(
+                "%s: %s" % (type(exc).__name__, exc),
+                dir_hint=getattr(self.ckpt, "path", None))
+            if path:
+                _LOG.error("resilience: flight recorder dumped to %s", path)
+            raise
         finally:
             if self._own_listener and self.preempt_listener is not None:
                 self.preempt_listener.stop()

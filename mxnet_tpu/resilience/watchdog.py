@@ -179,7 +179,8 @@ class Watchdog:
             site=entry.site, deadline_s=entry.deadline_s,
             span_dump=_telem.span_events(limit=64),
             device_dump=device_dump,
-            compile_dump=_telem.recent_compiles(limit=10))
+            compile_dump=_telem.recent_compiles(limit=10),
+            flight_dump=_telem.flight_records(limit=32))
         with self._cond:
             if self._entries.get(tid) is not entry:
                 # the op completed between deadline-claim and now: its guard

@@ -35,6 +35,26 @@ Export: `snapshot()` (dict), `dumps(format='table'|'json')`,
 host-side trace, the analog of the reference's `Profiler::DumpProfile`.
 `mx.profiler.dumps()` also embeds the counter snapshot, so the existing
 profiler API surfaces telemetry.
+
+Telemetry v2 — the LIVE observability plane on top of the registry:
+
+* `telemetry.export` — a Prometheus `/metrics`+`/snapshot` HTTP endpoint
+  (`MXNET_TPU_METRICS_PORT`) and a periodic JSONL snapshot streamer
+  (`MXNET_TPU_METRICS_STREAM`), both off by default and fully inert when
+  telemetry is disabled; `tools/mxtop.py` is the matching dashboard;
+* cross-rank correlation — every chrome-trace dump is stamped with this
+  worker's rank and a run-wide `trace_id()`; `aggregate_trace()` exchanges
+  span events fleet-wide and `dump_trace(merged=True)` writes ONE trace
+  with a process row per rank on a shared clock;
+* `telemetry.flight` — a crash flight recorder: bounded ring of per-step
+  records (step ms, comm deltas, compiles/retrace reasons, anomalies,
+  resilience events), embedded in watchdog post-mortems and auto-dumped
+  on fatal resilience errors / unhandled exceptions;
+* `telemetry.anomaly` — rolling-median step-time spike + SLO detection
+  (`telemetry.anomaly.*` counters, `anomaly@<site>` marker spans) and the
+  rolling p50/p99 step-latency quantiles the exporter and bench rows
+  report. `step_event(site, ms)` is the one call the instrumented step
+  paths make to feed both.
 """
 from __future__ import annotations
 
@@ -42,18 +62,22 @@ import json
 import os
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 
 from .metrics import Counter, Gauge, Histogram, Registry
-from .trace import TraceBuffer, write_chrome_trace
+from .trace import (TraceBuffer, write_chrome_trace,
+                    write_merged_chrome_trace)
 from . import memory as _memory
 
 __all__ = ["enabled", "enable", "disable", "registry", "counter", "gauge",
            "histogram", "inc", "set_gauge", "observe", "span", "record_span",
            "snapshot", "reset", "dumps", "dump", "dump_trace", "span_events",
-           "aggregate_snapshot", "merge_snapshots",
+           "aggregate_snapshot", "merge_snapshots", "aggregate_trace",
            "sample_memory", "maybe_sample_memory",
            "note_compile", "recent_compiles", "device_report",
+           "trace_id", "set_trace_id", "safe_rank", "local_trace_dump",
+           "step_event", "step_quantiles", "flight_records",
            "Counter", "Gauge", "Histogram", "Registry"]
 
 # the ONLY state instrumented code reads on the disabled fast path
@@ -69,8 +93,14 @@ def enabled():
 
 
 def enable():
+    """Turn telemetry on at runtime. Also (re-)checks the live-export env
+    knobs: a process that started under MXNET_TPU_TELEMETRY=0 with
+    MXNET_TPU_METRICS_PORT set gets its endpoint the moment telemetry is
+    switched on, not never."""
     global ENABLED
     ENABLED = True
+    from . import export as _export
+    _export.maybe_start_from_env()
 
 
 def disable():
@@ -171,6 +201,47 @@ def span_events(limit=None):
     return events
 
 
+# ---------------------------------------------------------------- identity
+# the run-wide trace id every span dump / flight dump / stream line carries.
+# MXNET_TPU_TRACE_ID pins it fleet-wide from the launcher; otherwise each
+# process draws its own and `aggregate_trace()` unifies on rank 0's at the
+# first collective exchange.
+_RUN_LOCK = threading.Lock()
+_RUN = {"trace_id": os.environ.get("MXNET_TPU_TRACE_ID") or None}
+
+
+def trace_id():
+    """The run-wide trace id (lazily drawn; stable for the process life)."""
+    with _RUN_LOCK:
+        if _RUN["trace_id"] is None:
+            _RUN["trace_id"] = uuid.uuid4().hex[:16]
+        return _RUN["trace_id"]
+
+
+def set_trace_id(value):
+    """Adopt a trace id (rank 0's, via `aggregate_trace`; or an external
+    orchestrator's)."""
+    with _RUN_LOCK:
+        _RUN["trace_id"] = str(value)
+
+
+def safe_rank():
+    """This worker's rank WITHOUT triggering backend init: the dist state
+    when rendezvoused, the launcher env otherwise. (dist.rank() falls back
+    to jax.process_index(), which would initialize the platform — too heavy
+    for a metrics scrape or an import-time exporter.)"""
+    try:
+        from ..parallel.dist import _STATE
+        if _STATE.get("initialized"):
+            return int(_STATE["rank"])
+    except Exception:  # noqa: BLE001 - identity is best-effort
+        pass
+    try:
+        return int(os.environ.get("DMLC_WORKER_ID", "0") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
 # ---------------------------------------------------------------- compiles
 # ring of the most recent compiled executables (name, epoch-relative ts) —
 # a stall post-mortem wants "what did we last hand the device", not just a
@@ -231,12 +302,15 @@ def snapshot():
 
 
 def reset():
-    """Drop all metrics, recorded spans, and the compile ring (does not
-    change ENABLED)."""
+    """Drop all metrics, recorded spans, the compile ring, the flight
+    recorder, and the anomaly windows (does not change ENABLED)."""
     registry.reset()
     _trace.clear()
     with _compiles_lock:
         del _compiles[:]
+    from . import anomaly as _anomaly, flight as _flight
+    _anomaly.reset()
+    _flight.reset()
 
 
 def dumps(format="table"):
@@ -250,13 +324,71 @@ def dump(path, format="json"):
     return path
 
 
-def dump_trace(path=None):
-    """Write recorded spans + counters as chrome://tracing JSON.
-    Default path: telemetry_trace.json in the cwd. Returns the path."""
+def dump_trace(path=None, merged=False):
+    """Write recorded spans + counters as chrome://tracing JSON, stamped
+    with this worker's rank and the run trace id. Default path:
+    telemetry_trace.json in the cwd. Returns the path.
+
+    merged=True exchanges span events fleet-wide first (`aggregate_trace`
+    — collective: every worker must call it in lockstep) and writes ONE
+    trace with a process row per rank on a shared wall-clock base, so
+    cross-rank overlap (comm buckets vs compute) is visible in a single
+    chrome://tracing load. Single-process merged dumps are local-only."""
     if path is None:
         path = "telemetry_trace.json"
-    write_chrome_trace(path, _trace, registry)
+    if merged:
+        dumps_by_rank = aggregate_trace()
+        write_merged_chrome_trace(path, dumps_by_rank, registry,
+                                  local_rank=safe_rank())
+    else:
+        write_chrome_trace(path, _trace, registry, rank=safe_rank(),
+                           trace_id=trace_id())
     return path
+
+
+def local_trace_dump():
+    """This worker's span events + identity — the per-rank unit
+    `aggregate_trace` exchanges."""
+    return {"rank": safe_rank(), "trace_id": trace_id(),
+            "epoch_unix": _trace.epoch_unix,
+            "events": [list(e) for e in _trace.events()]}
+
+
+def aggregate_trace(dump=None):
+    """Fleet-wide span-event exchange (collective — lockstep like
+    `aggregate_snapshot`); returns `[{rank, trace_id, epoch_unix, events}]`
+    sorted by rank. See telemetry/aggregate.py."""
+    from .aggregate import aggregate_trace as _agg
+    return _agg(dump)
+
+
+# ---------------------------------------------------------------- step plane
+def step_event(site, dur_ms):
+    """One call per training/serving step from the instrumented step paths
+    (`trainer` / `fused_step` / `train_step`): runs anomaly detection over
+    the duration and appends a flight-recorder record with this step's
+    counter deltas. No-op when disabled."""
+    if not ENABLED:
+        return
+    from . import anomaly as _anomaly, flight as _flight
+    fired = _anomaly.observe(site, dur_ms)
+    _flight.record_step(site, dur_ms, anomalies=fired)
+
+
+def step_quantiles(site=None):
+    """Rolling p50/p99 step-latency quantiles: one site's dict, or
+    {site: dict} for all sites when `site` is None."""
+    from . import anomaly as _anomaly
+    if site is not None:
+        return _anomaly.quantiles(site)
+    return _anomaly.quantiles_all()
+
+
+def flight_records(limit=None):
+    """The flight recorder's step records, oldest first (see
+    telemetry/flight.py); the watchdog embeds the tail in `StallError`."""
+    from . import flight as _flight
+    return _flight.records(limit=limit)
 
 
 def aggregate_snapshot(snapshot=None):
@@ -274,3 +406,14 @@ def merge_snapshots(snaps):
     `aggregate_snapshot`) — usable on dumps collected out-of-band."""
     from .aggregate import merge_snapshots as _merge
     return _merge(snaps)
+
+
+# ------------------------------------------------------------- live export
+# start whatever live transports the env configures (MXNET_TPU_METRICS_PORT
+# endpoint / MXNET_TPU_METRICS_STREAM JSONL). Both default OFF; when
+# telemetry is disabled this is a pure no-op — no thread, no port — which
+# tests assert. Import order matters: `export` reads this module's ENABLED
+# and registry, both defined above.
+from . import export  # noqa: E402  (needs ENABLED/registry above)
+
+export.maybe_start_from_env()

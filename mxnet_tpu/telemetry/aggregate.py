@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["merge_snapshots", "aggregate_snapshot"]
+__all__ = ["merge_snapshots", "aggregate_snapshot", "aggregate_trace"]
 
 
 def _merge_hist(a, b):
@@ -99,3 +99,33 @@ def aggregate_snapshot(snapshot=None):
     blobs = _exchange_json(
         json.dumps(snapshot, sort_keys=True).encode("utf-8"))
     return merge_snapshots([json.loads(b.decode("utf-8")) for b in blobs])
+
+
+def aggregate_trace(dump=None):
+    """Fleet-wide span exchange: every worker's recorded span events (plus
+    its rank, trace id, and the wall-clock anchor of its span epoch) over
+    the same length-padded allgather `aggregate_snapshot` rides. Returns
+    `[{rank, trace_id, epoch_unix, events}]` sorted by rank — the input
+    shape of `trace.write_merged_chrome_trace`.
+
+    The run-wide trace id is unified here: every worker adopts rank 0's,
+    so a merged dump (and every later per-rank dump) names ONE run.
+
+    Collective on multi-worker runtimes — call in lockstep, like
+    `aggregate_snapshot`. Single-process: returns the local dump only.
+    """
+    from .. import telemetry as _telem
+    from ..parallel import dist
+    if dump is None:
+        dump = _telem.local_trace_dump()
+    if dist.num_workers() <= 1:
+        return [dump]
+    blobs = _exchange_json(json.dumps(dump).encode("utf-8"))
+    dumps = sorted((json.loads(b.decode("utf-8")) for b in blobs),
+                   key=lambda d: int(d.get("rank", 0)))
+    run_id = dumps[0].get("trace_id")
+    if run_id:
+        _telem.set_trace_id(run_id)
+        for d in dumps:
+            d["trace_id"] = run_id
+    return dumps

@@ -1,0 +1,358 @@
+"""Live metrics export: Prometheus `/metrics` endpoint + JSONL streamer.
+
+Until now telemetry was post-hoc: `dump()` after the run — which is exactly
+when a wedged fleet can no longer produce it. This module makes the
+registry observable WHILE the run is alive, with two transports:
+
+* **HTTP endpoint** — ``MXNET_TPU_METRICS_PORT=<port>`` (or
+  `start_http_server(port)`) serves, from one daemon thread:
+  - ``/metrics`` — Prometheus text exposition (counters as counters,
+    gauges as value+``_max`` watermark pairs, histograms as cumulative
+    ``_bucket{le=...}``/``_sum``/``_count`` series), every sample labeled
+    with this worker's rank;
+  - ``/snapshot`` — the raw `telemetry.snapshot()` dict plus rolling
+    step-latency quantiles, rank, and the run trace id (what
+    `tools/mxtop.py` polls);
+  - ``/healthz`` — liveness.
+* **JSONL stream** — ``MXNET_TPU_METRICS_STREAM=<path>`` appends one
+  `/snapshot`-shaped JSON line every ``MXNET_TPU_METRICS_STREAM_S``
+  (default 5) seconds from a daemon thread — the no-port transport for
+  batch fleets whose only artifact channel is a file (mxtop tails it).
+
+Both transports read through `Registry.snapshot()`, i.e. under the
+registry lock with per-metric-atomic reads — a scrape racing a step thread
+sees a consistent registry. Both are OFF by default and fully inert under
+``MXNET_TPU_TELEMETRY=0``: no thread is started and no port is bound even
+when the env vars are set.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["prometheus_text", "snapshot_payload", "parse_prometheus_text",
+           "start_http_server", "stop_http_server", "start_stream",
+           "stop_stream", "maybe_start_from_env", "MetricsServer",
+           "SnapshotStreamer", "default_stream_interval_s"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+_PREFIX = "mxnet_tpu_"
+
+
+def _telem():
+    from .. import telemetry
+    return telemetry
+
+
+def default_stream_interval_s():
+    try:
+        return max(0.05,
+                   float(os.environ.get("MXNET_TPU_METRICS_STREAM_S", "5")))
+    except (TypeError, ValueError):
+        return 5.0
+
+
+# ------------------------------------------------------------- text format
+def _sanitize(name):
+    return _PREFIX + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _fmt_value(v):
+    if v is None:
+        return "NaN"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def _hist_bounds(buckets):
+    """Snapshot bucket keys ("le_0.01", ..., "le_inf") ordered by bound."""
+    def key(k):
+        raw = k[len("le_"):]
+        return float("inf") if raw == "inf" else float(raw)
+    return sorted(buckets, key=key)
+
+
+def prometheus_text(snap=None, rank=None):
+    """Render a `telemetry.snapshot()` dict in the Prometheus text
+    exposition format (0.0.4). Each HELP line carries the original dotted
+    metric name, so a scrape is parseable back counter-for-counter
+    (`parse_prometheus_text` is the inverse — the parity tests use it)."""
+    telem = _telem()
+    if snap is None:
+        snap = telem.snapshot()
+    if rank is None:
+        rank = telem.safe_rank()
+    label = '{rank="%d"}' % int(rank)
+    lines = []
+    for name, value in snap.get("counters", {}).items():
+        san = _sanitize(name)
+        lines.append("# HELP %s %s" % (san, name))
+        lines.append("# TYPE %s counter" % san)
+        lines.append("%s%s %s" % (san, label, _fmt_value(value)))
+    for name, g in snap.get("gauges", {}).items():
+        san = _sanitize(name)
+        lines.append("# HELP %s %s" % (san, name))
+        lines.append("# TYPE %s gauge" % san)
+        lines.append("%s%s %s" % (san, label, _fmt_value(g.get("value"))))
+        lines.append("# TYPE %s_max gauge" % san)
+        lines.append("%s_max%s %s" % (san, label, _fmt_value(g.get("max"))))
+    for name, h in snap.get("histograms", {}).items():
+        san = _sanitize(name)
+        lines.append("# HELP %s %s" % (san, name))
+        lines.append("# TYPE %s histogram" % san)
+        cum = 0
+        buckets = h.get("buckets", {})
+        for k in _hist_bounds(buckets):
+            bound = k[len("le_"):]
+            if bound == "inf":
+                continue
+            cum += buckets[k]
+            lines.append('%s_bucket{rank="%d",le="%s"} %d'
+                         % (san, int(rank), bound, cum))
+        lines.append('%s_bucket{rank="%d",le="+Inf"} %d'
+                     % (san, int(rank), h.get("count", 0)))
+        lines.append("%s_sum%s %s" % (san, label, _fmt_value(h.get("sum"))))
+        lines.append("%s_count%s %s" % (san, label,
+                                        _fmt_value(h.get("count"))))
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text):
+    """Parse a `/metrics` scrape back into {original_name: value} for the
+    counter series (the parity-test inverse of `prometheus_text`). HELP
+    lines map the sanitized series name back to the dotted original."""
+    help_map = {}
+    types = {}
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            san, _, orig = rest.partition(" ")
+            help_map[san] = orig
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            san, _, kind = rest.partition(" ")
+            types[san] = kind
+        elif line and not line.startswith("#"):
+            series, _, value = line.rpartition(" ")
+            san = series.split("{", 1)[0]
+            if types.get(san) == "counter" and san in help_map:
+                out[help_map[san]] = int(float(value))
+    return out
+
+
+def snapshot_payload():
+    """The JSON body both live transports emit: the registry snapshot plus
+    identity (rank, trace id) and rolling step-latency quantiles."""
+    telem = _telem()
+    from . import anomaly
+    return {
+        "ts": time.time(),
+        "rank": telem.safe_rank(),
+        "trace_id": telem.trace_id(),
+        "snapshot": telem.snapshot(),
+        "step_quantiles": anomaly.quantiles_all(),
+        "flight_steps": len(_flight_recorder()),
+    }
+
+
+def _flight_recorder():
+    from . import flight
+    return flight._RECORDER
+
+
+# ------------------------------------------------------------- HTTP server
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet-tpu-telemetry"
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = prometheus_text().encode("utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path in ("/", "/snapshot"):
+                body = json.dumps(snapshot_payload()).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/healthz":
+                body = b"ok\n"
+                ctype = "text/plain"
+            else:
+                self.send_error(404, "unknown path %r" % path)
+                return
+        except Exception as exc:  # noqa: BLE001 — a scrape bug must not
+            # take down the serving thread
+            self.send_error(500, "telemetry export failed: %s" % exc)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        _LOG.debug("metrics http: " + format, *args)
+
+
+class MetricsServer:
+    """ThreadingHTTPServer on a daemon thread; `close()` releases the
+    port synchronously (tests bind successive free ports)."""
+
+    def __init__(self, port, host="0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="mxnet_tpu_metrics_http", daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+class SnapshotStreamer:
+    """Daemon thread appending one `snapshot_payload()` JSON line to `path`
+    every `interval_s` seconds (and once more on `close()`, so short runs
+    still leave a final line)."""
+
+    def __init__(self, path, interval_s=None):
+        self.path = os.path.abspath(path)
+        self.interval_s = (default_stream_interval_s()
+                           if interval_s is None else float(interval_s))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="mxnet_tpu_metrics_stream", daemon=True)
+        self._thread.start()
+
+    def _write_line(self):
+        try:
+            line = json.dumps(snapshot_payload())
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except Exception as exc:  # noqa: BLE001 — a full disk must not kill
+            # the streamer (the run matters more than its metrics)
+            _LOG.debug("metrics stream write failed: %s", exc)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self._write_line()
+
+    def close(self):
+        if not self._stop.is_set():
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            self._write_line()  # final flush: the run's last word
+
+
+# ---------------------------------------------------------- module control
+_STATE = {"server": None, "streamer": None, "atexit_registered": False}
+_STATE_LOCK = threading.Lock()
+
+
+def start_http_server(port=None, host=None):
+    """Start (or return the running) metrics endpoint. Returns None —
+    binding nothing — when telemetry is disabled or no port is configured.
+    Binds MXNET_TPU_METRICS_HOST (default 0.0.0.0 — remote scraping is
+    the point of a Prometheus endpoint; set 127.0.0.1 on shared-tenant
+    networks, the payload names checkpoints and resilience activity)."""
+    if not _telem().ENABLED:
+        return None
+    if host is None:
+        host = os.environ.get("MXNET_TPU_METRICS_HOST") or "0.0.0.0"
+    if port is None:
+        raw = os.environ.get("MXNET_TPU_METRICS_PORT", "")
+        if not raw or raw == "0":
+            return None
+        try:
+            port = int(raw)
+            if not 0 < port < 65536:
+                raise ValueError("out of range")
+        except ValueError:
+            # a typo in the env var must not kill `import mxnet_tpu`
+            _LOG.warning("telemetry: ignoring malformed "
+                         "MXNET_TPU_METRICS_PORT=%r (want a port number)",
+                         raw)
+            return None
+    with _STATE_LOCK:
+        if _STATE["server"] is None:
+            _STATE["server"] = MetricsServer(port, host=host)
+            _LOG.info("telemetry: /metrics endpoint on port %d",
+                      _STATE["server"].port)
+        return _STATE["server"]
+
+
+def stop_http_server():
+    with _STATE_LOCK:
+        server, _STATE["server"] = _STATE["server"], None
+    if server is not None:
+        server.close()
+
+
+def start_stream(path=None, interval_s=None):
+    """Start (or return the running) JSONL snapshot streamer. Returns None
+    when telemetry is disabled or no path is configured."""
+    if not _telem().ENABLED:
+        return None
+    if path is None:
+        path = os.environ.get("MXNET_TPU_METRICS_STREAM", "")
+        if not path:
+            return None
+    with _STATE_LOCK:
+        if _STATE["streamer"] is None:
+            _STATE["streamer"] = SnapshotStreamer(path,
+                                                  interval_s=interval_s)
+            _LOG.info("telemetry: streaming snapshots to %s",
+                      _STATE["streamer"].path)
+        return _STATE["streamer"]
+
+
+def stop_stream():
+    with _STATE_LOCK:
+        streamer, _STATE["streamer"] = _STATE["streamer"], None
+    if streamer is not None:
+        streamer.close()
+
+
+def maybe_start_from_env():
+    """Import-time hook: start whichever transports the env configures.
+    Inert (no thread, no port) unless telemetry is enabled AND a knob is
+    set; binding failures log a warning instead of killing the import (two
+    workers on one host sharing a port must not crash the run)."""
+    server = streamer = None
+    # broad except: NOTHING a bad env knob provokes (bind failure, bad
+    # port value, read-only stream path) may crash the interpreter's
+    # import of mxnet_tpu
+    try:
+        server = start_http_server()
+    except Exception as exc:  # noqa: BLE001 — see above
+        _LOG.warning("telemetry: could not bind MXNET_TPU_METRICS_PORT: %s",
+                     exc)
+    try:
+        streamer = start_stream()
+    except Exception as exc:  # noqa: BLE001 — see above
+        _LOG.warning("telemetry: could not open MXNET_TPU_METRICS_STREAM: "
+                     "%s", exc)
+    if server is not None or streamer is not None:
+        # the streamer's close() writes the FINAL line (a run shorter than
+        # one interval would otherwise leave an empty stream file); the
+        # server close releases the port promptly on interpreter exit.
+        # Registered once — enable() re-runs this path freely.
+        with _STATE_LOCK:
+            need = not _STATE["atexit_registered"]
+            _STATE["atexit_registered"] = True
+        if need:
+            import atexit
+            atexit.register(stop_stream)
+            atexit.register(stop_http_server)
+    return server, streamer

@@ -1,0 +1,346 @@
+"""Crash flight recorder: a bounded ring of per-step context that survives
+to the post-mortem.
+
+Telemetry counters say *how much*; a crash dump needs *what just happened*.
+The flight recorder keeps the last ``MXNET_TPU_FLIGHT_STEPS`` (default 256)
+step records — step duration, comm volume and collectives launched this
+step, compiles/retraces (with the guard's retrace reasons), the device
+memory watermark, anomaly flags, and any resilience events (checkpoints,
+restores, preemption notices) that landed since the previous step — fed by
+the instrumented step paths (Trainer / FusedTrainStep / ShardedTrainStep
+via `telemetry.step_event`).
+
+The ring surfaces exactly when a run dies, which is when the process is
+least able to ask for it:
+
+* **StallError** — the watchdog embeds the ring tail in the error
+  (`StallError.flight_dump`, rendered by ``format_report()``), so a hung
+  collective's post-mortem opens with the last N steps of context;
+* **fatal ResilienceError** — `ResilientRunner` dumps the ring to a JSON
+  file before re-raising a fault it cannot recover from;
+* **unhandled exception** — a chained ``sys.excepthook`` (installed
+  lazily on the first record; ``MXNET_TPU_FLIGHT_AUTODUMP=0`` disables)
+  writes the ring before the interpreter dies, and
+  ``MXNET_TPU_FLIGHT_DUMP_AT_EXIT=1`` additionally dumps on every exit
+  (ops fleets that collect artifacts unconditionally).
+
+Dumps land in ``MXNET_TPU_FLIGHT_DIR`` (default: the runner's checkpoint
+dir when it has one, else the system temp dir — never the workspace) as
+``flight_rank<r>_<pid>.json`` and are tabulated by
+``tools/parse_log.py --flight``. Everything is inert under
+``MXNET_TPU_TELEMETRY=0``: no records, no hooks, no files.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+__all__ = ["FlightRecorder", "record_step", "note_event", "note_retrace",
+           "records", "dump", "dump_on_crash", "format_records", "reset",
+           "default_ring_steps"]
+
+# counters whose per-step DELTA tells the step's story; absent counters are
+# skipped, zero deltas are dropped from the record to keep the ring small
+_DELTA_COUNTERS = (
+    "comm.collectives", "comm.bucket.count", "comm.bucket.bytes",
+    "kvstore.push_bytes", "kvstore.pull_bytes",
+    "cachedop.compile", "fused_step.compile", "train_step.compile",
+    "cachedop.retrace", "fused_step.retrace", "train_step.retrace",
+    "ndarray.sync.asnumpy",
+    "resilience.retries", "resilience.restores", "resilience.stalls",
+    "resilience.checkpoints", "resilience.faults_injected",
+    "resilience.preempt.notices",
+)
+
+_REASON_LIMIT = 8     # retrace reasons buffered between two step records
+_EVENT_LIMIT = 16     # resilience events buffered between two step records
+
+
+def default_ring_steps():
+    try:
+        return max(8, int(os.environ.get("MXNET_TPU_FLIGHT_STEPS", "256")))
+    except (TypeError, ValueError):
+        return 256
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records plus the between-step event inbox."""
+
+    def __init__(self, maxlen=None):
+        self._ring = deque(maxlen=maxlen or default_ring_steps())
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_counters = {}
+        self._last_compile_ts = -1.0
+        self._reasons = []   # (site, reason) since the last record
+        self._events = []    # (kind, detail, t) since the last record
+        # resolved memory.<device>.bytes_in_use gauge, cached once found —
+        # scanning the registry's name list every step would put an
+        # O(n log n) sorted scan in the hot path; re-probe only every
+        # _MEM_PROBE_EVERY records while unresolved (CPU backends may
+        # never grow the gauge)
+        self._mem_gauge = None
+        self._mem_probe_in = 0
+
+    _MEM_PROBE_EVERY = 32
+
+    def _memory_gauge(self, _telem):
+        """Cached lookup of device 0's bytes_in_use gauge (call under
+        self._lock)."""
+        if self._mem_gauge is None:
+            if self._mem_probe_in > 0:
+                self._mem_probe_in -= 1
+                return None
+            self._mem_probe_in = self._MEM_PROBE_EVERY
+            for name in _telem.registry.names():
+                if name.startswith("memory.") and \
+                        name.endswith(".bytes_in_use"):
+                    self._mem_gauge = _telem.registry.get(name)
+                    break
+        return self._mem_gauge
+
+    # ------------------------------------------------------------------
+    def record_step(self, site, dur_ms, anomalies=None):
+        """Append one step record; deltas are computed against the previous
+        record, so the ring reads as a per-step ledger."""
+        from .. import telemetry as _telem
+        if not _telem.ENABLED:
+            return None
+        record = {
+            "t": time.time(),
+            "site": site,
+            "step_ms": round(float(dur_ms), 3),
+        }
+        if anomalies:
+            record["anomalies"] = list(anomalies)
+        with self._lock:
+            # counters and the compile ring are snapshotted UNDER the
+            # recorder lock: two step sites recording concurrently must
+            # not interleave a read batch with another's _last_counters
+            # update (that interleaving writes negative deltas into the
+            # ring)
+            snap_counters = {}
+            for name in _DELTA_COUNTERS:
+                metric = _telem.registry.get(name)
+                if metric is not None:
+                    snap_counters[name] = metric.value
+            recent = _telem.recent_compiles()
+            mem = self._memory_gauge(_telem)
+            if mem is not None:
+                record["mem_bytes_in_use"] = mem.value
+            self._seq += 1
+            record["seq"] = self._seq
+            deltas = {}
+            for name, value in snap_counters.items():
+                d = value - self._last_counters.get(name, 0)
+                if d:
+                    deltas[name] = d
+            self._last_counters.update(snap_counters)
+            if deltas:
+                record["deltas"] = deltas
+            # the compile watermark is read AND advanced under the lock:
+            # two step sites recording concurrently must not both claim
+            # the same executables against a stale watermark
+            compiles = [(n, ts) for n, ts in recent
+                        if ts > self._last_compile_ts]
+            if compiles:
+                record["compiles"] = [n for n, _ in compiles]
+                self._last_compile_ts = max(ts for _, ts in compiles)
+            if self._reasons:
+                record["retrace_reasons"] = [
+                    "%s: %s" % (s, r) for s, r in self._reasons]
+                del self._reasons[:]
+            if self._events:
+                record["events"] = ["%s %s" % (k, d)
+                                    for k, d, _ in self._events]
+                del self._events[:]
+            self._ring.append(record)
+        _maybe_install_crash_hook()
+        return record
+
+    def note_retrace(self, site, reason):
+        """Buffer a retrace reason (from `analysis.guard.on_retrace`) for
+        the next step record."""
+        with self._lock:
+            if len(self._reasons) < _REASON_LIMIT:
+                self._reasons.append((str(site), str(reason or "unknown")))
+
+    def note_event(self, kind, detail=""):
+        """Buffer a resilience/runtime event (checkpoint, restore, preempt
+        notice, ...) for the next step record."""
+        with self._lock:
+            if len(self._events) < _EVENT_LIMIT:
+                self._events.append((str(kind), str(detail), time.time()))
+
+    # ------------------------------------------------------------------
+    def records(self, limit=None):
+        with self._lock:
+            out = list(self._ring)
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def reset(self):
+        with self._lock:
+            self._ring.clear()
+            self._last_counters.clear()
+            self._last_compile_ts = -1.0
+            del self._reasons[:]
+            del self._events[:]
+            self._seq = 0
+            self._mem_gauge = None
+            self._mem_probe_in = 0
+
+    # ------------------------------------------------------------------
+    def dump(self, path=None, reason=None, dir_hint=None):
+        """Write the ring (+ identity: rank, trace id) as JSON; returns the
+        path, or None when there is nothing to say. Destination precedence:
+        explicit `path` > MXNET_TPU_FLIGHT_DIR > `dir_hint` (the runner
+        passes its checkpoint dir — post-mortems land next to the state
+        they explain) > the system temp dir (never the workspace: auto
+        dumps must not litter a repo checkout)."""
+        import tempfile
+        from .. import telemetry as _telem
+        recs = self.records()
+        if not recs:
+            return None
+        rank = _telem.safe_rank()
+        if path is None:
+            path = os.path.join(
+                os.environ.get("MXNET_TPU_FLIGHT_DIR") or dir_hint
+                or tempfile.gettempdir(),
+                "flight_rank%d_%d.json" % (rank, os.getpid()))
+        payload = {
+            "rank": rank,
+            "trace_id": _telem.trace_id(),
+            "dumped_at": time.time(),
+            "reason": reason,
+            "records": recs,
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+
+def format_records(recs, limit=10):
+    """Render step records as the post-mortem table `format_report` embeds
+    (newest last)."""
+    if not recs:
+        return "flight recorder: empty"
+    lines = ["flight recorder (last %d of %d steps):"
+             % (min(limit, len(recs)), len(recs))]
+    for r in recs[-limit:]:
+        parts = ["  #%-6d %-12s %8.2f ms" % (r.get("seq", 0),
+                                             r.get("site", "?"),
+                                             r.get("step_ms", 0.0))]
+        deltas = r.get("deltas", {})
+        for key, label in (("comm.collectives", "coll"),
+                           ("comm.bucket.bytes", "comm_B"),
+                           ("resilience.restores", "restores")):
+            if key in deltas:
+                parts.append("%s=%s" % (label, deltas[key]))
+        compiles = r.get("compiles")
+        if compiles:
+            parts.append("compiled=%s" % ",".join(compiles))
+        if r.get("retrace_reasons"):
+            parts.append("retrace=%s" % "; ".join(r["retrace_reasons"]))
+        if r.get("anomalies"):
+            parts.append("ANOMALY=%s" % ",".join(r["anomalies"]))
+        if r.get("events"):
+            parts.append("events=[%s]" % "; ".join(r["events"]))
+        lines.append(" ".join(parts))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- module API
+_RECORDER = FlightRecorder()
+_HOOK_LOCK = threading.Lock()
+_HOOK = {"installed": False, "prev": None}
+
+
+def record_step(site, dur_ms, anomalies=None):
+    return _RECORDER.record_step(site, dur_ms, anomalies=anomalies)
+
+
+def note_event(kind, detail=""):
+    from .. import telemetry as _telem
+    if not _telem.ENABLED:
+        return
+    _RECORDER.note_event(kind, detail)
+
+
+def note_retrace(site, reason):
+    from .. import telemetry as _telem
+    if not _telem.ENABLED:
+        return
+    _RECORDER.note_retrace(site, reason)
+
+
+def records(limit=None):
+    return _RECORDER.records(limit=limit)
+
+
+def dump(path=None, reason=None, dir_hint=None):
+    return _RECORDER.dump(path=path, reason=reason, dir_hint=dir_hint)
+
+
+def dump_on_crash(reason, dir_hint=None):
+    """Best-effort crash dump (fatal-resilience and excepthook path): never
+    raises, returns the path or None."""
+    try:
+        return _RECORDER.dump(reason=reason, dir_hint=dir_hint)
+    except Exception:  # noqa: BLE001 — a post-mortem must not mask the crash
+        return None
+
+
+def reset():
+    _RECORDER.reset()
+
+
+# ------------------------------------------------------------- crash hooks
+def _autodump_enabled():
+    return os.environ.get("MXNET_TPU_FLIGHT_AUTODUMP", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _crash_excepthook(etype, value, tb):
+    path = None
+    if _autodump_enabled() and not issubclass(etype, KeyboardInterrupt):
+        path = dump_on_crash("unhandled %s: %s" % (etype.__name__, value))
+    if path:
+        print("mxnet_tpu: flight recorder dumped to %s" % path,
+              file=sys.stderr)
+    prev = _HOOK["prev"] or sys.__excepthook__
+    prev(etype, value, tb)
+
+
+def _exit_dump():
+    if os.environ.get("MXNET_TPU_FLIGHT_DUMP_AT_EXIT", "").lower() in (
+            "1", "true", "on"):
+        dump_on_crash("atexit")
+
+
+def _maybe_install_crash_hook():
+    """Install the excepthook chain + atexit dump once, lazily, only after
+    the ring actually holds something worth dumping."""
+    if _HOOK["installed"]:
+        return
+    with _HOOK_LOCK:
+        if _HOOK["installed"]:
+            return
+        if not _autodump_enabled():
+            _HOOK["installed"] = True  # explicit opt-out: never re-check
+            return
+        import atexit
+        _HOOK["prev"] = sys.excepthook
+        sys.excepthook = _crash_excepthook
+        atexit.register(_exit_dump)
+        _HOOK["installed"] = True

@@ -45,7 +45,11 @@ class Counter:
         return self._value
 
     def snapshot(self):
-        return self._value
+        # under the metric lock: a live exporter scrape racing inc() from a
+        # step thread must see a committed value, not a partial += on a
+        # future non-GIL runtime
+        with self._lock:
+            return self._value
 
 
 class Gauge:
@@ -75,7 +79,11 @@ class Gauge:
         return self._max
 
     def snapshot(self):
-        return {"value": self._value, "max": self._max}
+        # under the metric lock: value and max are a PAIR — a scrape racing
+        # set() must never observe a fresh value with a stale max (torn
+        # watermark), so the exporter's reads stay atomic per metric
+        with self._lock:
+            return {"value": self._value, "max": self._max}
 
 
 class Histogram:

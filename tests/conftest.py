@@ -95,3 +95,4 @@ def _seed_rng(request):
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running (full-size model zoo / multi-process)")
     config.addinivalue_line("markers", "lint: tracelint self-check (mx.analysis over mxnet_tpu/; run alone with -m lint)")
+    config.addinivalue_line("markers", "obs: observability endpoint tests (live /metrics HTTP server on localhost)")

@@ -279,6 +279,92 @@ def test_dist_commit_election_rank_ahead_by_one(tmp_path):
         assert res["elected2"] == 4
 
 
+TRACE_WORKER = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+
+# bucketed multi-key pushpull -> comm.bucket[...] spans on each rank
+shapes = [(64, 32), (64,), (32, 16)]
+keys = list(range(len(shapes)))
+rng = np.random.RandomState(rank)
+for k, s in zip(keys, shapes):
+    kv.init(k, nd.zeros(s))
+grads = [nd.array(rng.randn(*s).astype(np.float32)) for s in shapes]
+outs = [nd.zeros(s) for s in shapes]
+kv.pushpull(keys, grads, out=outs)
+outs[0].asnumpy()
+with telemetry.span("rank_marker_%d" % rank, "test"):
+    pass
+
+# collective: BOTH ranks call the merged dump in lockstep; each writes its
+# own copy of the SAME fleet-wide trace
+path = telemetry.dump_trace(
+    os.environ["TRACE_FILE_PREFIX"] + str(rank) + ".json", merged=True)
+
+out = {"rank": rank, "nw": nw, "trace_id": telemetry.trace_id(),
+       "path": path}
+with open(os.environ["RESULT_FILE_PREFIX"] + str(rank) + ".json", "w") as f:
+    json.dump(out, f)
+"""
+
+
+@pytest.mark.slow
+def test_dist_merged_trace_two_workers(tmp_path):
+    """ISSUE 6 acceptance: `dump_trace(merged=True)` from a 2-rank run
+    yields ONE chrome trace with both ranks' comm-bucket spans as separate
+    process rows on a shared clock, under one run-wide trace id."""
+    n = 2
+    script = tmp_path / "trace_worker.py"
+    script.write_text(TRACE_WORKER)
+    env = dict(os.environ)
+    env.update({
+        "RESULT_FILE_PREFIX": str(tmp_path / "result_"),
+        "TRACE_FILE_PREFIX": str(tmp_path / "trace_"),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_TELEMETRY", None)
+    env.pop("MXNET_TPU_TRACE_ID", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "--root-port", str(_free_port()),
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    results = []
+    for r in range(n):
+        with open(str(tmp_path / ("result_%d.json" % r))) as f:
+            results.append(json.load(f))
+    # one run-wide trace id, adopted by every rank during the exchange
+    assert results[0]["trace_id"] == results[1]["trace_id"]
+    for res in results:
+        obj = json.load(open(res["path"]))
+        meta = obj["metadata"]
+        assert meta["merged"] is True
+        assert meta["ranks"] == [0, 1]
+        assert meta["trace_id"] == results[0]["trace_id"]
+        spans = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        by_rank = {0: set(), 1: set()}
+        for e in spans:
+            by_rank[e["pid"]].add(e["name"])
+        # both ranks contributed their comm-bucket spans AND their marker
+        for r in (0, 1):
+            assert any(name.startswith("comm.bucket[")
+                       for name in by_rank[r]), \
+                "rank %d has no comm-bucket span in the merged trace" % r
+            assert ("rank_marker_%d" % r) in by_rank[r]
+
+
 # ---------------------------------------------------------------------------
 # 2-bit compression wire format (unit; reference: gradient_compression.cc)
 # ---------------------------------------------------------------------------

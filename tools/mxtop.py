@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""mxtop — live terminal dashboard for the mxnet_tpu telemetry plane.
+
+Polls a running training/serving process through either live transport the
+telemetry exporter provides and renders a top-style view: step latency
+(rolling p50/p99 + steps/s), comm and kvstore throughput, compile/retrace
+activity, device-memory watermarks, resilience events, and anomalies.
+
+Sources (pick one):
+  --port N [--host H]   poll http://H:N/snapshot (the endpoint started by
+                        MXNET_TPU_METRICS_PORT; /metrics also works for
+                        Prometheus, but mxtop wants the richer JSON)
+  --url URL             full /snapshot URL
+  --stream FILE         tail the JSONL file written by
+                        MXNET_TPU_METRICS_STREAM (no network needed)
+
+Options:
+  --interval S          refresh period (default 2 s)
+  --once                render a single frame and exit (scripting / tests)
+
+Examples:
+  MXNET_TPU_METRICS_PORT=9100 python train.py &
+  python tools/mxtop.py --port 9100
+
+  MXNET_TPU_METRICS_STREAM=/tmp/run.jsonl python train.py &
+  python tools/mxtop.py --stream /tmp/run.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+CLEAR = "\x1b[2J\x1b[H"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+RED = "\x1b[31m"
+RESET = "\x1b[0m"
+
+
+def fetch_url(url, timeout=3.0):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def fetch_stream(path, block=1 << 16):
+    """Last complete JSON line of the stream file (the newest snapshot).
+    Reads only a tail block from EOF (doubling while no newline-delimited
+    line fits) — a week-long stream is hundreds of MB and re-scanning it
+    every poll would eventually take longer than the poll interval."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        while True:
+            span = min(size, block)
+            f.seek(size - span)
+            chunk = f.read(span)
+            parts = chunk.split(b"\n")
+            if not chunk.endswith(b"\n"):
+                parts = parts[:-1]    # streamer mid-append: partial tail
+            if span < size:
+                parts = parts[1:]     # seek landed mid-line: partial head
+            lines = [l for l in parts if l.strip()]
+            if lines:
+                return json.loads(lines[-1].decode("utf-8"))
+            if span == size:
+                raise ValueError(
+                    "stream file %s has no snapshot lines yet" % path)
+            block *= 2
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return "%.1f %s" % (n, unit)
+        n /= 1024.0
+
+
+def _fmt_num(n):
+    if n is None:
+        return "-"
+    if isinstance(n, float):
+        return "%.2f" % n
+    return str(n)
+
+
+def _rate(cur, prev, name, dt):
+    if prev is None or dt <= 0:
+        return None
+    d = cur.get(name, 0) - prev.get(name, 0)
+    return d / dt if d >= 0 else None
+
+
+def render(payload, prev_payload=None, dt=None, source=""):
+    """One dashboard frame as a string. `prev_payload` (the previous poll)
+    turns monotonic counters into rates."""
+    snap = payload.get("snapshot", {})
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    prev = (prev_payload or {}).get("snapshot", {}).get("counters") \
+        if prev_payload else None
+    quant = payload.get("step_quantiles", {}) or {}
+    lines = []
+    lines.append("%smxtop%s — rank %s  trace %s  %s  %s" % (
+        BOLD, RESET, payload.get("rank", "?"),
+        payload.get("trace_id", "?"),
+        time.strftime("%H:%M:%S", time.localtime(payload.get("ts",
+                                                             time.time()))),
+        DIM + source + RESET))
+    lines.append("")
+
+    # --- step latency ---------------------------------------------------
+    lines.append(BOLD + "step latency (rolling window)" + RESET)
+    lines.append("  %-12s %10s %10s %10s %8s %9s"
+                 % ("site", "p50 ms", "p99 ms", "last ms", "n", "steps/s"))
+    hists = snap.get("histograms", {})
+    for site, q in sorted(quant.items()):
+        hist = hists.get("%s.step_ms" % site, {})
+        rate = None
+        if prev_payload is not None and dt:
+            prev_hists = prev_payload.get("snapshot", {}).get(
+                "histograms", {})
+            d = hist.get("count", 0) - prev_hists.get(
+                "%s.step_ms" % site, {}).get("count", 0)
+            rate = d / dt if d >= 0 else None
+        lines.append("  %-12s %10s %10s %10s %8s %9s"
+                     % (site, _fmt_num(q.get("p50")), _fmt_num(q.get("p99")),
+                        _fmt_num(q.get("last_ms")), q.get("n", "-"),
+                        _fmt_num(rate)))
+    if not quant:
+        lines.append(DIM + "  (no steps observed yet)" + RESET)
+    lines.append("")
+
+    # --- throughput -----------------------------------------------------
+    lines.append(BOLD + "throughput" + RESET)
+    for name, label in (("comm.bucket.bytes", "comm bucket"),
+                        ("kvstore.push_bytes", "kvstore push"),
+                        ("kvstore.pull_bytes", "kvstore pull")):
+        total = counters.get(name)
+        if total is None:
+            continue
+        rate = _rate(counters, prev, name, dt or 0)
+        lines.append("  %-14s %14s total %14s"
+                     % (label, _fmt_bytes(total),
+                        (_fmt_bytes(rate) + "/s") if rate is not None
+                        else ""))
+    coll = counters.get("comm.collectives")
+    if coll is not None:
+        rate = _rate(counters, prev, "comm.collectives", dt or 0)
+        lines.append("  %-14s %14s total %14s"
+                     % ("collectives", coll,
+                        ("%.1f/s" % rate) if rate is not None else ""))
+    lines.append("")
+
+    # --- compiles -------------------------------------------------------
+    lines.append(BOLD + "compiles / retraces" + RESET)
+    row = []
+    for name in ("cachedop.compile", "fused_step.compile",
+                 "train_step.compile", "cachedop.retrace",
+                 "fused_step.retrace", "train_step.retrace"):
+        v = counters.get(name)
+        if v:
+            row.append("%s=%d" % (name, v))
+    lines.append("  " + ("  ".join(row) if row else DIM + "(none)" + RESET))
+    lines.append("")
+
+    # --- memory ---------------------------------------------------------
+    mem_rows = [(n, g) for n, g in sorted(gauges.items())
+                if n.startswith("memory.") and n.endswith(".bytes_in_use")]
+    if mem_rows:
+        lines.append(BOLD + "device memory" + RESET)
+        for name, g in mem_rows:
+            dev = name[len("memory."):-len(".bytes_in_use")]
+            lines.append("  %-10s %14s in use   %14s peak"
+                         % (dev, _fmt_bytes(g.get("value", 0)),
+                            _fmt_bytes(g.get("max", 0))))
+        lines.append("")
+
+    # --- resilience + anomalies ----------------------------------------
+    res = {n: v for n, v in sorted(counters.items())
+           if n.startswith("resilience.") and v}
+    if res:
+        lines.append(BOLD + "resilience" + RESET)
+        lines.append("  " + "  ".join("%s=%d" % (n[len("resilience."):], v)
+                                      for n, v in res.items()))
+        lines.append("")
+    anom = {n: v for n, v in sorted(counters.items())
+            if n.startswith("telemetry.anomaly.") and v}
+    if anom:
+        lines.append(BOLD + RED + "anomalies" + RESET)
+        lines.append("  " + "  ".join(
+            "%s=%d" % (n[len("telemetry.anomaly."):], v)
+            for n, v in anom.items()))
+        lines.append("")
+    flight_n = payload.get("flight_steps")
+    if flight_n is not None:
+        lines.append(DIM + "flight recorder: %s steps buffered" % flight_n
+                     + RESET)
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    src = parser.add_mutually_exclusive_group(required=True)
+    src.add_argument("--port", type=int, help="poll localhost /snapshot")
+    src.add_argument("--url", help="full /snapshot URL")
+    src.add_argument("--stream", help="tail a MXNET_TPU_METRICS_STREAM file")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--once", action="store_true",
+                        help="render one frame and exit")
+    args = parser.parse_args(argv)
+
+    if args.stream:
+        fetch = lambda: fetch_stream(args.stream)  # noqa: E731
+        source = args.stream
+    else:
+        url = args.url or ("http://%s:%d/snapshot" % (args.host, args.port))
+        fetch = lambda: fetch_url(url)  # noqa: E731
+        source = url
+
+    prev = None
+    prev_t = None
+    while True:
+        try:
+            payload = fetch()
+        except Exception as exc:  # noqa: BLE001 — poll target flakiness is
+            # the normal case for a dashboard; report and keep trying
+            if args.once:
+                sys.exit("mxtop: cannot read %s: %s" % (source, exc))
+            sys.stdout.write(CLEAR + "mxtop: waiting for %s (%s)\n"
+                             % (source, exc))
+            sys.stdout.flush()
+            time.sleep(args.interval)
+            continue
+        now = time.monotonic()
+        dt = (now - prev_t) if prev_t is not None else None
+        frame = render(payload, prev, dt, source=source)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write(CLEAR + frame + "\n")
+        sys.stdout.flush()
+        prev, prev_t = payload, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -186,6 +186,85 @@ def _print_comm(rows, fmt):
         print(line % r)
 
 
+def parse_flight(obj):
+    """Flatten a flight-recorder dump (`telemetry.flight.dump()` JSON, or a
+    dict with a "records" list) into per-step rows:
+    [(seq, site, step_ms, anomalies, compiles, events, notes)]."""
+    records = obj.get("records", [])
+    rows = []
+    for r in records:
+        deltas = r.get("deltas", {})
+        notes = []
+        for key, label in (("comm.collectives", "coll"),
+                           ("comm.bucket.bytes", "comm_B"),
+                           ("resilience.restores", "restores"),
+                           ("resilience.retries", "retries")):
+            if key in deltas:
+                notes.append("%s=%s" % (label, deltas[key]))
+        if r.get("retrace_reasons"):
+            notes.append("retrace: " + "; ".join(r["retrace_reasons"]))
+        rows.append((r.get("seq", ""), r.get("site", "?"),
+                     r.get("step_ms", ""),
+                     ",".join(r.get("anomalies", [])),
+                     ",".join(r.get("compiles", [])),
+                     "; ".join(r.get("events", [])),
+                     " ".join(notes)))
+    return rows
+
+
+def _print_flight(rows, fmt):
+    if not rows:
+        print("no flight-recorder records in this dump", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| step | site | step_ms | anomalies | compiles | events |"
+              " notes |")
+        print("| --- | --- | --- | --- | --- | --- | --- |")
+        line = "| %s | %s | %s | %s | %s | %s | %s |"
+    else:
+        print("step,site,step_ms,anomalies,compiles,events,notes")
+        line = "%s,%s,%s,%s,%s,%s,%s"
+    for r in rows:
+        if fmt == "csv":
+            r = tuple(str(c).replace(",", ";") for c in r)
+        print(line % r)
+
+
+def parse_anomalies(obj):
+    """Extract the anomaly story from a telemetry snapshot: every
+    `telemetry.anomaly.*` counter plus the step-time histograms the spikes
+    were judged against. Returns [(metric, kind, value)]."""
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    rows = []
+    for name, v in sorted(obj.get("counters", {}).items()):
+        if name.startswith("telemetry.anomaly."):
+            rows.append((name[len("telemetry.anomaly."):], "count", v))
+    for name, h in sorted(obj.get("histograms", {}).items()):
+        if name.endswith(".step_ms"):
+            avg = h.get("avg")
+            rows.append((name, "avg_ms",
+                         round(avg, 3) if avg is not None else ""))
+            rows.append((name, "max_ms", h.get("max")))
+    return rows
+
+
+def _print_anomalies(rows, fmt):
+    if not rows:
+        print("no telemetry.anomaly.* counters in this dump (clean run, "
+              "no steps, or telemetry disabled)", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| metric | kind | value |")
+        print("| --- | --- | --- |")
+        line = "| %s | %s | %s |"
+    else:
+        print("metric,kind,value")
+        line = "%s,%s,%s"
+    for r in rows:
+        print(line % r)
+
+
 # severity ordering for the lint table: errors first, then by location
 _LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
 
@@ -262,8 +341,29 @@ def main():
                              " counters from a telemetry JSON dump — was the"
                              " sync bucketed (few big launches) or per-param"
                              " (many small ones)?")
+    parser.add_argument("--flight", action="store_true",
+                        help="flight-recorder mode: per-step table from a "
+                             "telemetry.flight.dump() JSON file — the last "
+                             "N steps before a crash")
+    parser.add_argument("--anomalies", action="store_true",
+                        help="anomaly mode: telemetry.anomaly.* counters + "
+                             "step-time histograms from a telemetry JSON "
+                             "dump — did any step blow its rolling median "
+                             "or SLO?")
     args = parser.parse_args()
     obj = _load_json(args.logfile)
+    if args.flight:
+        if obj is None:
+            sys.exit("--flight input is not a JSON object: %s"
+                     % args.logfile)
+        _print_flight(parse_flight(obj), args.format)
+        return
+    if args.anomalies:
+        if obj is None:
+            sys.exit("--anomalies input is not a JSON object: %s"
+                     % args.logfile)
+        _print_anomalies(parse_anomalies(obj), args.format)
+        return
     if args.comm:
         if obj is None:
             sys.exit("--comm input is not a JSON object: %s" % args.logfile)

@@ -17,4 +17,6 @@ if [ -n "${MXNET_TPU_TRACELINT_CACHE:-}" ]; then
 else
     set -- --cache "$@"
 fi
-exec python -m mxnet_tpu.analysis mxnet_tpu --fail-on=error "$@"
+# tools/mxtop.py rides along: the dashboard spawns no traces itself but
+# shares the telemetry thread model the TPU006 rule audits
+exec python -m mxnet_tpu.analysis mxnet_tpu tools/mxtop.py --fail-on=error "$@"
