@@ -25,13 +25,29 @@ TPU005 host-rng-under-trace         error    random.*/np.random.* baked
                                              in at trace time
 TPU006 thread-shared-state          warning  module-level mutable state
                                              touched from threads lock-free
+TPU007 sharding-annotation          error    PartitionSpec axes no mesh
+                                             declares, in_/out_shardings
+                                             arity mismatches, dead
+                                             partition rules
+TPU008 collective-safety            error    collectives under rank-
+                                             divergent control flow,
+                                             unbound axis_name, padded
+                                             all_reduce_multi dims
 ====== ============================ ======== =========================
+
+Directory linting is *whole-program*: one level of project imports is
+resolved (`analysis.project.ProjectContext`), so a helper that
+`.asnumpy()`s in another module is flagged at its traced call site, and
+the mesh-axis universe TPU007/TPU008 validate against spans the whole
+tree.
 
 Use:
 
 * ``mx.analysis.check(block_or_fn)`` → ``list[Finding]`` (file/line, rule
   code, severity, fix hint);
 * ``python -m mxnet_tpu.analysis mxnet_tpu/ --fail-on=error`` (CI);
+* ``--baseline tools/tracelint_baseline.json`` gates on NEW findings
+  only (``tools/run_tracelint.sh --ci``); ``--format sarif`` for upload;
 * ``# tpu-lint: disable=TPU001`` suppresses a finding on its line;
 * ``MXNET_TPU_TRACE_GUARD=1`` arms the runtime guard: dynamic host syncs
   under trace raise `TraceGuardError` (counter
@@ -42,15 +58,15 @@ Use:
 from __future__ import annotations
 
 from .findings import Finding, Severity, SEVERITY_ORDER, max_severity
-from .engine import (check, check_source, lint_file, lint_paths,
-                     lint_source)
+from .engine import (build_project, check, check_source, lint_file,
+                     lint_paths, lint_source)
 from .rules import RULES, LINT_VERSION, rule_table
 from .guard import TraceGuardError, set_mode as set_guard_mode, \
     mode as guard_mode, active as guard_active
-from . import guard
+from . import engine, guard, project
 
 __all__ = ["Finding", "Severity", "SEVERITY_ORDER", "max_severity",
-           "check", "check_source", "lint_file", "lint_paths",
-           "lint_source", "RULES", "LINT_VERSION", "rule_table",
-           "TraceGuardError", "set_guard_mode", "guard_mode",
-           "guard_active", "guard"]
+           "build_project", "check", "check_source", "lint_file",
+           "lint_paths", "lint_source", "RULES", "LINT_VERSION",
+           "rule_table", "TraceGuardError", "set_guard_mode",
+           "guard_mode", "guard_active", "engine", "guard", "project"]

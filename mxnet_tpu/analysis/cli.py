@@ -1,8 +1,15 @@
 """tracelint CLI — ``python -m mxnet_tpu.analysis path_or_module ...``.
 
-Text or JSON output, ``--fail-on`` severity gating for CI, rule selection,
-and an optional per-file mtime cache so the tier-1 self-check re-lints only
-files that changed (tools/run_tracelint.sh).
+Text, JSON, or SARIF output, ``--fail-on`` severity gating for CI, rule
+selection, and an optional per-file mtime cache so the tier-1 self-check
+re-lints only files that changed (tools/run_tracelint.sh).
+
+Baseline gate (``--baseline tools/tracelint_baseline.json``): findings
+whose fingerprint (code|file|symbol|source — line-number free) is in the
+checked-in baseline pass; only NEW findings gate the exit code, so a
+legacy warning doesn't block CI while any freshly introduced hazard
+does. ``--update-baseline`` rewrites the file to exactly the current
+findings — fixing a finding prunes its entry on the next update.
 
 Exit codes: 0 clean (below the fail-on bar), 1 findings at/above the bar,
 2 usage or input error.
@@ -20,7 +27,8 @@ from .engine import lint_paths
 from .findings import Finding, SEVERITY_ORDER, Severity
 from .rules import LINT_VERSION, RULES, rule_table
 
-__all__ = ["main", "FileCache"]
+__all__ = ["main", "FileCache", "load_baseline", "apply_baseline",
+           "write_baseline", "to_sarif"]
 
 # uid-scoped so the CI gate never trusts (or fights over) another local
 # user's cache file in the shared tempdir
@@ -32,7 +40,10 @@ _CACHE_DEFAULT = os.path.join(
 
 class FileCache:
     """Per-file findings cache keyed by (mtime, size, lint version, rule
-    selection). A malformed or version-skewed cache file is ignored."""
+    selection, project digest). The digest folds every project file's
+    (mtime, size) in — cross-file taint means a caller's findings depend
+    on its helpers, so editing ANY project file conservatively re-lints
+    everything. A malformed or version-skewed cache file is ignored."""
 
     def __init__(self, path):
         self.path = path
@@ -50,7 +61,7 @@ class FileCache:
     def _rules_key(rules):
         return ",".join(rules) if rules else "*"
 
-    def get(self, fname, rules):
+    def get(self, fname, rules, digest=""):
         entry = self._files.get(os.path.abspath(fname))
         if not entry:
             return None
@@ -60,18 +71,19 @@ class FileCache:
             return None
         if entry.get("mtime") != st.st_mtime or \
                 entry.get("size") != st.st_size or \
-                entry.get("rules") != self._rules_key(rules):
+                entry.get("rules") != self._rules_key(rules) or \
+                entry.get("project", "") != digest:
             return None
         return [Finding.from_dict(d) for d in entry.get("findings", [])]
 
-    def put(self, fname, rules, findings):
+    def put(self, fname, rules, findings, digest=""):
         try:
             st = os.stat(fname)
         except OSError:
             return
         self._files[os.path.abspath(fname)] = {
             "mtime": st.st_mtime, "size": st.st_size,
-            "rules": self._rules_key(rules),
+            "rules": self._rules_key(rules), "project": digest,
             "findings": [f.to_dict() for f in findings]}
         self._dirty = True
 
@@ -86,6 +98,139 @@ class FileCache:
             os.replace(tmp, self.path)
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# baseline gate
+# ---------------------------------------------------------------------------
+def _norm_file(path):
+    """Repo-relative forward-slash path when under the cwd, so baselines
+    match regardless of how the target was spelled."""
+    path = path.replace("\\", "/")
+    cwd = os.getcwd().replace("\\", "/")
+    if os.path.isabs(path) and path.startswith(cwd + "/"):
+        return path[len(cwd) + 1:]
+    return path
+
+
+def _fingerprint(finding):
+    f = Finding.from_dict(finding.to_dict())
+    f.file = _norm_file(f.file)
+    return f.fingerprint()
+
+
+def load_baseline(path):
+    """{fingerprint: count} from a baseline file; {} when missing (an
+    absent baseline means every finding is new)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    entries = data.get("entries", {})
+    return {k: int(v) for k, v in entries.items()
+            if isinstance(v, (int, float))}
+
+
+def _candidate_fingerprints(finding):
+    """The finding's fingerprint plus path-suffix variants: the baseline
+    stores repo-relative paths (run_tracelint.sh cd's to the repo root),
+    but the gate must also match when invoked from elsewhere with
+    absolute target paths — progressively stripping leading path
+    components recovers the repo-relative spelling. Code+symbol+source
+    stay in the key, so a suffix collision also has to collide on the
+    offending line to mis-match."""
+    f = Finding.from_dict(finding.to_dict())
+    f.file = _norm_file(f.file)
+    fps = [f.fingerprint()]
+    parts = f.file.split("/")
+    for i in range(1, len(parts)):
+        f.file = "/".join(parts[i:])
+        fps.append(f.fingerprint())
+    return fps
+
+
+def apply_baseline(findings, baseline):
+    """Split findings into (new, baselined, stale_fingerprints): the
+    first `count` occurrences of a baselined fingerprint pass, any
+    excess is new; baselined fingerprints with no occurrence left are
+    stale (fixed — prune them with --update-baseline)."""
+    remaining = dict(baseline)
+    new, baselined = [], []
+    for f in findings:
+        hit = None
+        for fp in _candidate_fingerprints(f):
+            if remaining.get(fp, 0) > 0:
+                hit = fp
+                break
+        if hit is not None:
+            remaining[hit] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = sorted(fp for fp, n in remaining.items() if n > 0)
+    return new, baselined, stale
+
+
+def write_baseline(path, findings):
+    counts = {}
+    for f in findings:
+        fp = _fingerprint(f)
+        counts[fp] = counts.get(fp, 0) + 1
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "lint_version": LINT_VERSION,
+                   "entries": {k: counts[k] for k in sorted(counts)}},
+                  f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(counts)
+
+
+# ---------------------------------------------------------------------------
+# SARIF output (for CI upload: GitHub code scanning et al.)
+# ---------------------------------------------------------------------------
+_SARIF_LEVEL = {Severity.ERROR: "error", Severity.WARNING: "warning",
+                Severity.INFO: "note"}
+
+
+def to_sarif(findings):
+    rules = []
+    seen = set()
+    for code, name, severity, _scope, desc in rule_table():
+        if code in seen:
+            continue
+        seen.add(code)
+        rules.append({
+            "id": code, "name": name,
+            "shortDescription": {"text": " ".join(desc.split())},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(severity, "warning")}})
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.code,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message +
+                        ((" | hint: " + f.hint) if f.hint else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _norm_file(f.file)},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": f.col + 1}}}],
+            "partialFingerprints": {"tracelint/v1": _fingerprint(f)}})
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tracelint",
+                "informationUri":
+                    "https://github.com/apache/mxnet",
+                "version": str(LINT_VERSION),
+                "rules": rules}},
+            "results": results}]}
 
 
 def _resolve_target(target):
@@ -119,8 +264,15 @@ def build_parser():
     parser.add_argument("targets", nargs="*",
                         help="files, directories, or importable module "
                              "names (e.g. mxnet_tpu/ or mxnet_tpu.gluon)")
-    parser.add_argument("--format", choices=["text", "json"],
+    parser.add_argument("--format", choices=["text", "json", "sarif"],
                         default="text")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="baseline gate: findings fingerprinted in "
+                             "PATH pass; only NEW findings gate the exit "
+                             "code")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite --baseline PATH to the current "
+                             "findings (fixed findings prune) and exit 0")
     parser.add_argument("--fail-on",
                         choices=["error", "warning", "info", "never"],
                         default="error",
@@ -141,7 +293,9 @@ def build_parser():
 
 def main(argv=None):
     parser = build_parser()
-    args = parser.parse_args(argv)
+    # intermixed: run_tracelint.sh appends extra TARGETS after the flag
+    # block it builds (`run_tracelint.sh --ci extra.py`)
+    args = parser.parse_intermixed_args(argv)
 
     if args.list_rules:
         for code, name, severity, scope, desc in rule_table():
@@ -174,28 +328,62 @@ def main(argv=None):
         paths.append(resolved)
 
     cache = None
+    summary_cache = None
     if args.cache or args.cache_file:
         cache = FileCache(args.cache_file or _CACHE_DEFAULT)
+        from .project import DEFAULT_SUMMARY_CACHE
+        summary_cache = ((args.cache_file + ".summaries")
+                         if args.cache_file else DEFAULT_SUMMARY_CACHE)
 
-    findings = lint_paths(paths, rules=rules, cache=cache)
+    findings = lint_paths(paths, rules=rules, cache=cache,
+                          summary_cache=summary_cache)
     if cache is not None:
         cache.save()
 
-    counts = _severity_counts(findings)
+    if args.update_baseline:
+        if not args.baseline:
+            print("error: --update-baseline needs --baseline PATH",
+                  file=sys.stderr)
+            return 2
+        n = write_baseline(args.baseline, findings)
+        print("tracelint: baseline %s updated (%d fingerprint(s), "
+              "%d finding(s))" % (args.baseline, n, len(findings)))
+        return 0
+
+    gated = findings
+    baseline_note = None
+    if args.baseline:
+        gated, baselined, stale = apply_baseline(
+            findings, load_baseline(args.baseline))
+        baseline_note = (
+            "baseline: %d finding(s) suppressed by %s, %d new, %d stale "
+            "entr%s (fixed — prune with --update-baseline)"
+            % (len(baselined), args.baseline, len(gated), len(stale),
+               "y" if len(stale) == 1 else "ies"))
+
+    counts = _severity_counts(gated)
     if args.format == "json":
-        print(json.dumps({
-            "version": LINT_VERSION,
-            "counts": counts,
-            "findings": [f.to_dict() for f in findings]}, indent=2))
+        out = {"version": LINT_VERSION,
+               "counts": counts,
+               "findings": [f.to_dict() for f in gated]}
+        if args.baseline:
+            out["baseline"] = {"path": args.baseline,
+                               "suppressed": len(baselined),
+                               "new": len(gated), "stale": len(stale)}
+        print(json.dumps(out, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(gated), indent=2))
     else:
-        for f in findings:
+        for f in gated:
             print(f.format())
+        if baseline_note:
+            print(baseline_note)
         print("tracelint: %d error(s), %d warning(s), %d info(s)"
               % (counts[Severity.ERROR], counts[Severity.WARNING],
                  counts[Severity.INFO]))
 
     if args.fail_on != "never":
         bar = SEVERITY_ORDER[args.fail_on]
-        if any(SEVERITY_ORDER.get(f.severity, 0) >= bar for f in findings):
+        if any(SEVERITY_ORDER.get(f.severity, 0) >= bar for f in gated):
             return 1
     return 0

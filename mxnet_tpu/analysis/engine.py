@@ -18,6 +18,13 @@ for all rules) on the finding's line — or on a comment-only line directly
 above it; ``# tpu-lint: disable-file=TPU004`` anywhere suppresses for the
 whole file. Suppressions are part of the contract: every suppression in
 `mxnet_tpu/` itself must carry a justification comment.
+
+Whole-program mode: `lint_paths` builds a `project.ProjectContext` over
+the package roots it is given (one level of import resolution), so rules
+see cross-module facts — an imported helper's host-sync summary
+(TPU001/TPU005 at the traced call site) and the project-wide mesh-axis
+universe (TPU007/TPU008). Single-source entry points (`check_source`)
+stay file-local.
 """
 from __future__ import annotations
 
@@ -30,7 +37,8 @@ from .rules import RULES, dotted
 from .taint import TaintTracker
 
 __all__ = ["ModuleInfo", "TracedFn", "lint_source", "lint_file",
-           "lint_paths", "check", "check_source", "iter_py_files"]
+           "lint_paths", "check", "check_source", "iter_py_files",
+           "build_project"]
 
 _HYBRID_BASES = ("HybridBlock", "HybridSequential", "HybridLambda",
                  "HybridConcurrent")
@@ -54,9 +62,12 @@ class TracedFn:
 class ModuleInfo:
     """Parsed file + import aliases + suppression map + traced regions."""
 
-    def __init__(self, source, filename="<string>"):
+    def __init__(self, source, filename="<string>", module_name=None,
+                 project=None):
         self.filename = filename
         self.source = source
+        self.module_name = module_name  # dotted name under a project root
+        self.project = project          # ProjectContext or None
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=filename)
         self.np_aliases = set()      # numpy module aliases (np, _np, ...)
@@ -65,6 +76,8 @@ class ModuleInfo:
         self.np_random_names = set()    # from numpy.random import uniform
         self.random_aliases = set()  # stdlib random module aliases
         self.random_names = set()    # from random import randint, ...
+        self.ps_aliases = set()      # names bound to PartitionSpec
+        self.mx_imports = {}         # alias -> (project module, symbol|None)
         self._collect_imports()
         self.all_functions = [n for n in ast.walk(self.tree)
                               if isinstance(n, (ast.FunctionDef,
@@ -79,8 +92,43 @@ class ModuleInfo:
             return self.lines[lineno - 1].strip()
         return ""
 
+    def resolve_callee(self, chain):
+        """(project module, function name) for a dotted call chain that
+        reaches ONE import hop into the project — `helper(x)` (imported
+        symbol), `sharding.helper(x)` (imported module), or the absolute
+        `mxnet_tpu.parallel.sharding.helper(x)`. None otherwise."""
+        if self.project is None or not chain:
+            return None
+        head = self.mx_imports.get(chain[0])
+        if head is not None:
+            module, symbol = head
+            if symbol is not None:
+                return (module, symbol) if len(chain) == 1 else None
+            # an imported module object: walk submodule attributes, the
+            # last chain part is the function
+            for part in chain[1:-1]:
+                nxt = module + "." + part
+                if self.project.module_path(nxt) is None:
+                    return None
+                module = nxt
+            return (module, chain[-1]) if len(chain) > 1 else None
+        # absolute dotted path (import mxnet_tpu.x.y style usage)
+        if len(chain) >= 2:
+            module = ".".join(chain[:-1])
+            if self.project.module_path(module) is not None:
+                return (module, chain[-1])
+        return None
+
     def _collect_imports(self):
         for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)) and \
+                    self.project is not None:
+                self.mx_imports.update(
+                    self.project.resolve_import(self.module_name, node))
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        self.ps_aliases.add(alias.asname or alias.name)
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     top = alias.name.split(".")[0]
@@ -276,10 +324,11 @@ def _selected_rules(rules):
 
 
 def lint_source(source, filename="<string>", rules=None,
-                keep_suppressed=False):
+                keep_suppressed=False, module_name=None, project=None):
     """Lint python source text; returns a list of `Finding`."""
     try:
-        mod = ModuleInfo(source, filename)
+        mod = ModuleInfo(source, filename, module_name=module_name,
+                         project=project)
     except SyntaxError as e:
         return [Finding("TPU000", Severity.ERROR,
                         "syntax error: %s" % e.msg, file=filename,
@@ -297,10 +346,12 @@ def lint_source(source, filename="<string>", rules=None,
     return findings
 
 
-def lint_file(path, rules=None):
+def lint_file(path, rules=None, project=None):
     with open(path, encoding="utf-8", errors="replace") as f:
         source = f.read()
-    return lint_source(source, filename=path, rules=rules)
+    module_name = project.module_name_for(path) if project else None
+    return lint_source(source, filename=path, rules=rules,
+                       module_name=module_name, project=project)
 
 
 def iter_py_files(path):
@@ -316,21 +367,47 @@ def iter_py_files(path):
                 yield os.path.join(root, name)
 
 
-def lint_paths(paths, rules=None, cache=None):
-    """Lint files/directories. `cache` is an optional `FileCache` — per-file
-    results keyed by (mtime, size, LINT_VERSION, rule selection)."""
+def build_project(paths, summary_cache=None):
+    """ProjectContext over the package roots covering `paths` (None when
+    no path belongs to a package — plain scripts lint file-locally)."""
+    from .project import ProjectContext, package_root
+    from .rules import LINT_VERSION
+    roots = set()
+    for path in paths:
+        root = package_root(path)
+        if root is not None:
+            roots.add(root)
+    if not roots:
+        return None
+    return ProjectContext(sorted(roots), cache_path=summary_cache,
+                          lint_version=LINT_VERSION)
+
+
+def lint_paths(paths, rules=None, cache=None, project="auto",
+               summary_cache=None):
+    """Lint files/directories with whole-program context. `cache` is an
+    optional `FileCache` — per-file results keyed by (mtime, size,
+    LINT_VERSION, rule selection, project digest); the digest folds every
+    project file's mtime in, so editing a helper re-lints its callers.
+    `project` is a `ProjectContext`, None (file-local linting), or
+    "auto" (derive package roots from `paths`)."""
+    if project == "auto":
+        project = build_project(paths, summary_cache=summary_cache)
+    digest = project.digest() if project is not None else ""
     findings = []
     for path in paths:
         for fname in iter_py_files(path):
             if cache is not None:
-                cached = cache.get(fname, rules)
+                cached = cache.get(fname, rules, digest=digest)
                 if cached is not None:
                     findings.extend(cached)
                     continue
-            got = lint_file(fname, rules=rules)
+            got = lint_file(fname, rules=rules, project=project)
             if cache is not None:
-                cache.put(fname, rules, got)
+                cache.put(fname, rules, got, digest=digest)
             findings.extend(got)
+    if project is not None:
+        project.save_cache()
     return findings
 
 

@@ -49,6 +49,15 @@ class Finding:
         self.symbol = symbol        # enclosing function/class, "" for module
         self.source = source        # offending source line (stripped)
 
+    def fingerprint(self):
+        """Stable identity for baseline matching: rule code, file,
+        enclosing symbol, and the offending source text — deliberately
+        NOT the line number, so reformatting or adding code above a
+        baselined finding does not resurrect it. Duplicate fingerprints
+        are counted (the baseline stores per-fingerprint counts)."""
+        return "|".join((self.code, self.file.replace("\\", "/"),
+                         self.symbol, " ".join(self.source.split())))
+
     def to_dict(self):
         return {"code": self.code, "severity": self.severity,
                 "message": self.message, "hint": self.hint,

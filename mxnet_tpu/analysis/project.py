@@ -1,0 +1,591 @@
+"""Whole-program context for tracelint: one-level cross-file resolution.
+
+Per-file AST linting cannot see that a helper in ``parallel/sharding.py``
+calls ``.asnumpy()`` when the traced caller lives in
+``gluon/fused_step.py``. A `ProjectContext` closes that gap for exactly one
+import hop:
+
+* it maps dotted module names (``mxnet_tpu.parallel.sharding``) to files
+  for every package root handed to `lint_paths`;
+* it computes a `ModuleSummary` per module — the *interprocedural facts*
+  rules consume: per-function host-sync/host-RNG hazard sites (computed
+  with every parameter tainted, so "would this helper sync if handed a
+  tracer?" is answerable at any call site), function arity, and the mesh
+  axis names the module declares (`Mesh(...)`, `create_mesh(...)`,
+  `MeshConfig(...)`, ``axis_order=`` literals, ``pmap(axis_name=...)``);
+* summaries are cached on disk keyed by (mtime, size, LINT_VERSION) —
+  the same contract as the CLI findings `FileCache` — so repeat runs
+  re-summarize only changed files.
+
+The taint model is deliberately ONE level deep: a traced caller sees the
+direct hazards in the imported helper's body, not hazards another hop
+away. That matches how these bugs are actually written (a "small" utility
+wrapping `.asnumpy()`) without dragging in a whole-program call graph.
+
+`digest()` folds every project file's (path, mtime, size) into one token;
+the findings cache keys on it so editing a *helper* invalidates the
+cached findings of its *callers*.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import tempfile
+
+from .taint import TaintTracker
+
+__all__ = ["ProjectContext", "ModuleSummary", "FnSummary", "SummaryCache",
+           "package_root", "collect_declared_axes", "collect_axis_sizes",
+           "DEFAULT_SUMMARY_CACHE"]
+
+DEFAULT_SUMMARY_CACHE = os.path.join(
+    tempfile.gettempdir(),
+    "mxnet_tpu_tracelint_summaries_%s.json"
+    % getattr(os, "getuid", lambda: "u")())
+
+# methods/builtins whose call on a tainted value is a host sync (mirrors
+# rules.TPU001; kept literal here so project.py has no import cycle with
+# rules.py)
+_SYNC_METHODS = ("asnumpy", "asscalar", "item", "tolist", "wait_to_read",
+                 "wait_to_write")
+_SYNC_BUILTINS = ("float", "int", "bool", "complex")
+
+_MESH_DEFAULT_AXES = ("data", "fsdp", "seq", "model", "expert")
+_MESH_CTORS = ("create_mesh", "auto_mesh", "MeshConfig")
+_NON_AXIS_KWARGS = ("config", "devices", "axis_order", "axis",
+                    "model_parallel", "seq_parallel", "n_devices")
+
+
+def package_root(path):
+    """Topmost package directory containing `path` (a dir or .py file):
+    walk up while an ``__init__.py`` marks the parent as a package. A
+    plain script (tools/mxtop.py) returns None."""
+    path = os.path.abspath(path)
+    if os.path.isfile(path):
+        path = os.path.dirname(path)
+    if not os.path.isfile(os.path.join(path, "__init__.py")):
+        return None
+    while os.path.isfile(os.path.join(os.path.dirname(path),
+                                      "__init__.py")):
+        path = os.path.dirname(path)
+    return path
+
+
+def module_name_for(path, roots):
+    """Dotted module name of `path` under one of `roots` (package dirs),
+    or None when the file belongs to no known package."""
+    path = os.path.abspath(path)
+    for root in roots:
+        base = os.path.dirname(root)
+        if not path.startswith(root + os.sep) and path != root:
+            continue
+        rel = os.path.relpath(path, base)
+        if rel.endswith(".py"):
+            rel = rel[:-3]
+        parts = rel.split(os.sep)
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# declared mesh axes (shared by TPU007/TPU008 for file-local + project scan)
+# ---------------------------------------------------------------------------
+def _str_elts(node):
+    """String constants in a Constant/Tuple/List node."""
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+    return out
+
+
+def _call_name(node):
+    """Terminal callee name: `Mesh` for ``jax.sharding.Mesh(...)`` at any
+    attribute depth."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def collect_declared_axes(tree):
+    """Mesh axis names *declared* in a module: Mesh()/local_mesh() axis
+    literals, create_mesh/auto_mesh/MeshConfig axis kwargs (which imply
+    the MeshConfig default axes), ``axis_order=(...)`` literals (including
+    the dataclass field default in mesh.py itself), and
+    ``pmap(axis_name=...)``."""
+    axes = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.keyword) and node.arg == "axis_order":
+            axes.update(_str_elts(node.value))
+        if isinstance(node, (ast.AnnAssign, ast.Assign)):
+            # `axis_order: tuple = ("data", ...)` — the canonical
+            # declaration site in parallel/mesh.py's MeshConfig
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "axis_order"
+                   for t in targets) and node.value is not None:
+                axes.update(_str_elts(node.value))
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "Mesh":
+            if len(node.args) >= 2:
+                axes.update(_str_elts(node.args[1]))
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axes.update(_str_elts(kw.value))
+        elif name == "local_mesh":
+            explicit = False
+            if len(node.args) >= 2:
+                axes.update(_str_elts(node.args[1]))
+                explicit = True
+            for kw in node.keywords:
+                if kw.arg == "axis":
+                    axes.update(_str_elts(kw.value))
+                    explicit = True
+            if not explicit:
+                axes.add("data")   # local_mesh's default axis name
+        elif name in _MESH_CTORS:
+            axis_order_given = False
+            for kw in node.keywords:
+                if kw.arg == "axis_order":
+                    axes.update(_str_elts(kw.value))
+                    axis_order_given = True
+                elif kw.arg and kw.arg not in _NON_AXIS_KWARGS:
+                    axes.add(kw.arg)
+            if not axis_order_given:
+                # every MeshConfig (and create_mesh/auto_mesh, which
+                # build one) carries the default axis_order, keeping the
+                # standard axes nameable — unless an explicit axis_order
+                # literal replaced it
+                axes.update(_MESH_DEFAULT_AXES)
+        elif name in ("pmap", "xmap"):
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axes.update(_str_elts(kw.value))
+            # jax.pmap(f, "i") — positional axis_name
+            if name == "pmap" and len(node.args) >= 2:
+                axes.update(_str_elts(node.args[1]))
+    return axes
+
+
+def collect_axis_sizes(tree):
+    """Statically-known mesh axis sizes from literal mesh constructions:
+    {var_name: {axis: size}} for ``m = local_mesh(4)`` /
+    ``m = create_mesh(data=2, model=4)`` assignments (module- or
+    function-level)."""
+    sizes = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        name = _call_name(call)
+        per = None
+        if name == "local_mesh" and call.args and \
+                isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, int):
+            axis = "data"
+            if len(call.args) >= 2 and \
+                    isinstance(call.args[1], ast.Constant):
+                axis = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "axis" and isinstance(kw.value, ast.Constant):
+                    axis = kw.value.value
+            per = {axis: call.args[0].value}
+        elif name in ("create_mesh", "MeshConfig"):
+            per = {}
+            for kw in call.keywords:
+                if kw.arg and kw.arg not in _NON_AXIS_KWARGS and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, int):
+                    per[kw.arg] = kw.value.value
+            if not per:
+                per = None
+        if per:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    sizes[t.id] = per
+    return sizes
+
+
+# ---------------------------------------------------------------------------
+# per-module summaries
+# ---------------------------------------------------------------------------
+class FnSummary:
+    """Interprocedural facts about one top-level function."""
+
+    __slots__ = ("name", "arity", "has_vararg", "hazards")
+
+    def __init__(self, name, arity, has_vararg, hazards):
+        self.name = name
+        self.arity = arity          # positional params (incl. defaults)
+        self.has_vararg = has_vararg
+        # [(kind, line, detail)] — kind: 'sync' (fires when called with a
+        # tainted arg) | 'rng' (fires whenever called under trace)
+        self.hazards = hazards
+
+    def to_dict(self):
+        return {"name": self.name, "arity": self.arity,
+                "has_vararg": self.has_vararg, "hazards": self.hazards}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["name"], d["arity"], d["has_vararg"],
+                   [tuple(h) for h in d["hazards"]])
+
+
+class ModuleSummary:
+    """Facts one module exports to its importers."""
+
+    __slots__ = ("module", "path", "functions", "declared_axes")
+
+    def __init__(self, module, path, functions, declared_axes):
+        self.module = module
+        self.path = path
+        self.functions = functions       # {name: FnSummary}
+        self.declared_axes = declared_axes
+
+    def to_dict(self):
+        return {"module": self.module, "path": self.path,
+                "functions": {k: v.to_dict()
+                              for k, v in self.functions.items()},
+                "declared_axes": sorted(self.declared_axes)}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["module"], d["path"],
+                   {k: FnSummary.from_dict(v)
+                    for k, v in d.get("functions", {}).items()},
+                   set(d.get("declared_axes", [])))
+
+
+def _fn_hazards(func, mod_rng):
+    """Direct host-sync/RNG hazard sites in `func`'s body, computed with
+    EVERY parameter tainted (the summary answers "what if a tracer is
+    passed?"). `mod_rng` is the module's (random_aliases, random_names,
+    np_random_aliases, np_random_names, np_aliases, np_names) tuple."""
+    args = func.args
+    params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+              if a.arg not in ("self", "cls")]
+    for extra in (args.vararg, args.kwarg):
+        if extra is not None:
+            params.append(extra.arg)
+    taint = TaintTracker(func, params)
+    (rand_alias, rand_names, npr_alias, npr_names, np_alias,
+     np_names) = mod_rng
+    hazards = []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SYNC_METHODS and taint.is_tainted(f.value):
+                hazards.append(("sync", node.lineno,
+                                ".%s()" % f.attr))
+                continue
+            chain = _dotted(f)
+            if chain and (chain[0] in rand_alias or
+                          chain[0] in npr_alias or
+                          (chain[0] in np_alias and len(chain) >= 3 and
+                           chain[1] == "random")):
+                hazards.append(("rng", node.lineno,
+                                "%s()" % ".".join(chain)))
+            elif chain and chain[0] in np_alias and \
+                    not (len(chain) > 1 and chain[1] == "random") and \
+                    _any_arg_tainted(taint, node):
+                hazards.append(("sync", node.lineno,
+                                "%s()" % ".".join(chain)))
+        elif isinstance(f, ast.Name):
+            if f.id in _SYNC_BUILTINS and len(node.args) == 1 and \
+                    taint.is_tainted(node.args[0]):
+                hazards.append(("sync", node.lineno, "%s()" % f.id))
+            elif f.id in rand_names or f.id in npr_names:
+                hazards.append(("rng", node.lineno, "%s()" % f.id))
+            elif f.id in np_names and _any_arg_tainted(taint, node):
+                hazards.append(("sync", node.lineno, "%s()" % f.id))
+    return hazards
+
+
+def _any_arg_tainted(taint, call):
+    return any(taint.is_tainted(a) for a in call.args) or \
+        any(taint.is_tainted(kw.value) for kw in call.keywords)
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _rng_imports(tree):
+    """Same RNG-import aliasing model as engine.ModuleInfo, condensed."""
+    rand_alias, rand_names = set(), set()
+    npr_alias, npr_names = set(), set()
+    np_alias, np_names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                top = alias.name.split(".")[0]
+                if alias.name.startswith("numpy.random") and alias.asname:
+                    npr_alias.add(alias.asname)
+                elif top == "numpy":
+                    np_alias.add(alias.asname or top)
+                elif top == "random":
+                    rand_alias.add(alias.asname or top)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "numpy":
+                for alias in node.names:
+                    if alias.name == "random":
+                        npr_alias.add(alias.asname or "random")
+                    else:
+                        np_names.add(alias.asname or alias.name)
+            elif mod.startswith("numpy.random"):
+                for alias in node.names:
+                    npr_names.add(alias.asname or alias.name)
+            elif mod == "random":
+                for alias in node.names:
+                    rand_names.add(alias.asname or alias.name)
+    return (rand_alias, rand_names, npr_alias, npr_names, np_alias,
+            np_names)
+
+
+def summarize_source(source, module, path):
+    """Build a ModuleSummary from source text (no filesystem access)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return ModuleSummary(module, path, {}, set())
+    mod_rng = _rng_imports(tree)
+    functions = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = node.args
+        arity = len(args.posonlyargs) + len(args.args)
+        functions[node.name] = FnSummary(
+            node.name, arity, args.vararg is not None,
+            _fn_hazards(node, mod_rng))
+    return ModuleSummary(module, path, functions,
+                         collect_declared_axes(tree))
+
+
+# ---------------------------------------------------------------------------
+# summary cache (same key contract as cli.FileCache)
+# ---------------------------------------------------------------------------
+class SummaryCache:
+    def __init__(self, path, lint_version):
+        self.path = path
+        self.version = lint_version
+        self._files = {}
+        self._dirty = False
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("version") == lint_version:
+                self._files = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    def get(self, fname):
+        entry = self._files.get(os.path.abspath(fname))
+        if not entry:
+            return None
+        try:
+            st = os.stat(fname)
+        except OSError:
+            return None
+        if entry.get("mtime") != st.st_mtime or \
+                entry.get("size") != st.st_size:
+            return None
+        try:
+            return ModuleSummary.from_dict(entry["summary"])
+        except (KeyError, TypeError):
+            return None
+
+    def put(self, fname, summary):
+        try:
+            st = os.stat(fname)
+        except OSError:
+            return
+        self._files[os.path.abspath(fname)] = {
+            "mtime": st.st_mtime, "size": st.st_size,
+            "summary": summary.to_dict()}
+        self._dirty = True
+
+    def save(self):
+        if not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": self.version, "files": self._files},
+                          f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the context
+# ---------------------------------------------------------------------------
+class ProjectContext:
+    """Module-name → file map + lazily computed summaries for a set of
+    package roots. Handed to ModuleInfo/rules via `lint_paths`."""
+
+    def __init__(self, roots, cache_path=None, lint_version=0):
+        self.roots = sorted({os.path.abspath(r) for r in roots if r})
+        self._modules = {}          # dotted name -> path
+        self._summaries = {}        # dotted name -> ModuleSummary | None
+        self._axes = None
+        self._digest = None
+        self._cache = (SummaryCache(cache_path, lint_version)
+                       if cache_path else None)
+        for root in self.roots:
+            self._scan(root)
+
+    def _scan(self, root):
+        base = os.path.dirname(root)
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git", "build",
+                                          ".pytest_cache"))
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, base)[:-3]
+                parts = rel.split(os.sep)
+                if parts[-1] == "__init__":
+                    parts = parts[:-1]
+                self._modules[".".join(parts)] = path
+
+    # ---------------------------------------------------------------- API
+    def module_path(self, dotted):
+        return self._modules.get(dotted)
+
+    def module_name_for(self, path):
+        return module_name_for(path, self.roots)
+
+    def resolve_import(self, module_name, node):
+        """{local alias: (dotted module, symbol|None)} for one
+        Import/ImportFrom node, restricted to modules in this project.
+        `module_name` (the importer's dotted name) anchors relative
+        imports; None limits resolution to absolute ones."""
+        out = {}
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name not in self._modules:
+                    continue
+                if alias.asname:        # import a.b.c as x → x is a.b.c
+                    out[alias.asname] = (alias.name, None)
+                else:                   # import a.b.c → binds `a`
+                    top = alias.name.split(".")[0]
+                    if top in self._modules:
+                        out[top] = (top, None)
+            return out
+        if not isinstance(node, ast.ImportFrom):
+            return out
+        base = node.module or ""
+        if node.level:
+            if not module_name:
+                return out
+            parts = module_name.split(".")
+            # level 1 anchors at the importer's own package: for a module
+            # that is parts[:-1], but a package __init__ (whose dotted
+            # name IS the package — module_name_for strips the __init__
+            # segment) anchors at itself; each extra level climbs one
+            # more package
+            path = self._modules.get(module_name, "")
+            is_pkg = os.path.basename(path) == "__init__.py"
+            drop = node.level - 1 if is_pkg else node.level
+            if drop > len(parts):
+                return out
+            anchor = parts[:len(parts) - drop]
+            if not anchor:
+                return out
+            base = ".".join(anchor + ([base] if base else []))
+        for alias in node.names:
+            target = "%s.%s" % (base, alias.name) if base else alias.name
+            if target in self._modules:
+                out[alias.asname or alias.name] = (target, None)
+            elif base in self._modules:
+                out[alias.asname or alias.name] = (base, alias.name)
+        return out
+
+    def summary(self, dotted):
+        """ModuleSummary for a project module (None for unknown ones)."""
+        if dotted in self._summaries:
+            return self._summaries[dotted]
+        path = self._modules.get(dotted)
+        if path is None:
+            self._summaries[dotted] = None
+            return None
+        summ = self._cache.get(path) if self._cache else None
+        if summ is None:
+            try:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    source = f.read()
+            except OSError:
+                self._summaries[dotted] = None
+                return None
+            summ = summarize_source(source, dotted, path)
+            if self._cache:
+                self._cache.put(path, summ)
+        self._summaries[dotted] = summ
+        return summ
+
+    def function_summary(self, dotted_module, fn_name):
+        summ = self.summary(dotted_module)
+        if summ is None:
+            return None
+        return summ.functions.get(fn_name)
+
+    def declared_axes(self):
+        """Union of mesh axes declared anywhere in the project."""
+        if self._axes is None:
+            axes = set()
+            for dotted in sorted(self._modules):
+                summ = self.summary(dotted)
+                if summ is not None:
+                    axes |= summ.declared_axes
+            self._axes = axes
+        return self._axes
+
+    def digest(self):
+        """One token folding every project file's (path, mtime, size) —
+        findings-cache entries key on it so editing a helper module
+        invalidates its callers' cached findings."""
+        if self._digest is None:
+            import hashlib
+            h = hashlib.sha1()
+            for dotted in sorted(self._modules):
+                path = self._modules[dotted]
+                try:
+                    st = os.stat(path)
+                    h.update(("%s:%s:%s;" % (path, st.st_mtime_ns,
+                                             st.st_size)).encode())
+                except OSError:
+                    h.update(("%s:gone;" % path).encode())
+            self._digest = h.hexdigest()[:16]
+        return self._digest
+
+    def save_cache(self):
+        if self._cache:
+            self._cache.save()

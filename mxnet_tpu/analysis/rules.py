@@ -18,6 +18,7 @@ class — nothing else changes.
 from __future__ import annotations
 
 import ast
+import os
 
 from .findings import Finding, Severity
 from .taint import TaintTracker, UNTAINTED_CALLS
@@ -25,7 +26,7 @@ from .taint import TaintTracker, UNTAINTED_CALLS
 __all__ = ["RULES", "register", "Rule", "rule_table", "LINT_VERSION"]
 
 # bump when rule logic changes — invalidates the per-file mtime cache
-LINT_VERSION = 6
+LINT_VERSION = 7
 
 RULES = {}
 
@@ -114,6 +115,10 @@ class HostSyncUnderTrace(Rule):
             if not isinstance(node, ast.Call):
                 continue
             func = node.func
+            cross = self._cross_file_sync(fn, mod, node)
+            if cross is not None:
+                yield cross
+                continue
             if isinstance(func, ast.Attribute):
                 if func.attr in _SYNC_METHODS and \
                         fn.taint.is_tainted(func.value):
@@ -158,6 +163,35 @@ class HostSyncUnderTrace(Rule):
                         mod, node,
                         "host numpy call %s() on a traced value" % func.id,
                         symbol=fn.qualname)
+
+    def _cross_file_sync(self, fn, mod, node):
+        """One-level cross-file taint: a call from a traced body into an
+        imported project helper whose summary says it host-syncs a
+        tainted argument (`project.ModuleSummary`). The finding lands at
+        the traced CALL SITE — that is where the fix (hoist the host
+        read) belongs — and names the helper's own sync line."""
+        if mod.project is None or not self._any_tainted(fn, node):
+            return None
+        res = mod.resolve_callee(dotted(node.func) or [])
+        if res is None:
+            return None
+        summ = mod.project.function_summary(*res)
+        if summ is None:
+            return None
+        syncs = [h for h in summ.hazards if h[0] == "sync"]
+        if not syncs:
+            return None
+        _, line, detail = syncs[0]
+        helper = "%s.%s" % res
+        return self._finding(
+            mod, node,
+            "call into %s() reaches a host sync (%s at %s:%d) with a "
+            "traced argument — the helper pulls the tracer to the host"
+            % (helper, detail,
+               os.path.basename(mod.project.summary(res[0]).path), line),
+            hint="keep helpers called under trace device-pure; hoist the "
+                 "host read out of the traced body",
+            symbol=fn.qualname)
 
     @staticmethod
     def _any_tainted(fn, call):
@@ -531,6 +565,10 @@ class HostRngUnderTrace(Rule):
             chain = dotted(node.func)
             if not chain:
                 continue
+            cross = self._cross_file_rng(fn, mod, node, chain)
+            if cross is not None:
+                yield cross
+                continue
             if len(chain) == 1:
                 # from random import randint / from numpy.random import x
                 if chain[0] in mod.random_names:
@@ -559,6 +597,30 @@ class HostRngUnderTrace(Rule):
                     "numpy RNG call %s() under trace is a trace-time "
                     "constant" % ".".join(chain),
                     symbol=fn.qualname)
+
+    def _cross_file_rng(self, fn, mod, node, chain):
+        """One-level cross-file taint, RNG flavor: calling an imported
+        project helper that draws host RNG bakes the draw in at trace
+        time no matter what arguments it gets."""
+        if mod.project is None:
+            return None
+        res = mod.resolve_callee(chain)
+        if res is None:
+            return None
+        summ = mod.project.function_summary(*res)
+        if summ is None:
+            return None
+        rngs = [h for h in summ.hazards if h[0] == "rng"]
+        if not rngs:
+            return None
+        _, line, detail = rngs[0]
+        return self._finding(
+            mod, node,
+            "call into %s.%s() draws host RNG (%s at %s:%d) — under "
+            "trace the draw happens once and compiles in as a constant"
+            % (res[0], res[1], detail,
+               os.path.basename(mod.project.summary(res[0]).path), line),
+            symbol=fn.qualname)
 
 
 # --------------------------------------------------------------------------
@@ -724,3 +786,9 @@ class ThreadSharedStateLint(Rule):
                 "module-level mutable %r mutated from thread-reachable "
                 "%s() without holding a lock" % (mutated, func.name),
                 symbol=func.name)
+
+
+# TPU007/TPU008 live in their own module (they share the project-level
+# mesh-axis machinery); importing registers them. Deliberately last:
+# spmd_rules imports Rule/register from this partially-initialized module.
+from . import spmd_rules  # noqa: E402,F401

@@ -1,0 +1,615 @@
+"""SPMD rules: sharding-annotation (TPU007) & collective-safety (TPU008).
+
+Both rules check the *partitioning contract* — the axis names, partition
+rules, and collectives that today only fail at runtime, as an XLA error
+(bad `in_shardings` arity, unknown axis) or worse, a cross-rank hang
+minutes into a pod job (rank-divergent conditional collective). Relay
+(PAPERS.md) is the precedent: catch annotation-level errors on the IR
+before execution.
+
+The mesh-axis *universe* both rules validate against is collected from
+declaration sites — `Mesh(devs, ("data", "model"))`,
+`create_mesh(data=4)`, `MeshConfig(...)`/``axis_order=`` literals,
+`pmap(axis_name=...)` — in the linted file AND, when a
+`ProjectContext` is active (directory linting), across the whole
+project, so `parallel/mesh.py`'s canonical axes cover every module.
+When no declaration is visible anywhere the axis checks stay silent
+(an unknown universe proves nothing).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Severity
+from .rules import Rule, register, dotted
+from .project import (collect_declared_axes, collect_axis_sizes,
+                      _str_elts)
+
+__all__ = ["ShardingAnnotationLint", "CollectiveSafetyLint"]
+
+# collectives (by terminal attribute/function name) that participate in a
+# mesh-wide rendezvous — every rank must execute the same sequence.
+# NOT axis_index: it reads the local coordinate without any cross-rank
+# rendezvous, so it is legal inside divergent branches.
+_COLLECTIVE_NAMES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "reduce_scatter", "ppermute", "pshuffle", "all_to_all",
+    "all_reduce", "psum_bucketed", "all_reduce_multi", "barrier",
+})
+
+# everything whose axis_name argument must resolve against a declared
+# mesh axis (the rendezvous set plus the local-coordinate reads)
+_AXIS_USERS = _COLLECTIVE_NAMES | {"axis_index"}
+
+# where each collective's axis-name argument lives: positional index
+# (after the array arg(s)) and accepted keyword names
+_AXIS_ARG_POS = {
+    "axis_index": 0,
+    "all_reduce_multi": 2,
+    "psum_bucketed": 1,
+}
+_AXIS_KWARGS = ("axis_name", "axis")
+_DEFAULT_AXIS_POS = 1   # psum(x, axis_name), all_gather(x, axis_name), ...
+
+_ARRAY_CTORS = frozenset({"ones", "zeros", "full", "empty", "normal",
+                          "uniform", "arange", "asarray"})
+
+_META = re.compile(r"[.^$*+?{}\[\]()|\\]")
+# anchors/zero-width assertions make substring-shadowing proofs unsound
+_ANCHORED = re.compile(r"[\^$]|\\[AbBZ]|\(\?[=!<]")
+
+
+def _walk_own_scope(root):
+    """ast.walk that does not descend into nested function definitions or
+    lambdas — their bodies run on their own schedule, not the scope's
+    (defining a function executes nothing)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _axes_universe(mod):
+    """Declared-axis union: file-local + project-wide. Memoized on the
+    ModuleInfo (TPU007 and TPU008 share it)."""
+    axes = getattr(mod, "_axes_universe", None)
+    if axes is None:
+        axes = set(collect_declared_axes(mod.tree))
+        if mod.project is not None:
+            axes |= mod.project.declared_axes()
+        mod._axes_universe = axes
+    return axes
+
+
+def _axis_literals(call, name):
+    """String axis names passed to collective `name` in `call` — the
+    positional axis slot or an axis_name=/axis= kwarg. Non-literal
+    (variable) axis args yield nothing: they are not statically
+    checkable."""
+    out = []
+    pos = _AXIS_ARG_POS.get(name, _DEFAULT_AXIS_POS)
+    if len(call.args) > pos:
+        out.extend(_str_elts(call.args[pos]))
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            out.extend(_str_elts(kw.value))
+    return out
+
+
+def _split_alternation(pattern):
+    """Split a regex on TOP-LEVEL ``|`` only (not inside groups or
+    classes). Returns the branch strings."""
+    branches, buf, depth, in_class, esc = [], [], 0, False, False
+    for ch in pattern:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            esc = True
+            continue
+        if in_class:
+            buf.append(ch)
+            if ch == "]":
+                in_class = False
+            continue
+        if ch == "[":
+            in_class = True
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        elif ch == "|" and depth == 0:
+            branches.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    branches.append("".join(buf))
+    return branches
+
+
+# --------------------------------------------------------------------------
+# TPU007 — sharding annotations
+# --------------------------------------------------------------------------
+@register
+class ShardingAnnotationLint(Rule):
+    code = "TPU007"
+    name = "sharding-annotation"
+    severity = Severity.ERROR
+    scope = "module"
+    description = ("PartitionSpec axes that no mesh declares, "
+                   "in_shardings/out_shardings whose arity cannot match "
+                   "the jitted function, and partition rules dead under "
+                   "first-match-wins ordering — each is a runtime XLA "
+                   "error (or a silently replicated param) caught at the "
+                   "annotation level.")
+    hint = ("declare the axis on the mesh (create_mesh/MeshConfig) or fix "
+            "the spec; order partition rules most-specific-first")
+
+    def check_module(self, mod):
+        yield from self._check_spec_axes(mod)
+        yield from self._check_jit_sharding_arity(mod)
+        yield from self._check_rule_tables(mod)
+
+    # -------------------------------------------------- axis declarations
+    def _check_spec_axes(self, mod):
+        universe = _axes_universe(mod)
+        if not universe:
+            return
+        ps_names = mod.ps_aliases | {"PartitionSpec"}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] not in ps_names:
+                continue
+            for arg in node.args:
+                for axis in _str_elts(arg):
+                    if axis not in universe:
+                        yield self._finding(
+                            mod, node,
+                            "PartitionSpec names mesh axis %r but no mesh "
+                            "declares it (declared: %s)"
+                            % (axis, ", ".join(sorted(universe))))
+
+    # -------------------------------------------------------- jit arity
+    def _check_jit_sharding_arity(self, mod):
+        by_name = {}
+        for func in mod.all_functions:
+            by_name.setdefault(func.name, func)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] not in ("jit", "pjit"):
+                continue
+            if not node.args:
+                continue
+            func = None
+            fn_name = n_params = positional = None
+            if isinstance(node.args[0], ast.Name):
+                func = by_name.get(node.args[0].id)
+            if func is not None:
+                if func.args.vararg is not None:
+                    continue
+                positional = [a.arg for a in func.args.posonlyargs +
+                              func.args.args]
+                n_params = len(positional)
+                fn_name = func.name
+            elif mod.project is not None:
+                # one import hop: the summary carries arity/has_vararg
+                res = mod.resolve_callee(dotted(node.args[0]) or [])
+                summ = (mod.project.function_summary(*res)
+                        if res else None)
+                if summ is None or summ.has_vararg:
+                    continue
+                n_params = summ.arity
+                fn_name = "%s.%s" % res
+            else:
+                continue
+            static = set()
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    vals = kw.value.elts if isinstance(
+                        kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                    static |= {v.value for v in vals
+                               if isinstance(v, ast.Constant)}
+            # only static selectors that hit a POSITIONAL parameter shrink
+            # the in_shardings pytree (a static_argnames naming a
+            # keyword-only param never occupied an in_shardings slot);
+            # without the param-name list (cross-file), string selectors
+            # make the count unprovable — stay silent
+            if positional is None and any(
+                    isinstance(s, str) for s in static):
+                continue
+            static_positional = {
+                s for s in static
+                if (isinstance(s, int) and 0 <= s < n_params) or
+                   (isinstance(s, str) and positional is not None and
+                    s in positional)}
+            n_traced = n_params - len(static_positional)
+            for kw in node.keywords:
+                if kw.arg == "in_shardings" and \
+                        isinstance(kw.value, (ast.Tuple, ast.List)):
+                    n_spec = len(kw.value.elts)
+                    if n_spec != n_traced:
+                        yield self._finding(
+                            mod, node,
+                            "in_shardings has %d entries but %s() takes "
+                            "%d traced argument(s)"
+                            % (n_spec, fn_name, n_traced),
+                            hint="one in_shardings entry per non-static "
+                                 "positional parameter")
+                elif kw.arg == "out_shardings" and func is not None and \
+                        isinstance(kw.value, (ast.Tuple, ast.List)):
+                    n_out = self._return_arity(func)
+                    if n_out is not None and n_out != len(kw.value.elts):
+                        yield self._finding(
+                            mod, node,
+                            "out_shardings has %d entries but %s() "
+                            "returns %d value(s)"
+                            % (len(kw.value.elts), fn_name, n_out))
+
+    @staticmethod
+    def _return_arity(func):
+        """Tuple arity of `func`'s OWN returns (nested defs/lambdas have
+        their own return scope) when every return is a literal tuple of
+        one consistent length; None when not statically evident."""
+        arity = None
+        for node in _walk_own_scope(func):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if not isinstance(node.value, ast.Tuple):
+                return None
+            n = len(node.value.elts)
+            if arity is None:
+                arity = n
+            elif arity != n:
+                return None
+        return arity
+
+    # ------------------------------------------------- dead rule entries
+    def _check_rule_tables(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] not in ("ShardingRules",
+                                              "match_partition_rules"):
+                continue
+            table = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "rules":
+                    table = kw.value
+            if not isinstance(table, (ast.List, ast.Tuple)):
+                continue
+            yield from self._check_rule_order(mod, table)
+
+    def _check_rule_order(self, mod, table):
+        earlier = []   # [(pattern str, compiled | None, node)]
+        for entry in table.elts:
+            if not isinstance(entry, (ast.Tuple, ast.List)) or \
+                    not entry.elts:
+                continue
+            pat_node = entry.elts[0]
+            if not isinstance(pat_node, ast.Constant) or \
+                    not isinstance(pat_node.value, str):
+                continue
+            pattern = pat_node.value
+            try:
+                compiled = re.compile(pattern)
+            except re.error as e:
+                yield self._finding(
+                    mod, pat_node,
+                    "invalid regex in partition rule: %r (%s)"
+                    % (pattern, e),
+                    hint="the rule silently matches nothing at runtime")
+                earlier.append((pattern, None, pat_node))
+                continue
+            shadow = self._shadowed_by(pattern, earlier)
+            if shadow is not None:
+                yield self._finding(
+                    mod, pat_node,
+                    "dead partition rule: every name r'%s' matches is "
+                    "already claimed by the earlier rule r'%s' "
+                    "(first match wins)" % (pattern, shadow),
+                    severity=Severity.WARNING,
+                    hint="order rules most-specific-first or delete the "
+                         "unreachable entry")
+            earlier.append((pattern, compiled, pat_node))
+
+    @staticmethod
+    def _shadowed_by(pattern, earlier):
+        """The earlier pattern proving `pattern` dead, or None.
+
+        Sufficient condition, sound for `re.search` matching: a branch
+        with no regex metacharacters matches exactly the names containing
+        it as a substring; if an earlier pattern finds a match *inside
+        the branch text itself*, that match also exists inside any name
+        containing the branch — so the earlier rule always claims the
+        name first. That implication breaks for anchored/zero-width
+        constructs (``^ $ \\A \\Z \\b \\B``, lookarounds): a match
+        against the bare branch text need not survive embedding in a
+        longer name, so such earlier patterns never prove deadness. A
+        rule is dead when every one of its top-level alternation
+        branches is literal and shadowed; branches with metacharacters
+        are unprovable and keep the rule alive."""
+        if not earlier:
+            return None
+        shadows = set()
+        for branch in _split_alternation(pattern):
+            if not branch or _META.search(branch):
+                return None
+            hit = None
+            for prev_pat, prev_re, _ in earlier:
+                if prev_re is not None and \
+                        not _ANCHORED.search(prev_pat) and \
+                        prev_re.search(branch):
+                    hit = prev_pat
+                    break
+            if hit is None:
+                return None
+            shadows.add(hit)
+        return sorted(shadows)[0] if shadows else None
+
+
+# --------------------------------------------------------------------------
+# TPU008 — collective safety
+# --------------------------------------------------------------------------
+@register
+class CollectiveSafetyLint(Rule):
+    code = "TPU008"
+    name = "collective-safety"
+    severity = Severity.ERROR
+    scope = "module"
+    description = ("Collectives under data-dependent control flow in "
+                   "traced regions (a rank-divergent predicate means some "
+                   "ranks join the rendezvous and some never do — a "
+                   "deadlock, not an error message), axis_name arguments "
+                   "no mesh binds, and statically-known leading dims that "
+                   "force all_reduce_multi's zero-padding.")
+    hint = ("hoist the collective out of the branch (compute both sides "
+            "and F.where-select, or psum the predicate first so every "
+            "rank agrees)")
+
+    def check_module(self, mod):
+        for fn in mod.traced:
+            yield from self._check_divergent_collectives(fn, mod)
+            yield from self._check_cond_branches(fn, mod)
+        yield from self._check_axis_bindings(mod)
+        yield from self._check_multi_divisibility(mod)
+
+    # ------------------------------------- collectives under tainted flow
+    @staticmethod
+    def _collective_calls(node, own_scope=False):
+        """Collective Call nodes under `node`. `own_scope=True` skips
+        nested def/lambda bodies — a function merely DEFINED inside a
+        branch executes nothing there."""
+        walker = _walk_own_scope(node) if own_scope else ast.walk(node)
+        for sub in walker:
+            if isinstance(sub, ast.Call):
+                chain = dotted(sub.func) or []
+                if chain and chain[-1] in _COLLECTIVE_NAMES:
+                    yield sub, chain
+
+    def _check_divergent_collectives(self, fn, mod):
+        # one finding per collective call, even when several tainted
+        # conditionals nest around it (ast.walk visits outermost-first,
+        # so the finding names the OUTERMOST divergent predicate; a
+        # seen-set keeps duplicates out of counts and baselines)
+        seen = set()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, (ast.If, ast.While)) or \
+                    not fn.taint.is_tainted(node.test):
+                continue
+            # the predicate itself runs on every rank — only the BODY
+            # (and else) execute divergently; nested defs/lambdas in the
+            # branch are declarations, not executions
+            body = node.body + node.orelse
+            for call, chain in (c for stmt in body
+                                for c in self._collective_calls(
+                                    stmt, own_scope=True)):
+                key = (call.lineno, call.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self._finding(
+                    mod, call,
+                    "collective %s() under a data-dependent %s "
+                    "(predicate at line %d) — ranks that branch "
+                    "differently never meet in the rendezvous and the "
+                    "mesh deadlocks"
+                    % (".".join(chain),
+                       "if" if isinstance(node, ast.If) else "while",
+                       node.lineno),
+                    symbol=fn.qualname)
+
+    def _check_cond_branches(self, fn, mod):
+        """lax.cond/switch with a traced predicate traces fine — but a
+        collective inside only SOME branches still diverges per rank at
+        run time."""
+        by_name = {}
+        for func in mod.all_functions:
+            by_name.setdefault(func.name, func)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] not in ("cond", "switch") or \
+                    not node.args:
+                continue
+            if not fn.taint.is_tainted(node.args[0]):
+                continue
+            hit = None
+            for branch in node.args[1:]:
+                target = None
+                if isinstance(branch, ast.Lambda):
+                    target = branch
+                elif isinstance(branch, ast.Name) and \
+                        branch.id in by_name:
+                    target = by_name[branch.id]
+                if target is None:
+                    continue
+                for _call, cchain in self._collective_calls(target):
+                    hit = cchain
+                    break
+                if hit:
+                    break
+            if hit:
+                yield self._finding(
+                    mod, node,
+                    "collective %s() inside a lax.%s branch selected by "
+                    "a data-dependent predicate — rank-divergent branch "
+                    "selection deadlocks the mesh"
+                    % (".".join(hit), chain[-1]),
+                    symbol=fn.qualname)
+
+    # ----------------------------------------------------- axis bindings
+    def _check_axis_bindings(self, mod):
+        universe = _axes_universe(mod)
+        if not universe:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] not in _AXIS_USERS:
+                continue
+            for axis in _axis_literals(node, chain[-1]):
+                if axis not in universe:
+                    yield self._finding(
+                        mod, node,
+                        "axis_name %r in %s() is bound by no mesh or "
+                        "shard_map declaration (declared: %s) — this "
+                        "raises NameError-style unbound-axis errors at "
+                        "trace time"
+                        % (axis, ".".join(chain),
+                           ", ".join(sorted(universe))),
+                        hint="collectives resolve axis names against the "
+                             "enclosing mesh/shard_map — use a declared "
+                             "axis or add it to the mesh")
+
+    # ----------------------------------------------- static divisibility
+    @staticmethod
+    def _scopes(mod):
+        """Name-resolution scopes for the shape/mesh-size heuristics: each
+        function, plus the module's top-level statements (so `g` in one
+        function never aliases an unrelated `g` in another)."""
+        for func in mod.all_functions:
+            yield func
+        top = [s for s in mod.tree.body
+               if not isinstance(s, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef))]
+        if top:
+            yield ast.Module(body=top, type_ignores=[])
+
+    def _check_multi_divisibility(self, mod):
+        seen = set()   # nested functions are walked twice (own scope +
+        # enclosing) — report each call once
+        for scope in self._scopes(mod):
+            yield from self._check_divisibility_scope(mod, scope, seen)
+
+    def _check_divisibility_scope(self, mod, scope, seen):
+        mesh_sizes = collect_axis_sizes(scope)
+        if not mesh_sizes:
+            return
+        shapes = self._literal_leading_dims(scope)
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] != "all_reduce_multi":
+                continue
+            if (node.lineno, node.col_offset) in seen:
+                continue
+            seen.add((node.lineno, node.col_offset))
+            per = self._mesh_for_call(node, mesh_sizes)
+            if per is None:
+                continue
+            axis = None
+            if len(node.args) > 2:
+                lits = _str_elts(node.args[2])
+                axis = lits[0] if lits else None
+            for kw in node.keywords:
+                if kw.arg == "axis":
+                    lits = _str_elts(kw.value)
+                    axis = lits[0] if lits else axis
+            if axis is not None:
+                size = per.get(axis)
+            elif len(per) == 1:
+                size = next(iter(per.values()))
+            else:
+                size = per.get("data")
+            if not size or size <= 1:
+                continue
+            arrays = node.args[0] if node.args else None
+            if not isinstance(arrays, (ast.List, ast.Tuple)):
+                continue
+            for elt in arrays.elts:
+                if not isinstance(elt, ast.Name):
+                    continue
+                m = shapes.get(elt.id)
+                if m is not None and m % size:
+                    yield self._finding(
+                        mod, node,
+                        "leading dim %d of %r does not divide the mesh "
+                        "axis size %d — all_reduce_multi zero-pads it to "
+                        "%d (extra bytes on the wire every step)"
+                        % (m, elt.id, size,
+                           (m + size - 1) // size * size),
+                        severity=Severity.WARNING,
+                        hint="size the leading dim to a multiple of the "
+                             "reduce axis, or accept the padding "
+                             "knowingly")
+
+    @staticmethod
+    def _mesh_for_call(node, mesh_sizes):
+        mesh_arg = None
+        if len(node.args) > 1:
+            mesh_arg = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mesh":
+                mesh_arg = kw.value
+        if isinstance(mesh_arg, ast.Name):
+            return mesh_sizes.get(mesh_arg.id)
+        return None
+
+    @staticmethod
+    def _literal_leading_dims(tree):
+        """{name: leading_dim} for names assigned array ctors with literal
+        shapes (`x = jnp.ones((6, 4))`, `y = np.zeros(shape=(3,))`)."""
+        out = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            chain = dotted(call.func) or []
+            if not chain or chain[-1] not in _ARRAY_CTORS:
+                continue
+            shape = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg in ("shape", "size"):
+                    shape = kw.value
+            lead = None
+            if isinstance(shape, (ast.Tuple, ast.List)) and shape.elts:
+                first = shape.elts[0]
+                if isinstance(first, ast.Constant) and \
+                        isinstance(first.value, int):
+                    lead = first.value
+            elif isinstance(shape, ast.Constant) and \
+                    isinstance(shape.value, int):
+                lead = shape.value
+            if lead is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = lead
+        return out

@@ -23,7 +23,8 @@ from __future__ import annotations
 
 import ast
 
-__all__ = ["TaintTracker", "STATIC_ATTRS", "UNTAINTED_CALLS"]
+__all__ = ["TaintTracker", "STATIC_ATTRS", "UNTAINTED_CALLS",
+           "DEVICE_VARYING_CALLS"]
 
 # attributes of a traced array whose value is static under trace
 STATIC_ATTRS = frozenset({
@@ -44,6 +45,14 @@ UNTAINTED_CALLS = frozenset({
 # trace (flagged separately as host syncs by TPU001 where applicable)
 _HOST_RESULT_METHODS = frozenset({
     "asnumpy", "item", "asscalar", "tolist", "astype_scalar",
+})
+
+# calls whose RESULT varies per rank/device regardless of argument taint:
+# `lax.axis_index('data')` is a tracer under trace AND the canonical
+# rank-divergent predicate source (`if axis_index(...) == 0: barrier()`
+# deadlocks the mesh — TPU003/TPU008 need the taint to see it)
+DEVICE_VARYING_CALLS = frozenset({
+    "axis_index", "process_index",
 })
 
 
@@ -156,12 +165,16 @@ class TaintTracker(ast.NodeVisitor):
     def _call_tainted(self, node):
         func = node.func
         if isinstance(func, ast.Name):
+            if func.id in DEVICE_VARYING_CALLS:
+                return True
             if func.id in UNTAINTED_CALLS or func.id in (
                     "float", "int", "bool", "complex", "str"):
                 # float(x) on a tracer is a host sync — TPU001's problem;
                 # its *result* is a host scalar
                 return False
         if isinstance(func, ast.Attribute):
+            if func.attr in DEVICE_VARYING_CALLS:
+                return True   # per-rank value, tainted by construction
             if func.attr in _HOST_RESULT_METHODS:
                 return False  # already a host value (and a TPU001 finding)
             if self.is_tainted(func.value):

@@ -98,6 +98,22 @@ def _eager_allreduce(arr, mesh, axis):
     from .. import telemetry as _telem
     from ..resilience import faults as _faults
     from ..resilience.retry import call_with_retry
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    if arr.shape[0] % n:
+        # odd leading dim: the single-array fused program pads-and-slices
+        # (shard_map's in_specs would reject the ragged shard outright)
+        fn = _multi_allreduce_fn(mesh, axis, [tuple(arr.shape)], arr.dtype)
+
+        def dispatch_padded():
+            _faults.check(
+                "collective.all_reduce",
+                context="shape=%s axis=%s (padded)"
+                        % (tuple(arr.shape), axis))
+            return fn(arr)[0]
+
+        _telem.inc("comm.collectives")
+        return call_with_retry(dispatch_padded,
+                               site="collective.all_reduce")
     spec = P(axis)
     f = shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
                   in_specs=spec, out_specs=P())
@@ -115,27 +131,44 @@ def _eager_allreduce(arr, mesh, axis):
 _MULTI_AR_CACHE = {}
 
 
+def _padded_leading(m, n):
+    """Smallest multiple of `n` that holds `m` leading rows."""
+    return (m + n - 1) // n * n
+
+
 def _multi_allreduce_fn(mesh, axis, shapes, dtype):
     key = (mesh, axis, tuple(tuple(s) for s in shapes), str(dtype))
     fn = _MULTI_AR_CACHE.get(key)
     if fn is not None:
         return fn
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
-    sizes = [int(_np.prod(s, dtype=_np.int64)) // n for s in shapes]
+    # pad-and-slice: a leading dim that does not divide the axis size is
+    # zero-padded up to the next multiple INSIDE the fused program (the
+    # shapes are static, so XLA folds the pad into the gather) and the
+    # result unpacks to ceil(m/n) rows — the final row just sums fewer
+    # real contributions. Keeps odd-sized buckets out of the error path;
+    # tracelint TPU008 warns where the padding provably happens.
+    padded = [(_padded_leading(s[0], n),) + tuple(s[1:]) for s in shapes]
+    sizes = [int(_np.prod(p, dtype=_np.int64)) // n for p in padded]
     splits = list(_np.cumsum(sizes)[:-1])
 
     def run(*raws):
         # each (n*k_i, ...) array contributes its per-shard flat row; the
         # concatenated (n, K) matrix reduces in ONE psum over the axis
-        flats = [r.reshape(n, -1) for r in raws]
+        flats = []
+        for r, s, p in zip(raws, shapes, padded):
+            if p[0] != s[0]:
+                fill = jnp.zeros((p[0] - s[0],) + tuple(s[1:]), r.dtype)
+                r = jnp.concatenate([r, fill], axis=0)
+            flats.append(r.reshape(n, -1))
         flat = jnp.concatenate(flats, axis=1) if len(flats) > 1 else flats[0]
         red = shard_map(lambda t: lax.psum(t, axis), mesh=mesh,
                         in_specs=P(axis), out_specs=P())(flat)
         row = red.reshape(-1)
         parts = jnp.split(row, splits) if splits else [row]
         return tuple(
-            p.reshape((s[0] // n,) + tuple(s[1:]))
-            for p, s in zip(parts, shapes))
+            q.reshape((p[0] // n,) + tuple(s[1:]))
+            for q, p, s in zip(parts, padded, shapes))
 
     fn = jax.jit(run)
     _MULTI_AR_CACHE[key] = fn
@@ -147,9 +180,11 @@ def all_reduce_multi(arrays, mesh=None, axis=None, bucket_mb=None):
     shards over `axis` (the `_eager_allreduce` contract) but batched —
     arrays pack into size-capped buckets (`mx.engine`) and each bucket is
     ONE jitted flatten->psum->unflatten program, launched as soon as it
-    fills so bucket N's collective overlaps bucket N+1's pack. Each
-    array's leading dim must divide by the axis size. Returns the reduced
-    arrays in input order."""
+    fills so bucket N's collective overlaps bucket N+1's pack. A leading
+    dim that does not divide the axis size is zero-padded up to the next
+    multiple inside the fused program (pad-and-slice) — the result then
+    has ceil(m/n) leading rows, the last summing fewer real
+    contributions. Returns the reduced arrays in input order."""
     from .. import engine as _engine
     from .. import telemetry as _telem
     from ..resilience import faults as _faults
@@ -159,12 +194,6 @@ def all_reduce_multi(arrays, mesh=None, axis=None, bucket_mb=None):
         mesh = current_mesh() or local_mesh()
     axis = axis or mesh.axis_names[0]
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
-    for a in arrays:
-        if a.shape[0] % n:
-            raise ValueError(
-                "all_reduce_multi: leading dim %d of shape %s does not "
-                "divide the %r axis size %d"
-                % (a.shape[0], tuple(a.shape), axis, n))
     cap = _engine.bucket_bytes(bucket_mb)
     if not cap or len(arrays) < 2:
         return [_eager_allreduce(a, mesh, axis) for a in arrays]
@@ -189,8 +218,9 @@ def all_reduce_multi(arrays, mesh=None, axis=None, bucket_mb=None):
             out[idx] = part
     for i, a in enumerate(arrays):
         if out[i] is None:  # zero-size arrays skip the bucketer; their
-            # reduction is an empty array of the shard shape
-            out[i] = jnp.zeros((a.shape[0] // n,) + tuple(a.shape[1:]),
+            # reduction is an empty array of the shard shape —
+            # ceil(m/n) rows, matching the padded per-tensor contract
+            out[i] = jnp.zeros((-(-a.shape[0] // n),) + tuple(a.shape[1:]),
                                a.dtype)
     return out
 

@@ -495,7 +495,7 @@ def test_rule_registry_complete():
     table = analysis.rule_table()
     got = [row[0] for row in table]
     assert got == ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
-                   "TPU006"]
+                   "TPU006", "TPU007", "TPU008"]
     assert all(row[4] for row in table)  # every rule documented
 
 
@@ -594,6 +594,9 @@ def test_parse_log_lint_mode(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "| severity | code | location | symbol | message |" in r.stdout
     assert "TPU001" in r.stdout
+    # per-rule rollup table rides along
+    assert "| rule | severity | count |" in r.stdout
+    assert "| TPU001 | error | 1 |" in r.stdout
 
 
 # ===========================================================================
@@ -722,6 +725,643 @@ def test_retrace_reason_formatting():
 
 
 # ===========================================================================
+# TPU007 — sharding annotations
+# ===========================================================================
+def test_tpu007_flags_undeclared_axis_in_partition_spec():
+    f = lint("""
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(None, ("data", "model"))
+    SPEC = P("dat", None)
+    GOOD = P("model", "data")
+    """)
+    hits = only(f, "TPU007")
+    assert len(hits) == 1 and hits[0].severity == Severity.ERROR
+    assert "'dat'" in hits[0].message and "data, model" in hits[0].message
+
+
+def test_tpu007_flags_dead_partition_rule_and_duplicate():
+    f = lint("""
+    from jax.sharding import PartitionSpec as P
+    RULES = ShardingRules([
+        (r"attn", P("model")),
+        (r"attn/wo", P(None, "model")),
+        (r"mlp/(w1|w3)", P("fsdp")),
+        (r"attn", P()),
+    ])
+    """)
+    hits = only(f, "TPU007")
+    assert len(hits) == 2
+    assert all("dead partition rule" in h.message for h in hits)
+    assert all(h.severity == Severity.WARNING for h in hits)
+
+
+def test_tpu007_flags_in_shardings_arity_mismatch():
+    f = lint("""
+    import jax
+    def step(params, batch):
+        return params
+    f = jax.jit(step, in_shardings=(1, 2, 3))
+    """)
+    hits = only(f, "TPU007")
+    assert len(hits) == 1
+    assert "3 entries" in hits[0].message and "2 traced" in hits[0].message
+
+
+def test_tpu007_flags_invalid_partition_rule_regex():
+    f = lint("""
+    RULES = ShardingRules([(r"attn/(wq", 1)])
+    """)
+    hits = only(f, "TPU007")
+    assert len(hits) == 1 and "invalid regex" in hits[0].message
+
+
+def test_tpu007_passes_declared_axes_and_specific_first_rules():
+    f = lint("""
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(None, ("data", "model", "fsdp"))
+    RULES = ShardingRules([
+        (r"attn/wo", P("model")),
+        (r"attn", P("fsdp")),
+        (r"norm|bias", P()),
+    ])
+    SPEC = P(("model",), ("fsdp",))
+    """)
+    assert not only(f, "TPU007")
+
+
+def test_tpu007_passes_without_any_mesh_declaration():
+    # no declaration anywhere -> the axis universe is unknown, stay silent
+    f = lint("""
+    from jax.sharding import PartitionSpec as P
+    SPEC = P("whatever")
+    """)
+    assert not only(f, "TPU007")
+
+
+def test_tpu007_passes_matching_arity_and_static_argnums():
+    f = lint("""
+    import jax
+    def step(params, batch):
+        return params
+    def stepn(n, params, batch):
+        return params
+    a = jax.jit(step, in_shardings=(1, 2))
+    b = jax.jit(stepn, static_argnums=(0,), in_shardings=(1, 2))
+    """)
+    assert not only(f, "TPU007")
+
+
+def test_tpu007_static_argnames_of_kwonly_param_keeps_arity():
+    # static_argnames naming a KEYWORD-ONLY param never occupied an
+    # in_shardings slot — the 2-entry spec is correct, not a mismatch
+    f = lint("""
+    import jax
+    def step(x, y, *, training):
+        return x
+    a = jax.jit(step, static_argnames=("training",), in_shardings=(1, 2))
+    """)
+    assert not only(f, "TPU007")
+
+
+def test_tpu007_anchored_earlier_pattern_keeps_rule_alive():
+    # "embedding$" does NOT shadow "embedding": "embedding_table" only
+    # matches the later rule — anchored patterns never prove deadness
+    f = lint("""
+    from jax.sharding import PartitionSpec as P
+    RULES = ShardingRules([
+        (r"embedding$", P("model")),
+        (r"embedding", P("fsdp")),
+    ])
+    """)
+    assert not only(f, "TPU007")
+
+
+def test_tpu007_nonliteral_branches_keep_rules_alive():
+    # "attn/(wq|wk)" after "q_proj" is NOT provably dead (regex branch)
+    f = lint("""
+    from jax.sharding import PartitionSpec as P
+    RULES = ShardingRules([
+        (r"q_proj", P("model")),
+        (r"attn/(wq|wk)", P("model")),
+    ])
+    """)
+    assert not only(f, "TPU007")
+
+
+def test_tpu007_out_shardings_ignores_nested_function_returns():
+    # the closure's 2-tuple return is NOT step's return arity
+    f = lint("""
+    import jax
+    def step(x):
+        def parts():
+            return x, x
+        return parts
+    a = jax.jit(step, out_shardings=(1,))
+    """)
+    assert not only(f, "TPU007")
+
+
+def test_tpu007_meshconfig_nonaxis_kwargs_still_declare_defaults():
+    f = lint("""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    cfg = MeshConfig(devices=jax.devices())
+    GOOD = P("data")
+    BAD = P("dat")
+    """)
+    hits = only(f, "TPU007")
+    assert len(hits) == 1 and "'dat'" in hits[0].message
+
+
+def test_tpu007_cross_file_jit_arity_via_summary(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "steps.py").write_text(
+        "def step(params, batch):\n    return params\n")
+    (pkg / "main.py").write_text(
+        "import jax\nfrom pkg import steps\n"
+        "good = jax.jit(steps.step, in_shardings=(1, 2))\n"
+        "bad = jax.jit(steps.step, in_shardings=(1, 2, 3))\n")
+    hits = [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU007"]
+    assert len(hits) == 1, [h.format() for h in hits]
+    assert "pkg.steps.step" in hits[0].message and \
+        "3 entries" in hits[0].message
+
+
+def test_tpu007_self_rules_tables_are_alive():
+    # LLAMA_RULES/BERT_RULES in parallel/sharding.py must never regress
+    # into shadowed entries
+    path = os.path.join(REPO, "mxnet_tpu", "parallel", "sharding.py")
+    f = [x for x in analysis.lint_file(path, rules=["TPU007"])]
+    assert f == [], [x.format() for x in f]
+
+
+# ===========================================================================
+# TPU008 — collective safety
+# ===========================================================================
+def test_tpu008_flags_collective_under_data_dependent_if():
+    f = lint("""
+    import jax
+    from jax import lax
+    @jax.jit
+    def step(x):
+        if x.sum() > 0:
+            x = lax.psum(x, "data")
+        return x
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 1 and hits[0].severity == Severity.ERROR
+    assert "deadlock" in hits[0].message
+    assert "step" in hits[0].symbol
+
+
+def test_tpu008_flags_collective_in_cond_branch_with_traced_pred():
+    f = lint("""
+    import jax
+    from jax import lax
+    @jax.jit
+    def step(x):
+        return lax.cond(x.sum() > 0,
+                        lambda v: lax.psum(v, "data"),
+                        lambda v: v, x)
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 1
+    assert "lax.cond" in hits[0].message
+
+
+def test_tpu008_flags_unbound_axis_name():
+    f = lint("""
+    import jax
+    from jax import lax
+    from jax.sharding import Mesh
+    mesh = Mesh(None, ("data", "model"))
+    @jax.jit
+    def step(x):
+        return lax.all_gather(x, "batch")
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 1
+    assert "'batch'" in hits[0].message and "data, model" in hits[0].message
+
+
+def test_tpu008_flags_undivisible_static_leading_dim():
+    f = lint("""
+    import jax.numpy as jnp
+    def sync():
+        mesh = local_mesh(4)
+        g0 = jnp.ones((6, 2))
+        g1 = jnp.ones((8, 2))
+        return all_reduce_multi([g0, g1], mesh=mesh)
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 1 and hits[0].severity == Severity.WARNING
+    assert "'g0'" in hits[0].message and "zero-pads" in hits[0].message
+
+
+def test_tpu008_flags_axis_index_divergent_collective():
+    """`lax.axis_index()` is per-rank by construction — branching on it
+    and meeting in a collective is the canonical mesh deadlock."""
+    f = lint("""
+    import jax
+    from jax import lax
+    @jax.jit
+    def step(x):
+        if lax.axis_index("data") == 0:
+            x = lax.psum(x, "data")
+        return x
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 1
+    assert "deadlock" in hits[0].message
+    # the branch itself is also untraceable — TPU003 fires alongside
+    assert len(only(f, "TPU003")) == 1
+
+
+def test_tpu008_passes_unconditional_and_none_guarded_collectives():
+    f = lint("""
+    import jax
+    from jax import lax
+    @jax.jit
+    def step(x, bias=None):
+        y = lax.psum(x, "data")
+        if bias is not None:
+            y = y + lax.psum(bias, "data")
+        return y
+    """)
+    assert not only(f, "TPU008")
+
+
+def test_tpu008_passes_bound_axis_and_divisible_dims():
+    f = lint("""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh
+    mesh2 = Mesh(None, ("data",))
+    @jax.jit
+    def step(x):
+        return lax.psum(x, "data")
+    def sync():
+        mesh = local_mesh(4)
+        g = jnp.ones((8, 2))
+        return all_reduce_multi([g], mesh=mesh)
+    """)
+    assert not only(f, "TPU008")
+
+
+def test_tpu008_passes_cond_with_collective_free_branches():
+    f = lint("""
+    import jax
+    from jax import lax
+    @jax.jit
+    def step(x):
+        return lax.cond(x.sum() > 0, lambda v: v * 2, lambda v: v, x)
+    """)
+    assert not only(f, "TPU008")
+
+
+def test_tpu008_axis_index_is_not_a_rendezvous():
+    # axis_index reads the local coordinate — no cross-rank rendezvous,
+    # legal inside divergent branches (only its axis_name is checked)
+    f = lint("""
+    import jax
+    from jax import lax
+    from jax.sharding import Mesh
+    mesh = Mesh(None, ("data",))
+    @jax.jit
+    def step(x):
+        return lax.cond(x.sum() > 0,
+                        lambda v: v * lax.axis_index("data"),
+                        lambda v: v, x)
+    """)
+    assert not only(f, "TPU008")
+
+
+def test_tpu008_function_defined_in_branch_is_not_executed():
+    # a lambda/def CREATED inside a divergent branch executes nothing
+    # there — only calls in the branch body itself diverge
+    f = lint("""
+    import jax
+    from jax import lax
+    @jax.jit
+    def step(x):
+        cb = lambda g: g
+        if x.sum() > 0:
+            cb = lambda g: lax.psum(g, "data")
+        return cb(x)
+    """)
+    assert not only(f, "TPU008")
+
+
+def test_tpu008_nested_tainted_ifs_report_once():
+    f = lint("""
+    import jax
+    from jax import lax
+    @jax.jit
+    def step(x):
+        if x.sum() > 0:
+            if x.min() < 0:
+                x = lax.psum(x, "data")
+        return x
+    """)
+    assert len(only(f, "TPU008")) == 1
+
+
+def test_tpu008_divisibility_is_function_scoped():
+    # `g` in other() must not alias sync()'s parameter of unknown shape
+    f = lint("""
+    import jax.numpy as jnp
+    def other():
+        g = jnp.ones((6, 2))
+        return g
+    def sync(g):
+        mesh = local_mesh(4)
+        return all_reduce_multi([g], mesh=mesh)
+    """)
+    assert not only(f, "TPU008")
+
+
+def test_tpu007_axes_from_fully_dotted_mesh_ctor():
+    # jax.sharding.Mesh(...) at full attribute depth still declares axes
+    f = lint("""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    mesh = jax.sharding.Mesh(None, ("data", "model"))
+    BAD = P("dat")
+    GOOD = P("model")
+    """)
+    hits = only(f, "TPU007")
+    assert len(hits) == 1 and "'dat'" in hits[0].message
+
+
+# ===========================================================================
+# cross-file taint (one level over project imports)
+# ===========================================================================
+_XF_HELPER_BAD = """
+import numpy as np
+
+def probe(x):
+    return float(x.sum().item())
+
+def clean(x):
+    return x * 2
+
+def noisy():
+    return np.random.rand()
+"""
+_XF_HELPER_FIXED = """
+def probe(x):
+    return x.sum()
+
+def clean(x):
+    return x * 2
+
+def noisy():
+    return 4  # chosen by fair dice roll ahead of time, on the host
+"""
+_XF_MODEL = """
+import jax
+from pkg.helpers import probe, clean, noisy
+from . import helpers
+
+@jax.jit
+def step(x):
+    a = probe(x)
+    b = clean(x)
+    c = helpers.probe(2)
+    d = noisy()
+    return b * a * c * d
+"""
+
+
+def _write_pkg(tmp_path, helper_src):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helpers.py").write_text(helper_src)
+    (pkg / "model.py").write_text(_XF_MODEL)
+    return pkg
+
+
+def test_cross_file_taint_flags_helper_sync_at_traced_call_site(tmp_path):
+    pkg = _write_pkg(tmp_path, _XF_HELPER_BAD)
+    findings = analysis.lint_paths([str(pkg)])
+    sync = [f for f in findings if f.code == "TPU001"]
+    assert len(sync) == 1, [f.format() for f in findings]
+    # flagged at the CALLER (model.py), pointing at the helper's line
+    assert sync[0].file.endswith("model.py")
+    assert "pkg.helpers.probe" in sync[0].message
+    assert "helpers.py:" in sync[0].message
+    assert "step" in sync[0].symbol
+
+
+def test_cross_file_taint_flags_helper_rng(tmp_path):
+    pkg = _write_pkg(tmp_path, _XF_HELPER_BAD)
+    findings = analysis.lint_paths([str(pkg)])
+    rng = [f for f in findings if f.code == "TPU005"]
+    assert len(rng) == 1
+    assert rng[0].file.endswith("model.py")
+    assert "pkg.helpers.noisy" in rng[0].message
+
+
+def test_cross_file_taint_passes_when_helper_cleaned(tmp_path):
+    # acceptance: the SAME caller passes once the helper is device-pure
+    pkg = _write_pkg(tmp_path, _XF_HELPER_FIXED)
+    findings = analysis.lint_paths([str(pkg)])
+    assert codes(findings) == [], [f.format() for f in findings]
+
+
+def test_cross_file_taint_ignores_untainted_args(tmp_path):
+    # helpers.probe(2) in the fixture carries no tracer: only the
+    # tainted call is flagged (one TPU001, not two)
+    pkg = _write_pkg(tmp_path, _XF_HELPER_BAD)
+    findings = [f for f in analysis.lint_paths([str(pkg)])
+                if f.code == "TPU001"]
+    assert len(findings) == 1
+
+
+def test_cross_file_taint_relative_import_from_package_init(tmp_path):
+    """`from . import helpers` / `from .helpers import probe` inside a
+    package __init__.py anchor at the package ITSELF, not its parent."""
+    pkg = tmp_path / "pkg"
+    sub = pkg / "sub"
+    sub.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (sub / "helpers.py").write_text(_XF_HELPER_BAD)
+    (sub / "__init__.py").write_text(
+        "import jax\n"
+        "from .helpers import probe\n"
+        "from . import helpers\n\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return probe(x) * helpers.noisy()\n")
+    findings = analysis.lint_paths([str(pkg)])
+    assert "TPU001" in codes(findings) and "TPU005" in codes(findings)
+    assert all(f.file.endswith("__init__.py") for f in findings
+               if f.code in ("TPU001", "TPU005"))
+
+
+def test_cross_file_taint_disabled_without_project(tmp_path):
+    pkg = _write_pkg(tmp_path, _XF_HELPER_BAD)
+    findings = analysis.lint_paths([str(pkg)], project=None)
+    assert [f for f in findings if f.code in ("TPU001", "TPU005")] == []
+
+
+def test_cross_file_cache_invalidates_when_helper_changes(tmp_path):
+    """The findings cache keys on the project digest: fixing the HELPER
+    must invalidate the CALLER's cached findings."""
+    from mxnet_tpu.analysis.cli import FileCache
+    pkg = _write_pkg(tmp_path, _XF_HELPER_BAD)
+    cache = FileCache(str(tmp_path / "cache.json"))
+    first = analysis.lint_paths([str(pkg)], cache=cache)
+    assert any(f.code == "TPU001" for f in first)
+    os.utime(str(pkg / "helpers.py"), (1, 1))
+    (pkg / "helpers.py").write_text(_XF_HELPER_FIXED)
+    second = analysis.lint_paths([str(pkg)], cache=cache)
+    assert [f for f in second if f.code in ("TPU001", "TPU005")] == []
+
+
+def test_summary_cache_round_trip(tmp_path):
+    from mxnet_tpu.analysis.project import SummaryCache
+    from mxnet_tpu.analysis.engine import build_project
+    pkg = _write_pkg(tmp_path, _XF_HELPER_BAD)
+    cache_path = str(tmp_path / "summaries.json")
+    proj = build_project([str(pkg)], summary_cache=cache_path)
+    s = proj.summary("pkg.helpers")
+    assert s is not None and "probe" in s.functions
+    assert any(h[0] == "sync" for h in s.functions["probe"].hazards)
+    proj.save_cache()
+    assert os.path.exists(cache_path)
+    # a fresh context reads the summary back from disk
+    from mxnet_tpu.analysis.rules import LINT_VERSION
+    sc = SummaryCache(cache_path, LINT_VERSION)
+    cached = sc.get(str(pkg / "helpers.py"))
+    assert cached is not None and "noisy" in cached.functions
+    assert any(h[0] == "rng" for h in cached.functions["noisy"].hazards)
+
+
+# ===========================================================================
+# baseline gate (CI findings gate)
+# ===========================================================================
+_BASE_BAD_TWO = """
+class Net:
+    def hybrid_forward(self, F, x):
+        a = x.asnumpy()
+        return x
+"""
+_BASE_BAD_THREE = """
+class Net:
+    def hybrid_forward(self, F, x):
+        a = x.asnumpy()
+        b = x.item()
+        return x
+"""
+
+
+def test_baseline_gate_semantics(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    baseline = tmp_path / "baseline.json"
+    target.write_text(_BASE_BAD_TWO)
+
+    # no baseline file: everything is new -> gate fails
+    assert cli_main([str(target), "--baseline", str(baseline),
+                     "--fail-on=error"]) == 1
+    capsys.readouterr()
+
+    # record the baseline: the same finding now passes
+    assert cli_main([str(target), "--baseline", str(baseline),
+                     "--update-baseline"]) == 0
+    capsys.readouterr()
+    data = json.loads(baseline.read_text())
+    assert data["entries"] and all(
+        v == 1 for v in data["entries"].values())
+    assert cli_main([str(target), "--baseline", str(baseline),
+                     "--fail-on=error"]) == 0
+    capsys.readouterr()
+
+    # a NEW finding fails even though the old one is baselined
+    os.utime(str(target), (1, 1))
+    target.write_text(_BASE_BAD_THREE)
+    rc = cli_main([str(target), "--baseline", str(baseline),
+                   "--format", "json", "--fail-on=error"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["baseline"]["suppressed"] == 1
+    assert out["baseline"]["new"] == 1
+    assert out["findings"][0]["message"].startswith(".item()")
+
+    # fixing everything leaves a stale entry; --update-baseline prunes it
+    os.utime(str(target), (2, 2))
+    target.write_text(_CLEAN_SRC)
+    rc = cli_main([str(target), "--baseline", str(baseline),
+                   "--format", "json", "--fail-on=error"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["baseline"]["stale"] == 1
+    assert cli_main([str(target), "--baseline", str(baseline),
+                     "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert json.loads(baseline.read_text())["entries"] == {}
+
+
+def test_baseline_fingerprints_survive_line_shifts(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    baseline = tmp_path / "baseline.json"
+    target.write_text(_BASE_BAD_TWO)
+    assert cli_main([str(target), "--baseline", str(baseline),
+                     "--update-baseline"]) == 0
+    capsys.readouterr()
+    # unrelated code above moves the finding down two lines — still
+    # baselined (fingerprints carry no line numbers)
+    os.utime(str(target), (1, 1))
+    target.write_text("import os\nimport sys\n" + _BASE_BAD_TWO)
+    assert cli_main([str(target), "--baseline", str(baseline),
+                     "--fail-on=error"]) == 0
+    capsys.readouterr()
+
+
+def test_baseline_matches_from_any_cwd(tmp_path, capsys, monkeypatch):
+    """The gate must keep matching when invoked from OUTSIDE the tree
+    with absolute targets: absolute finding paths fall back to
+    path-suffix fingerprint matching against the repo-relative
+    baseline."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "mod.py").write_text(_BASE_BAD_TWO)
+    baseline = tree / "baseline.json"
+    monkeypatch.chdir(str(tree))
+    assert cli_main(["mod.py", "--baseline", str(baseline),
+                     "--update-baseline"]) == 0
+    capsys.readouterr()
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(str(elsewhere))
+    assert cli_main([str(tree / "mod.py"), "--baseline", str(baseline),
+                     "--fail-on=error"]) == 0
+    capsys.readouterr()
+
+
+def test_sarif_output(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    target.write_text(_BASE_BAD_TWO)
+    rc = cli_main([str(target), "--format", "sarif", "--fail-on=never"])
+    assert rc == 0
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tracelint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"TPU001", "TPU007", "TPU008"} <= rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "TPU001" and res["level"] == "error"
+    assert res["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 4
+    assert "tracelint/v1" in res["partialFingerprints"]
+
+
+# ===========================================================================
 # meta: the tree lints itself clean (tier-1 self-check, `lint` marker)
 # ===========================================================================
 @pytest.mark.lint
@@ -740,3 +1380,50 @@ def test_run_tracelint_script():
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
     assert r.returncode == 0, r.stdout + r.stderr
     assert "0 error(s)" in r.stdout
+
+
+@pytest.mark.lint
+def test_run_tracelint_ci_gate_passes_committed_baseline(tmp_path):
+    """Acceptance: --ci exits 0 against the committed baseline, and
+    non-zero when a new finding is introduced (an extra target file
+    stands in for an edit to the tree)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_TRACELINT_CACHE=str(tmp_path / "cache.json"))
+    r = subprocess.run(
+        ["sh", os.path.join(REPO, "tools", "run_tracelint.sh"), "--ci"],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in r.stdout
+
+    bad = tmp_path / "newly_introduced.py"
+    bad.write_text(_BASE_BAD_TWO)
+    r = subprocess.run(
+        ["sh", os.path.join(REPO, "tools", "run_tracelint.sh"), "--ci",
+         str(bad)],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "1 new" in r.stdout
+
+
+@pytest.mark.lint
+def test_committed_baseline_matches_tree():
+    """The checked-in baseline must stay in sync: no finding outside it
+    (a new hazard must be fixed or reviewed into the baseline) and no
+    stale entries (fixed findings must be pruned)."""
+    from mxnet_tpu.analysis.cli import apply_baseline, load_baseline
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        findings = analysis.lint_paths(
+            [os.path.join(REPO, "mxnet_tpu"),
+             os.path.join(REPO, "tools", "mxtop.py")])
+        baseline = load_baseline(
+            os.path.join(REPO, "tools", "tracelint_baseline.json"))
+        assert baseline, "committed baseline missing or empty"
+        new, _baselined, stale = apply_baseline(findings, baseline)
+    finally:
+        os.chdir(cwd)
+    assert new == [], "unbaselined findings:\n" + "\n".join(
+        f.format() for f in new)
+    assert stale == [], "stale baseline entries (prune them):\n" + \
+        "\n".join(stale)

@@ -459,15 +459,56 @@ def test_eager_all_reduce_multi_zero_size_array():
     np.testing.assert_allclose(np.asarray(out[1]), np.full((2, 3), float(n)))
 
 
-def test_eager_all_reduce_multi_rejects_undivisible_dim():
+def test_eager_all_reduce_multi_pads_undivisible_dim():
+    """Pad-and-slice: a leading dim that does not divide the axis size is
+    zero-padded to the next multiple inside the fused program; the result
+    has ceil(m/n) rows (the last sums fewer real contributions) instead
+    of raising."""
     from mxnet_tpu.parallel import collectives
     from mxnet_tpu.parallel.mesh import local_mesh
     mesh = local_mesh()
-    if mesh.devices.size == 1:
+    n = mesh.devices.size
+    if n == 1:
         pytest.skip("needs a >1-device mesh")
-    with pytest.raises(ValueError, match="does not divide"):
-        collectives.all_reduce_multi(
-            [jnp.ones((mesh.devices.size + 1, 2))], mesh=mesh)
+    m = n + 1
+    x = jnp.asarray(np.arange(m * 3, dtype=np.float32).reshape(m, 3))
+    (out,) = collectives.all_reduce_multi([x], mesh=mesh)
+    k = -(-m // n)
+    assert tuple(out.shape) == (k, 3)
+    padded = np.zeros((k * n, 3), np.float32)
+    padded[:m] = np.asarray(x)
+    np.testing.assert_allclose(
+        np.asarray(out), padded.reshape(n, -1).sum(0).reshape(k, 3))
+
+
+def test_eager_all_reduce_multi_mixed_odd_even_parity():
+    """Odd- and even-leading-dim arrays in one call agree between the
+    bucketed fused path and the per-tensor escape hatch (which routes odd
+    arrays through the same padded program)."""
+    from mxnet_tpu.parallel import collectives
+    from mxnet_tpu.parallel.mesh import local_mesh
+    mesh = local_mesh()
+    n = mesh.devices.size
+    if n == 1:
+        pytest.skip("needs a >1-device mesh")
+    rng = np.random.RandomState(3)
+    arrs = [jnp.asarray(rng.randn(n + 1, 2).astype(np.float32)),
+            jnp.asarray(rng.randn(2 * n, 3).astype(np.float32)),
+            jnp.asarray(rng.randn(2 * n + 1).astype(np.float32))]
+    fused = collectives.all_reduce_multi(arrs, mesh=mesh)
+    with engine.bucket_mb_scope(0):
+        per_tensor = collectives.all_reduce_multi(arrs, mesh=mesh)
+    for f, p in zip(fused, per_tensor):
+        np.testing.assert_array_equal(np.asarray(f), np.asarray(p))
+    for a, r in zip(arrs, fused):
+        m = a.shape[0]
+        k = -(-m // n)
+        rest = tuple(a.shape[1:])
+        padded = np.zeros((k * n,) + rest, np.float32)
+        padded[:m] = np.asarray(a)
+        np.testing.assert_allclose(
+            np.asarray(r), padded.reshape(n, -1).sum(0).reshape(r.shape),
+            rtol=1e-6)
 
 
 def test_psum_bucketed_inside_shard_map():

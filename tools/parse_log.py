@@ -307,6 +307,18 @@ def _print_lint(rows, fmt):
         if fmt == "csv":
             msg = msg.replace(",", ";")
         print(line % (sev, code, loc, sym, msg))
+    if fmt != "markdown":
+        return  # csv consumers want ONE table; the rollup is human-facing
+    # per-rule rollup: which rule dominates the findings?
+    by_rule = {}
+    for sev, code, _loc, _sym, _msg in rows:
+        key = (code, sev)
+        by_rule[key] = by_rule.get(key, 0) + 1
+    print()
+    print("| rule | severity | count |")
+    print("| --- | --- | --- |")
+    for code, sev in sorted(by_rule):
+        print("| %s | %s | %d |" % (code, sev, by_rule[(code, sev)]))
 
 
 def _load_json(path):

@@ -4,12 +4,36 @@
 # tests/test_analysis.py under the `lint` pytest marker).
 #
 # The per-file mtime cache keeps repeat runs well under the 10 s budget —
-# only files that changed since the last run are re-parsed.
+# only files that changed since the last run are re-parsed (the
+# whole-program project digest folds every file's mtime in, so editing a
+# helper re-lints its callers too).
 #
 # Usage: tools/run_tracelint.sh [extra tracelint args...]
-#        (e.g. tools/run_tracelint.sh --format json)
+#        tools/run_tracelint.sh --ci
+#
+# --ci is the findings gate: any NEW warning-or-worse finding fails; the
+# findings fingerprinted in tools/tracelint_baseline.json pass. Refresh
+# the baseline after a reviewed change with:
+#   python -m mxnet_tpu.analysis mxnet_tpu tools/mxtop.py \
+#       --baseline tools/tracelint_baseline.json --update-baseline
 set -e
 cd "$(dirname "$0")/.."
+# rewrite a --ci token into the baseline-gate argument set (plain-flag
+# word splitting is fine here: tracelint args carry no spaces)
+ci=0
+rest=""
+for a in "$@"; do
+    if [ "$a" = "--ci" ]; then
+        ci=1
+    else
+        rest="$rest $a"
+    fi
+done
+# shellcheck disable=SC2086
+set -- $rest
+if [ "$ci" = 1 ]; then
+    set -- --baseline tools/tracelint_baseline.json --fail-on warning "$@"
+fi
 # --cache uses the CLI's uid-scoped default path under $TMPDIR;
 # MXNET_TPU_TRACELINT_CACHE overrides it explicitly
 if [ -n "${MXNET_TPU_TRACELINT_CACHE:-}" ]; then
