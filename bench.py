@@ -551,6 +551,102 @@ def bench_resilience(on_accel):
     }
 
 
+def bench_serve(on_accel):
+    """BENCH=serve: continuous-batching inference bench for mx.serve. A
+    llama LM serves a burst of staggered-length requests through the
+    paged-KV scheduler; the same traffic is then replayed with max_batch=1
+    (sequential decode) for the vs_baseline ratio — the speedup continuous
+    batching buys on this backend. The row carries the serving SLO
+    numbers: tokens_s, ttft_ms_p50/p99 (queue wait + prefill),
+    tpot_ms_p50/p99 (per-output-token decode cadence), queue_depth (peak),
+    shed_requests (structured Overloaded rejections — two deliberately
+    oversized requests prove load-shedding sheds instead of OOMing), and
+    kv_blocks_peak (paged-pool pressure).
+
+    Reading the row: on an accelerator, batching amortizes dispatch and
+    weight reads across the batch, so vs_baseline > 1 is the win; the cpu
+    smoke row runs a compute-bound tiny model where a B=8 decode program
+    does 8x the math per launch, so its vs_baseline < 1 — there the row
+    is about ttft/tpot/shed behavior, not the time ratio."""
+    import dataclasses
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models.llama import CONFIGS, llama_init
+
+    if on_accel:
+        cfg = CONFIGS["llama_110m"]
+        n_req, base_new, blocks, bs, batch = 32, 32, 512, 16, 8
+    else:
+        cfg = dataclasses.replace(CONFIGS["llama_tiny"],
+                                  dtype=jnp.float32, max_seq_len=64)
+        n_req, base_new, blocks, bs, batch = 12, 8, 64, 8, 8
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    traffic = [(rng.randint(1, cfg.vocab_size - 1,
+                            size=rng.randint(4, 16)).tolist(),
+                base_new + (i % 5)) for i in range(n_req)]
+
+    def quant(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def run(max_batch):
+        telemetry.reset()
+        server = mx.serve.InferenceServer(
+            params, cfg, max_batch=max_batch, kv_blocks=blocks,
+            block_size=bs, queue_cap=n_req + 4)
+        server.warmup()
+        handles = []
+        t0 = time.perf_counter()
+        for prompt, max_new in traffic:
+            handles.append(server.submit(
+                mx.serve.Request(prompt, max_new_tokens=max_new)))
+        # two requests that can NEVER fit: admission must shed them with a
+        # structured Overloaded, not OOM the pool mid-decode
+        shed = 0
+        for _ in range(2):
+            try:
+                server.submit(mx.serve.Request(
+                    [1] * 8, max_new_tokens=cfg.max_seq_len * 4))
+            except mx.serve.Overloaded:
+                shed += 1
+        server.run()
+        dt = time.perf_counter() - t0
+        toks = sum(len(h.result()) for h in handles)
+        return toks / dt, handles, shed
+
+    tok_s, handles, shed = run(batch)
+    snap = telemetry.snapshot()
+    gauges = snap["gauges"]
+    counters = snap["counters"]
+    ttft = [h.ttft_ms for h in handles if h.ttft_ms is not None]
+    tpot = [ms for h in handles for ms in h.tpot_ms]
+    tok_s_seq, _, _ = run(1)
+    return {
+        "metric": ("serve_tokens_per_sec" if on_accel
+                   else "serve_cpu_tokens_per_sec"),
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / tok_s_seq, 4),  # vs sequential decode
+        "tokens_s": round(tok_s, 2),
+        "ttft_ms_p50": round(quant(ttft, 0.50), 3),
+        "ttft_ms_p99": round(quant(ttft, 0.99), 3),
+        "tpot_ms_p50": round(quant(tpot, 0.50), 3),
+        "tpot_ms_p99": round(quant(tpot, 0.99), 3),
+        "queue_depth": gauges.get("serve.queue_depth", {}).get("max", 0),
+        "shed_requests": counters.get("serve.shed", shed),
+        "kv_blocks_peak": gauges.get("serve.kv.blocks_in_use",
+                                     {}).get("max", 0),
+        "requests": n_req,
+        "recoveries": counters.get("serve.recoveries", 0),
+    }
+
+
 def bench_obs(on_accel):
     """BENCH=obs: observability-plane microbench. A small Gluon MLP trains
     under the live /metrics endpoint while the bench scrapes it, measuring
@@ -723,6 +819,9 @@ def main():
         return
     if which == "obs":
         _emit(bench_obs(on_accel))
+        return
+    if which == "serve":
+        _emit(bench_serve(on_accel))
         return
     if which in ("bert", "bert_gluon"):
         tok_s, _ = (bench_bert if which == "bert"

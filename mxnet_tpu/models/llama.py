@@ -29,11 +29,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..parallel.flash_attention import flash_attention
+from ..parallel.flash_attention import flash_attention, paged_attention
 from ..parallel.ring_attention import ring_attention
 
 __all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss",
-           "init_kv_cache", "llama_decode_step", "CONFIGS"]
+           "init_kv_cache", "llama_decode_step", "init_kv_pools",
+           "llama_prefill_paged", "llama_decode_paged", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,6 +237,126 @@ def init_kv_cache(cfg: LlamaConfig, batch, max_len=None, dtype=None):
     return {str(i): {"k": jnp.zeros(shape, dtype),
                      "v": jnp.zeros(shape, dtype)}
             for i in range(cfg.n_layers)}
+
+
+# ------------------------------------------------------- paged decoding
+# The serving runtime (mxnet_tpu.serve) stores KV in fixed-size blocks
+# inside ONE physical pool per layer instead of a (batch, max_seq_len)
+# rectangle per stream: a stream costs exactly the blocks its context
+# fills, and blocks recycle through a free-list as streams finish
+# (serve.kv_cache.KVBlockPool owns the bookkeeping; these functions are the
+# jitted compute). Positions map to pool slots through per-stream block
+# tables; table entries >= num_blocks are unallocated — their writes DROP
+# (lax scatter mode) and their reads are discarded by the length mask, so
+# one fixed-shape program serves every context length in the bucket.
+
+def init_kv_pools(cfg: LlamaConfig, num_blocks, block_size, dtype=None):
+    """The physical paged KV pool: per layer, (num_blocks, n_kv_heads,
+    block_size, head_dim) for k (post-RoPE) and v."""
+    dtype = dtype or cfg.dtype
+    shape = (num_blocks, cfg.n_kv_heads, block_size, cfg.head_dim)
+    return {str(i): {"k": jnp.zeros(shape, dtype),
+                     "v": jnp.zeros(shape, dtype)}
+            for i in range(cfg.n_layers)}
+
+
+def llama_prefill_paged(params, pools, tokens, length, block_table,
+                        cfg: LlamaConfig, block_size):
+    """Bucketed prefill: run the context through the stack once, write its
+    KV into the paged pool, return the next-token logits.
+
+    tokens (S,) int32 right-padded to the bucket size; length () int32 true
+    context length; block_table (S // block_size,) int32 pool block per
+    logical block (entries >= num_blocks are dropped). Returns
+    (logits (vocab,) fp32 at position length-1, new pools).
+
+    Embedding is always the gather path — `embed_onehot` exists for the
+    *backward* scatter-add under vocab sharding, which inference never runs.
+    """
+    S = tokens.shape[0]
+    num_blocks = pools["0"]["k"].shape[0]
+    x = params["tok_embeddings"][tokens][None]               # (1,S,D)
+    positions = jnp.arange(S, dtype=jnp.int32)
+    cos, sin = rope_freqs(positions, cfg.head_dim, cfg.rope_theta)
+    blk = block_table[positions // block_size]
+    # pad rows write nowhere (their k/v rows are garbage-by-construction)
+    blk = jnp.where(positions < length, blk, num_blocks)
+    off = positions % block_size
+    new_pools = {}
+    for i in range(cfg.n_layers):
+        lp = params["layers"][str(i)]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["attn"]["wq"]).reshape(1, S, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["attn"]["wk"]).reshape(1, S, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        v = (h @ lp["attn"]["wv"]).reshape(1, S, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+        k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+        v = v.transpose(0, 2, 1, 3)
+        pk = pools[str(i)]["k"].at[blk, :, off].set(
+            k[0].transpose(1, 0, 2), mode="drop")
+        pv = pools[str(i)]["v"].at[blk, :, off].set(
+            v[0].transpose(1, 0, 2), mode="drop")
+        new_pools[str(i)] = {"k": pk, "v": pv}
+        o = flash_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(1, S, -1)
+        x = x + o @ lp["attn"]["wo"]
+        x = _mlp(lp, x, cfg)
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                    keepdims=False)
+    head = params["tok_embeddings"] if cfg.tie_embeddings else params["lm_head"]
+    logits = (last @ head.T.astype(last.dtype)).astype(jnp.float32)
+    return logits, new_pools
+
+
+def llama_decode_paged(params, pools, tokens, positions, block_tables,
+                       cfg: LlamaConfig, block_size):
+    """One continuous-batching decode step over the paged pool.
+
+    tokens (B,) int32 — the token each stream feeds this step (its newest
+    emitted token); positions (B,) int32 — that token's position, or -1
+    for an inactive batch slot (write dropped, logits ignored by the
+    caller); block_tables (B, nb) int32. Returns (logits (B, vocab) fp32,
+    new pools). Shapes are fixed by (B, nb): requests join and leave the
+    running batch between steps without ever changing the signature.
+    """
+    B = tokens.shape[0]
+    num_blocks = pools["0"]["k"].shape[0]
+    active = positions >= 0
+    pos = jnp.maximum(positions, 0)
+    x = params["tok_embeddings"][tokens][:, None, :]         # (B,1,D)
+    cos, sin = rope_freqs(pos[:, None], cfg.head_dim, cfg.rope_theta)
+    blk = jnp.take_along_axis(block_tables, (pos // block_size)[:, None],
+                              axis=1)[:, 0]
+    blk = jnp.where(active, blk, num_blocks)
+    off = pos % block_size
+    lengths = pos + 1          # inactive slots read one masked garbage row
+    new_pools = {}
+    for i in range(cfg.n_layers):
+        lp = params["layers"][str(i)]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["attn"]["wq"]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["attn"]["wk"]).reshape(B, 1, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        v = (h @ lp["attn"]["wv"]).reshape(B, 1, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+        k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+        v = v.transpose(0, 2, 1, 3)
+        pk = pools[str(i)]["k"].at[blk, :, off].set(k[:, :, 0, :],
+                                                    mode="drop")
+        pv = pools[str(i)]["v"].at[blk, :, off].set(v[:, :, 0, :],
+                                                    mode="drop")
+        new_pools[str(i)] = {"k": pk, "v": pv}
+        o = paged_attention(q, pk, pv, block_tables, lengths)
+        x = x + o.transpose(0, 2, 1, 3).reshape(B, 1, -1) @ lp["attn"]["wo"]
+        x = _mlp(lp, x, cfg)
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    head = params["tok_embeddings"] if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, 0] @ head.T.astype(x.dtype)).astype(jnp.float32)
+    return logits, new_pools
 
 
 def llama_decode_step(params, cache, token, pos, cfg: LlamaConfig):

@@ -32,7 +32,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "paged_attention"]
 
 _NEG_INF = -1e30
 
@@ -443,3 +443,49 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     return _flash(q, k, v, bool(causal), float(sm_scale))
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths, sm_scale=None):
+    """Single-token attention over a paged KV pool (the serving decode path).
+
+    The KV cache lives as fixed-size blocks in one physical pool per layer
+    (`mxnet_tpu.serve.KVBlockPool`); each stream owns a block table mapping
+    its logical positions onto pool blocks — long contexts cost exactly the
+    blocks they fill, not a max_seq_len rectangle per batch slot.
+
+    q:            (B, H, 1, D) — one new query token per stream.
+    k_pool/v_pool:(N, Hkv, bs, D) — the shared physical pool (N blocks of
+                  bs tokens). H divisible by Hkv (GQA).
+    block_tables: (B, nb) int32 — per-stream block ids, logical block j of
+                  stream b at entry [b, j]. Entries >= N mark unallocated
+                  tail blocks; the gather clamps them and the length mask
+                  discards whatever they read.
+    lengths:      (B,) int32 — valid context length per stream (the new
+                  token's KV must already be written to the pool). Must be
+                  >= 1 (inactive batch slots pass 1 and ignore the output)
+                  so the softmax never normalizes over an empty row.
+
+    Returns (B, H, 1, D) in q's dtype. Same grouped-einsum structure and
+    fp32 softmax as `_ref_attention`, so paged decode matches the unpaged
+    reference bit-for-bit on the positions the mask keeps.
+    """
+    B, H, _, D = q.shape
+    Hkv, bs = k_pool.shape[1], k_pool.shape[2]
+    nb = block_tables.shape[1]
+    g = H // Hkv
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    # gather each stream's pages: (B, nb, Hkv, bs, D) -> (B, Hkv, nb*bs, D)
+    k = k_pool[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, Hkv, nb * bs, D)
+    v = v_pool[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, Hkv, nb * bs, D)
+    qg = q.reshape(B, Hkv, g, 1, D)
+    logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    mask = lax.broadcasted_iota(jnp.int32, (B, 1, 1, 1, nb * bs), 4) \
+        < lengths[:, None, None, None, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v)
+    return out.reshape(B, H, 1, D).astype(q.dtype)

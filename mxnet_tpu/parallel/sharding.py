@@ -16,7 +16,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingRules", "LLAMA_RULES", "BERT_RULES", "named_sharding",
            "shard_pytree", "replicate_pytree", "reshard_pytree",
-           "logical_to_spec"]
+           "donated_device_put", "logical_to_spec"]
 
 P = PartitionSpec
 
@@ -131,17 +131,40 @@ def replicate_pytree(params, mesh):
         lambda x: jax.device_put(x, NamedSharding(mesh, P())), params)
 
 
-def reshard_pytree(params, rules, mesh):
+def donated_device_put(x, spec, mesh, donate):
+    """Host-bounce one leaf onto `mesh` per `spec`, optionally deleting
+    the source buffer the moment its host copy exists — the single move
+    both elastic re-layout paths (`reshard_pytree`,
+    `ShardedTrainStep.place`) share. Deleting BEFORE the new placement
+    allocates is what caps peak HBM at max(old, new) + one leaf; XLA
+    keeps the host view valid while the numpy external reference lives,
+    so the bounce is safe even when the device copy was zero-copy."""
+    import numpy as _np
+    host = _np.asarray(x)
+    if donate and isinstance(x, jax.Array) and not x.is_deleted():
+        x.delete()
+    return jax.device_put(jax.numpy.asarray(host),
+                          NamedSharding(mesh, spec))
+
+
+def reshard_pytree(params, rules, mesh, donate=False):
     """Re-lay a pytree that may already live on a DIFFERENT (possibly
     partially dead) mesh onto `mesh`: every leaf is pulled to host first,
     then placed per `rules`. The elastic-recovery variant of
     `shard_pytree` — device_put straight from an array whose source
     devices vanished raises; a host bounce always works, and restored
-    snapshots are host arrays anyway (free)."""
-    import numpy as _np
-    host = jax.tree_util.tree_map(lambda x: _np.asarray(x), params)
-    return shard_pytree(
-        jax.tree_util.tree_map(jax.numpy.asarray, host), rules, mesh)
+    snapshots are host arrays anyway (free).
+
+    donate=True deletes each source buffer the moment its host copy
+    exists, BEFORE the new placement allocates — so grow-back re-layout
+    peaks at max(old, new) + one leaf of HBM instead of old + new (the
+    resilience-v2 follow-on: without donation, re-laying a model near the
+    memory ceiling OOMs on the very recovery meant to save it). Donated
+    leaves are unusable afterwards; only pass trees the caller is about
+    to replace. Host-resident leaves (restored snapshots) are untouched."""
+    specs = rules.tree_specs(params, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: donated_device_put(x, s, mesh, donate), params, specs)
 
 
 # flax-style logical axis mapping: model code annotates with logical names,

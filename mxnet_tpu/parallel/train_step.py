@@ -161,23 +161,31 @@ class ShardedTrainStep:
     # ------------------------------------------------------------------
     # elastic re-layout (resilience: the device set changed under the run)
     # ------------------------------------------------------------------
-    def place(self, params, opt_state):
+    def place(self, params, opt_state, donate=True):
         """Re-lay existing (params, opt_state) trees onto THIS step's mesh:
         rules-derived NamedShardings + device_put — `init()` for state that
         already has values. The elastic-recovery primitive: a restored
         snapshot (host arrays) or a live tree from a partially-dead mesh
         lands sharded across the current device set (every leaf bounces
         through host — `sharding.reshard_pytree` — because device_put
-        straight off vanished source devices raises)."""
-        import numpy as _np
-        from .sharding import reshard_pytree
-        params = reshard_pytree(params, self.rules, self.mesh)
+        straight off vanished source devices raises).
+
+        donate=True (default): each source device buffer is deleted the
+        moment its host copy exists, so grow-back re-layout peaks at
+        max(old, new) + one leaf of HBM instead of old + new. The inputs
+        are consumed — callers keep only the returned trees (the
+        `ResilientRunner` relayout adapters already do). Pass donate=False
+        to keep the sources alive (e.g. an A/B comparison)."""
+        from .sharding import donated_device_put, reshard_pytree
+        params = reshard_pytree(params, self.rules, self.mesh,
+                                donate=donate)
         self._param_specs = self.rules.tree_specs(params, self.mesh)
-        opt_state = _tmap(lambda x: jnp.asarray(_np.asarray(x)), opt_state)
         opt_specs = self._state_specs(opt_state)
+        # PartitionSpec is a pytree leaf, so one tree_map covers both the
+        # scalar slots (spec = P()) and the per-param subtrees
         opt_state = _tmap(
-            lambda x, s: jax.device_put(
-                x, NamedSharding(self.mesh, s)), opt_state, opt_specs)
+            lambda x, s: donated_device_put(x, s, self.mesh, donate),
+            opt_state, opt_specs)
         return params, opt_state
 
     def rebuild_for_mesh(self, mesh):

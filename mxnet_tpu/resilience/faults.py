@@ -24,6 +24,15 @@ Instrumented sites:
                                       ``preempt`` fault here simulates a
                                       TPU-VM preemption NOTICE (proactive
                                       checkpoint), not a crash
+``serve.admit``                       inference-request admission
+                                      (`mx.serve.InferenceServer.submit`)
+``serve.step``                        top of every serving scheduler step
+                                      (inside the watchdog guard, before
+                                      admission/decode) — an ``error`` or
+                                      ``preempt`` here IS the
+                                      "replica killed mid-stream" drill:
+                                      in-flight streams drain back to the
+                                      queue and resume by re-prefill
 
 Fault kinds:
 

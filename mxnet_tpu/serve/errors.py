@@ -1,0 +1,52 @@
+"""Structured serving errors: load-shedding and deadline misses.
+
+A serving runtime under pressure must fail *requests*, never the process:
+an exhausted KV pool or a full queue answers with a structured `Overloaded`
+(carrying enough state for the client to back off intelligently) instead of
+marching into an OOM, and a request that cannot meet its deadline is shed
+with `DeadlineExceeded` (carrying whatever tokens it did produce) instead
+of burning decode slots on an answer nobody is waiting for.
+
+Both are `ResilienceError`s but deliberately NOT `RetriableError`s: they
+are verdicts about *this request under this load*, not transient transport
+faults — the in-runtime recovery machinery (retry policies, drain/requeue)
+must never spin on them.
+"""
+from __future__ import annotations
+
+from ..resilience.errors import ResilienceError
+
+__all__ = ["ServeError", "Overloaded", "DeadlineExceeded"]
+
+
+class ServeError(ResilienceError):
+    """Base class of every error raised by mxnet_tpu.serve."""
+
+
+class Overloaded(ServeError):
+    """Graceful load-shed: the runtime cannot admit this request right now.
+
+    reason: ``queue_full`` (admission queue at capacity), ``kv_exhausted``
+    (the paged KV pool cannot hold the request's worst-case context), or
+    ``too_large`` (the request can NEVER fit — prompt + budget exceeds the
+    pool or the bucket table; retrying is pointless).
+    """
+
+    def __init__(self, message, reason=None, queue_depth=None,
+                 kv_free_blocks=None, kv_needed_blocks=None,
+                 retry_after_s=None):
+        super().__init__(message)
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.kv_free_blocks = kv_free_blocks
+        self.kv_needed_blocks = kv_needed_blocks
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed — in the queue (no tokens) or
+    mid-stream (`tokens` carries the partial output)."""
+
+    def __init__(self, message, tokens=None):
+        super().__init__(message)
+        self.tokens = list(tokens or [])

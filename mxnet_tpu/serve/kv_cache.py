@@ -1,0 +1,193 @@
+"""Paged KV-cache allocator: fixed-size blocks with a free-list.
+
+The naive decode cache (`models.llama.init_kv_cache`) is a
+(batch, max_seq_len) rectangle per stream — a 64-token chat in an 8k-context
+model wastes 99% of its rows, and the rectangle's batch dim is frozen at
+allocation, which is exactly what continuous batching cannot have. Here KV
+lives in ONE physical pool per layer, carved into fixed-size blocks
+(vLLM's PagedAttention layout, sized by ``MXNET_TPU_SERVE_KV_BLOCKS`` ×
+``MXNET_TPU_SERVE_KV_BLOCK`` tokens): a stream owns exactly the blocks its
+context fills, via a block table the jitted programs use to gather/scatter
+(`parallel.flash_attention.paged_attention`), and finished streams return
+their blocks to a free-list for immediate reuse — fragmentation is
+impossible by construction because every block is interchangeable.
+
+Exhaustion is a *verdict*, not a crash: `alloc` either reserves every block
+the caller asked for or raises a structured `Overloaded` having reserved
+nothing, so admission control can shed the request (or leave it queued)
+while the streams already running keep their memory. Freed blocks are not
+zeroed — a reused block is fully overwritten up to its new owner's length,
+and positions past that length are masked out of every gather.
+
+Telemetry: ``serve.kv.blocks_in_use`` gauge (watermark = peak pool
+pressure), ``serve.kv.allocs`` / ``serve.kv.freed_blocks`` /
+``serve.kv.exhausted`` counters.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .. import telemetry as _telem
+from .errors import Overloaded
+
+__all__ = ["KVBlockPool", "default_num_blocks", "default_block_size"]
+
+
+def default_num_blocks():
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_SERVE_KV_BLOCKS", "256")))
+    except (TypeError, ValueError):
+        return 256
+
+
+def default_block_size():
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_SERVE_KV_BLOCK", "16")))
+    except (TypeError, ValueError):
+        return 16
+
+
+class KVBlockPool:
+    """Physical paged KV pool + block accounting for one serving replica.
+
+    Owns the per-layer pool arrays (`models.llama.init_kv_pools` layout)
+    and the stream → block-table map. The jitted programs treat the arrays
+    functionally; `update()` swaps in each program's returned pools (the
+    programs donate the inputs, so the swap is also the memory's lifetime).
+    """
+
+    def __init__(self, cfg, num_blocks=None, block_size=None, dtype=None):
+        from ..models.llama import init_kv_pools
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks or default_num_blocks())
+        self.block_size = int(block_size or default_block_size())
+        self._dtype = dtype
+        self.pools = init_kv_pools(cfg, self.num_blocks, self.block_size,
+                                   dtype=dtype)
+        # LIFO free-list: a just-freed (cache-warm) block is reused first
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._tables = {}           # stream_id -> [block ids]
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- geometry
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold an n_tokens context."""
+        return -(-max(0, int(n_tokens)) // self.block_size)
+
+    @property
+    def free_blocks(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def blocks_in_use(self):
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    # ----------------------------------------------------------- allocation
+    def alloc(self, stream_id, n_tokens):
+        """Grow `stream_id`'s block table to cover `n_tokens` positions.
+
+        All-or-nothing: raises `Overloaded(reason="kv_exhausted")` — having
+        reserved NOTHING — when the free-list is short, so a rejected
+        admission never strands half a context in the pool."""
+        need_total = self.blocks_for(n_tokens)
+        with self._lock:
+            table = self._tables.get(stream_id, [])
+            grow = need_total - len(table)
+            if grow <= 0:
+                return list(table)
+            if grow > len(self._free):
+                # reserve NOTHING on failure — not even an empty table
+                # entry: rejected stream ids are uuids that never return,
+                # so a leftover entry would leak one dict slot per shed
+                free = len(self._free)
+                _telem.inc("serve.kv.exhausted")
+                raise Overloaded(
+                    "KV pool exhausted: stream %r needs %d more block(s) "
+                    "(%d tokens) but only %d of %d are free"
+                    % (stream_id, grow, n_tokens, free, self.num_blocks),
+                    reason="kv_exhausted", kv_free_blocks=free,
+                    kv_needed_blocks=grow)
+            table = table + [self._free.pop() for _ in range(grow)]
+            self._tables[stream_id] = table
+            in_use = self.num_blocks - len(self._free)
+        _telem.inc("serve.kv.allocs")
+        _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+        return list(table)
+
+    def free(self, stream_id):
+        """Return the stream's blocks to the free-list (idempotent)."""
+        with self._lock:
+            table = self._tables.pop(stream_id, None)
+            if not table:
+                return 0
+            self._free.extend(reversed(table))
+            in_use = self.num_blocks - len(self._free)
+        _telem.inc("serve.kv.freed_blocks", len(table))
+        _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+        return len(table)
+
+    def table(self, stream_id, width):
+        """The stream's block table as a width-`width` int32 array, padded
+        with the `num_blocks` sentinel (dropped writes / masked reads).
+        Truncates past `width`: a prefill bucket's table only names the
+        blocks its positions can touch, even when the stream reserved its
+        worst-case context up front."""
+        with self._lock:
+            blocks = list(self._tables.get(stream_id, ()))[:width]
+        out = np.full(width, self.num_blocks, np.int32)
+        out[:len(blocks)] = blocks
+        return out
+
+    def owned_blocks(self, stream_id):
+        with self._lock:
+            return list(self._tables.get(stream_id, ()))
+
+    # -------------------------------------------------------------- storage
+    def update(self, new_pools):
+        """Adopt the pools a prefill/decode program returned (the program
+        donated the previous arrays)."""
+        self.pools = new_pools
+
+    def reconcile(self):
+        """Rebuild the free-list as the exact complement of every live
+        table. Recovery calls this because an async fault (the watchdog's
+        StallError lands at any bytecode) can tear alloc/free mid-flight:
+        blocks popped from the free-list but not yet committed to a
+        table — or popped from a table but not yet returned — are in
+        NEITHER structure and would otherwise leak forever, shrinking
+        effective pool capacity with every stall. Returns the number of
+        blocks recovered (0 when nothing was torn)."""
+        with self._lock:
+            owned = {b for table in self._tables.values() for b in table}
+            before = len(self._free)
+            self._free = [b for b in range(self.num_blocks - 1, -1, -1)
+                          if b not in owned]
+            recovered = len(self._free) - before
+            in_use = self.num_blocks - len(self._free)
+        if recovered:
+            _telem.inc("serve.kv.reconciled_blocks", recovered)
+            _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+        return recovered
+
+    def ensure_storage(self):
+        """Heal donation wreckage after a fault: an async StallError can
+        land between a donating program call and `update`, leaving
+        `pools` pointing at deleted buffers. Recovery requeues every
+        stream for re-prefill, so the CONTENT is worthless anyway — the
+        arrays just have to be alive again. Returns True when the pools
+        were re-materialized."""
+        import jax
+        from ..models.llama import init_kv_pools
+        leaves = jax.tree_util.tree_leaves(self.pools)
+        if not any(isinstance(x, jax.Array) and x.is_deleted()
+                   for x in leaves):
+            return False
+        self.pools = init_kv_pools(self.cfg, self.num_blocks,
+                                   self.block_size, dtype=self._dtype)
+        _telem.inc("serve.kv.storage_resets")
+        return True

@@ -1,0 +1,198 @@
+"""AOT-compiled prefill/decode executables per bucketed signature.
+
+The reference's answer to varying sequence lengths was BucketingModule —
+one symbolic executor per bucket, picked at dispatch time. Relay (PAPERS.md)
+sharpened that into ahead-of-time compilation per input signature. This
+module is the serving version of both: every program a request could need
+is lowered and compiled at **warm-up** — one prefill executable per
+bucketed context length (right-padded, length-masked) and ONE decode
+executable for the whole replica (batch and block-table dims fixed at
+``max_batch`` × ``blocks_per_stream``; streams join/leave between steps by
+flipping slots active/inactive, never by changing a shape) — so admission
+can never trigger a mid-traffic retrace. Compiles route through
+``telemetry.note_compile`` (the acceptance evidence: the compile ring must
+not grow after warm-up), and a post-warm-up signature miss is treated
+exactly like a CachedOp retrace: counted (``serve.retrace``), explained,
+and routed through ``analysis.guard.on_retrace`` so the trace guard's
+retrace limit covers the serving path too.
+
+Sampling is greedy (argmax inside the program — one int32 per stream
+crosses the device boundary, not a vocab row). Greedy is also what makes
+kill-mid-stream recovery *byte-identical*: re-prefilling an interrupted
+stream's prompt + already-emitted tokens rebuilds the same KV state, so the
+resumed decode continues the exact token trajectory.
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import numpy as np
+
+from .. import telemetry as _telem
+
+__all__ = ["ServePrograms", "default_buckets"]
+
+
+def default_buckets(block_size, max_context):
+    """Power-of-two context buckets, block-aligned, covering max_context."""
+    out = []
+    b = max(int(block_size), 8)
+    while b < max_context:
+        out.append(b)
+        b *= 2
+    out.append(-(-int(max_context) // block_size) * block_size)
+    return tuple(sorted(set(out)))
+
+
+class ServePrograms:
+    """The compiled half of a serving replica: params + pool geometry in,
+    token ids out. The scheduler owns WHAT runs when; this owns the
+    executables and the no-retrace contract."""
+
+    def __init__(self, params, cfg, pool, max_batch, max_context,
+                 buckets=None):
+        from ..models.llama import llama_decode_paged, llama_prefill_paged
+        self.params = params
+        self.cfg = cfg
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        bs = pool.block_size
+        self.max_context = min(int(max_context), cfg.max_seq_len)
+        self.blocks_per_stream = -(-self.max_context // bs)
+        self.buckets = tuple(b for b in (buckets
+                                         or default_buckets(
+                                             bs, self.max_context))
+                             if b % bs == 0)
+        if not self.buckets:
+            raise ValueError(
+                "serve: no valid prefill buckets (buckets must be "
+                "multiples of the KV block size %d)" % bs)
+
+        def _prefill(params, pools, tokens, length, table):
+            logits, pools = llama_prefill_paged(
+                params, pools, tokens, length, table, cfg, bs)
+            return jax.numpy.argmax(logits).astype(jax.numpy.int32), pools
+
+        def _decode(params, pools, tokens, positions, tables):
+            logits, pools = llama_decode_paged(
+                params, pools, tokens, positions, tables, cfg, bs)
+            return (jax.numpy.argmax(logits, axis=-1).astype(
+                jax.numpy.int32), pools)
+
+        self._prefill_jit = jax.jit(_prefill, donate_argnums=(1,))
+        self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
+        self._prefill_exec = {}
+        self._decode_exec = None
+        self._warm = False
+
+    # ------------------------------------------------------------- buckets
+    def bucket_for(self, n_tokens):
+        """Smallest warmed bucket holding n_tokens, or None (too large)."""
+        for b in self.buckets:
+            if n_tokens <= b:
+                return b
+        return None
+
+    # -------------------------------------------------------------- warmup
+    def _pool_avals(self):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self.pool.pools)
+
+    def _compile_prefill(self, bucket):
+        i32 = jax.numpy.int32
+        t0 = time.perf_counter()
+        ex = self._prefill_jit.lower(
+            self.params, self._pool_avals(),
+            jax.ShapeDtypeStruct((bucket,), i32),
+            jax.ShapeDtypeStruct((), i32),
+            jax.ShapeDtypeStruct((bucket // self.pool.block_size,), i32),
+        ).compile()
+        _telem.inc("serve.compile")
+        _telem.observe("serve.compile_ms", (time.perf_counter() - t0) * 1e3)
+        _telem.note_compile("serve.prefill[S=%d]" % bucket)
+        self._prefill_exec[bucket] = ex
+        return ex
+
+    def _compile_decode(self):
+        i32 = jax.numpy.int32
+        t0 = time.perf_counter()
+        ex = self._decode_jit.lower(
+            self.params, self._pool_avals(),
+            jax.ShapeDtypeStruct((self.max_batch,), i32),
+            jax.ShapeDtypeStruct((self.max_batch,), i32),
+            jax.ShapeDtypeStruct((self.max_batch, self.blocks_per_stream),
+                                 i32),
+        ).compile()
+        _telem.inc("serve.compile")
+        _telem.observe("serve.compile_ms", (time.perf_counter() - t0) * 1e3)
+        _telem.note_compile("serve.decode[B=%d]" % self.max_batch)
+        self._decode_exec = ex
+        return ex
+
+    def warmup(self):
+        """Compile every executable a request could route to. After this,
+        steady-state traffic never compiles (the acceptance bar)."""
+        with _telem.span("serve.warmup", "serve"):
+            for bucket in self.buckets:
+                if bucket not in self._prefill_exec:
+                    self._compile_prefill(bucket)
+            if self._decode_exec is None:
+                self._compile_decode()
+        self._warm = True
+
+    def _on_miss(self, kind, reason):
+        """A post-warm-up signature miss IS a retrace: count it, explain
+        it, and give the trace guard its veto."""
+        if not self._warm:
+            return
+        _telem.inc("serve.retrace")
+        _telem.note_compile("serve.%s(retrace)" % kind)
+        from ..analysis import guard as _guard
+        if _guard.ACTIVE:
+            n = len(self._prefill_exec) + (1 if self._decode_exec else 0)
+            _guard.on_retrace("serve.%s" % kind, n + 1, reason)
+
+    # ------------------------------------------------------------- execute
+    def prefill(self, tokens, table):
+        """Run the bucketed prefill for a context of `tokens` (list/array
+        of ints). `table` is the stream's padded-to-bucket block table.
+        Returns the next token id (int)."""
+        n = len(tokens)
+        bucket = self.bucket_for(n)
+        if bucket is None:
+            raise ValueError(
+                "serve: context of %d tokens exceeds the largest bucket "
+                "(%d) — admission should have shed this request"
+                % (n, self.buckets[-1]))
+        ex = self._prefill_exec.get(bucket)
+        if ex is None:
+            self._on_miss("prefill", "unwarmed bucket S=%d (warmed: %s)"
+                          % (bucket, ",".join(map(str, self._prefill_exec))
+                             or "none"))
+            ex = self._compile_prefill(bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:n] = tokens
+        tbl = np.asarray(table, np.int32)[:bucket // self.pool.block_size]
+        tok, pools = ex(self.params, self.pool.pools, padded,
+                        np.int32(n), tbl)
+        self.pool.update(pools)
+        return int(tok)
+
+    def decode(self, tokens, positions, tables):
+        """One decode step over the fixed-size batch. tokens/positions
+        (max_batch,) int32 (position -1 = inactive slot), tables
+        (max_batch, blocks_per_stream) int32. Returns the next token id
+        per slot as a numpy (max_batch,) array."""
+        ex = self._decode_exec
+        if ex is None:
+            self._on_miss("decode", "decode executable missing at dispatch")
+            ex = self._compile_decode()
+        out, pools = ex(self.params, self.pool.pools,
+                        np.asarray(tokens, np.int32),
+                        np.asarray(positions, np.int32),
+                        np.asarray(tables, np.int32))
+        self.pool.update(pools)
+        return np.asarray(out)

@@ -1,0 +1,114 @@
+"""Replica-group supervision: N serving replicas over one shared queue.
+
+`ResilientRunner` semantics applied to serving: each replica is an
+`InferenceServer` on its own thread with its own KV pool and compiled
+programs, all admitting from ONE shared `RequestQueue`. A replica that
+takes a retriable fault drains in place (its in-flight streams re-enter
+the shared queue and resume by re-prefill — `InferenceServer._recover`);
+a replica that spends its restart budget **dies**, and because the drain
+happens before the death, its streams are already queued for the
+survivors — a killed replica costs requeues, never tokens. The group is
+healthy while any replica lives.
+
+Telemetry: ``serve.replica_deaths`` counter (from the server),
+``serve.replicas_alive`` gauge, per-replica flight-recorder events.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from .. import telemetry as _telem
+from .errors import ServeError
+from .scheduler import InferenceServer, RequestQueue
+
+__all__ = ["ReplicaGroup"]
+
+_LOG = logging.getLogger("mxnet_tpu.serve")
+
+
+class ReplicaGroup:
+    """Usage::
+
+        group = mx.serve.ReplicaGroup(params, cfg, replicas=2)
+        group.warmup().start()
+        handles = [group.submit(r) for r in requests]
+        for h in handles:
+            h.result(timeout=60)
+        group.stop()
+    """
+
+    def __init__(self, params, cfg, replicas=2, queue_cap=None,
+                 **server_kwargs):
+        if replicas < 1:
+            raise ValueError("serve: a replica group needs >= 1 replica")
+        self.queue = RequestQueue(queue_cap)
+        self.servers = [
+            InferenceServer(params, cfg, queue=self.queue,
+                            name="replica%d" % i, **server_kwargs)
+            for i in range(int(replicas))]
+        self._threads = []
+        self._stop = threading.Event()
+
+    def warmup(self):
+        for server in self.servers:
+            server.warmup()
+        return self
+
+    # ---------------------------------------------------------------- life
+    def _loop(self, server):
+        try:
+            server.run(stop=self._stop)
+        except Exception as exc:  # noqa: BLE001 — a dead replica must not
+            # take the group down; its streams were requeued by _recover
+            server.dead = True
+            _LOG.warning("serve: %s died (%s: %s); %d replica(s) remain",
+                         server.name, type(exc).__name__, exc,
+                         self.alive_replicas)
+        finally:
+            _telem.set_gauge("serve.replicas_alive", self.alive_replicas)
+
+    def start(self):
+        self._stop.clear()
+        self._threads = [
+            threading.Thread(target=self._loop, args=(server,),
+                             name="mxnet_tpu_%s" % server.name, daemon=True)
+            for server in self.servers]
+        for t in self._threads:
+            t.start()
+        _telem.set_gauge("serve.replicas_alive", self.alive_replicas)
+        return self
+
+    def stop(self, timeout=30.0):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []
+
+    @property
+    def alive_replicas(self):
+        return sum(1 for s in self.servers if not s.dead)
+
+    # ------------------------------------------------------------- traffic
+    def submit(self, request):
+        """Admit through any live replica (admission state — queue cap,
+        pool geometry — is identical across the group)."""
+        for server in self.servers:
+            if not server.dead:
+                return server.submit(request)
+        raise ServeError("serve: every replica in the group is dead")
+
+    def drain(self, timeout=60.0):
+        """Block until the shared queue and every live replica's batch are
+        empty (best-effort; returns False on timeout)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            idle = len(self.queue) == 0 and all(
+                s.dead or (s._admitting is None
+                           and all(slot is None for slot in s._slots))
+                for s in self.servers)
+            if idle:
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,637 @@
+"""Continuous-batching scheduler: admission, decode loop, fault recovery.
+
+The serving analog of `resilience.run.ResilientRunner`: one replica =
+one `InferenceServer`, driving the AOT programs (`serve.programs`) over the
+paged KV pool (`serve.kv_cache`) with the full robustness contract wired
+through the existing planes —
+
+* **continuous batching** — requests join and leave the running batch
+  *between* decode steps (the way the bucketed comm engine overlaps
+  buckets): a fresh request is admitted into any free batch slot, prefilled
+  at its bucket, and decodes alongside whatever is already running; a
+  finished stream frees its slot and blocks immediately;
+* **admission control** — a full queue or an exhausted KV pool answers
+  with a structured `Overloaded` (shed, never OOM); a request whose
+  worst-case context can NEVER fit is shed at submit; a transiently
+  unfit one simply waits its turn in the queue (backpressure);
+* **deadlines & retry budgets** — each request carries an optional
+  deadline (checked in the queue and mid-stream; partial output travels on
+  the `DeadlineExceeded`) and a retry budget sourced from
+  `resilience.retry.RetryPolicy` (``MXNET_TPU_RETRIES``);
+* **fault sites** — ``serve.admit`` (submission) and ``serve.step`` (top
+  of every scheduler step) are `resilience.faults` sites, so
+  ``MXNET_TPU_FAULT_PLAN`` chaos plans cover serving exactly like
+  training; the step body runs under the hang watchdog
+  (``MXNET_TPU_SERVE_STEP_DEADLINE_S``, falling back to the global step
+  deadline), so a dead decode becomes a recoverable `StallError`;
+* **drain & resume** — any retriable fault drains the replica: every
+  in-flight stream's blocks are freed and the stream re-enters the queue
+  (front, budget decremented), to resume — here or on another replica —
+  by **re-prefilling its prompt + already-emitted tokens**. Greedy decode
+  plus the bit-matching paged/prefill math make the resumed output
+  byte-identical: no token is lost (emitted tokens are the new context)
+  and none duplicated (the resumed prefill emits the FIRST not-yet-seen
+  token).
+
+Telemetry: ``serve.requests/admitted/completed/shed[.reason]/tokens/
+prefills/decode_steps/recoveries/requeued_streams/failed`` counters,
+``serve.queue_depth`` / ``serve.batch_occupancy`` / ``serve.kv.*`` gauges,
+``serve.ttft_ms`` / ``serve.tpot_ms`` / ``serve.step_ms`` histograms, and
+``telemetry.step_event("serve.step", ms)`` per step — anomaly detection
+and the crash flight recorder cover the serving path for free.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from collections import deque
+
+import numpy as np
+
+from .. import telemetry as _telem
+from ..resilience import faults as _faults
+from ..resilience import watchdog as _watchdog
+from ..resilience.errors import RetriableError, RetryExhausted
+from ..resilience.retry import RetryPolicy
+from ..telemetry import flight as _flight
+from .errors import DeadlineExceeded, Overloaded
+from .kv_cache import KVBlockPool
+from .programs import ServePrograms
+
+__all__ = ["Request", "StreamHandle", "RequestQueue", "InferenceServer",
+           "default_max_batch", "default_queue_cap"]
+
+
+def default_max_batch():
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_SERVE_MAX_BATCH", "8")))
+    except (TypeError, ValueError):
+        return 8
+
+
+def default_queue_cap():
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_SERVE_QUEUE", "64")))
+    except (TypeError, ValueError):
+        return 64
+
+
+def _step_deadline_s():
+    raw = os.environ.get("MXNET_TPU_SERVE_STEP_DEADLINE_S")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return _watchdog.default_deadline_s()
+
+
+class Request:
+    """One generation request: a token prompt plus its budgets.
+
+    deadline_s is relative to submission and covers queue wait AND decode;
+    eos_id stops the stream early; retries overrides the replica-fault
+    budget (default: `RetryPolicy().max_attempts`, i.e. MXNET_TPU_RETRIES).
+    """
+
+    def __init__(self, prompt, max_new_tokens=16, request_id=None,
+                 deadline_s=None, eos_id=None, retries=None):
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("serve: empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("serve: max_new_tokens must be >= 1")
+        self.request_id = request_id or uuid.uuid4().hex[:12]
+        self.deadline_s = deadline_s
+        self.eos_id = eos_id
+        self.retries = retries
+
+
+class StreamHandle:
+    """The caller's view of an in-flight stream: tokens appear as decoded,
+    `result()` blocks for completion. Survives replica kills — a requeued
+    stream keeps its handle, so recovery is invisible to the client except
+    for `requeues` ticking up."""
+
+    def __init__(self, request):
+        self.request = request
+        self.id = request.request_id
+        self.tokens = []          # emitted tokens, grown by the scheduler
+        self.error = None
+        self.ttft_ms = None
+        self.tpot_ms = []         # per-output-token latencies after the 1st
+        self.requeues = 0
+        self._done = threading.Event()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block until the stream completes; returns the emitted tokens or
+        raises the structured error that ended it."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("serve: stream %s still running" % self.id)
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    def _complete(self):
+        self._done.set()
+
+    def _fail(self, exc):
+        self.error = exc
+        self._done.set()
+
+
+class _Stream:
+    """Scheduler-internal in-flight state. `handle.tokens` IS the emitted
+    list — requeue/resume carries it untouched."""
+
+    __slots__ = ("handle", "request", "retries_left", "deadline",
+                 "last_token_t", "t_submit", "owner", "table_row",
+                 "kv_id")
+
+    def __init__(self, handle, retries_left):
+        self.handle = handle
+        self.request = handle.request
+        self.retries_left = retries_left
+        # `is not None`: deadline_s=0 means "already expired", not "none"
+        self.deadline = (time.monotonic() + handle.request.deadline_s
+                         if handle.request.deadline_s is not None else None)
+        # the KV pool is keyed by THIS key, never by the caller-supplied
+        # request_id: two in-flight requests reusing one id must not
+        # silently share (and cross-corrupt) one block table
+        self.kv_id = uuid.uuid4().hex[:12]
+        self.last_token_t = None
+        self.t_submit = time.perf_counter()
+        # who holds the stream right now — the RequestQueue instance when
+        # queued, the InferenceServer that popped it while in flight.
+        # Written ONLY under the queue lock; recovery decisions read it
+        # there too, so "has my requeue already run / did another replica
+        # already take this stream" is answered atomically (a plain
+        # membership check would race a sibling replica's pop)
+        self.owner = None
+        # padded block-table row, cached at admission: the table is
+        # immutable for the stream's in-flight life (worst-case blocks
+        # reserved up front), so the decode hot path must not rebuild it
+        # per token
+        self.table_row = None
+
+    @property
+    def context(self):
+        return self.request.prompt + self.handle.tokens
+
+    def expired(self, now):
+        return self.deadline is not None and now > self.deadline
+
+    def finished(self):
+        """Emitted everything it ever will (budget spent, or EOS) — but
+        not yet retired. Normally _finish_check retires in the same step;
+        a requeued stream can arrive in this state when a fault landed in
+        between."""
+        tokens = self.handle.tokens
+        if len(tokens) >= self.request.max_new_tokens:
+            return True
+        return (self.request.eos_id is not None and tokens
+                and tokens[-1] == self.request.eos_id)
+
+
+class RequestQueue:
+    """Bounded admission queue, shareable across replicas. `push` sheds at
+    capacity; `requeue` (recovery re-entry) is cap-exempt and goes to the
+    FRONT — a stream must never be shed by its own replica's death."""
+
+    def __init__(self, cap=None):
+        self.cap = int(cap or default_queue_cap())
+        self._items = deque()
+        self._cond = threading.Condition()
+
+    def push(self, stream):
+        with self._cond:
+            if len(self._items) >= self.cap:
+                raise Overloaded(
+                    "serve queue full (%d waiting, cap %d)"
+                    % (len(self._items), self.cap),
+                    reason="queue_full", queue_depth=len(self._items),
+                    retry_after_s=0.1)
+            stream.owner = self
+            self._items.append(stream)
+            depth = len(self._items)
+            self._cond.notify_all()
+        _telem.set_gauge("serve.queue_depth", depth)
+
+    def requeue(self, stream):
+        with self._cond:
+            stream.owner = self
+            self._items.appendleft(stream)
+            depth = len(self._items)
+            self._cond.notify_all()
+        _telem.set_gauge("serve.queue_depth", depth)
+
+    def pop(self, owner=None):
+        """Pop the head stream, atomically transferring ownership to
+        `owner` (the popping replica) under the queue lock."""
+        with self._cond:
+            if not self._items:
+                return None
+            stream = self._items.popleft()
+            stream.owner = owner
+            depth = len(self._items)
+        _telem.set_gauge("serve.queue_depth", depth)
+        return stream
+
+    def owned_by(self, stream, who):
+        """Atomic ownership check — recovery's 'is this mid-admission
+        stream still MINE to drain, or did my requeue already hand it
+        off (possibly straight into a sibling replica's pop)?'"""
+        with self._cond:
+            return stream.owner is who
+
+    def wait_nonempty(self, timeout=None):
+        with self._cond:
+            if self._items:
+                return True
+            self._cond.wait(timeout)
+            return bool(self._items)
+
+    def __len__(self):
+        with self._cond:
+            return len(self._items)
+
+
+class InferenceServer:
+    """One fault-tolerant continuous-batching serving replica.
+
+    Usage::
+
+        server = mx.serve.InferenceServer(params, cfg)
+        server.warmup()                       # AOT-compile all programs
+        h = server.submit(mx.serve.Request([1, 2, 3], max_new_tokens=8))
+        server.run()                          # drive until idle
+        print(h.result())
+    """
+
+    def __init__(self, params, cfg, *, max_batch=None, kv_blocks=None,
+                 block_size=None, max_context=None, buckets=None,
+                 queue=None, queue_cap=None, step_deadline_s=None,
+                 max_restarts=3, name="replica0"):
+        self.name = name
+        self.cfg = cfg
+        self.pool = KVBlockPool(cfg, num_blocks=kv_blocks,
+                                block_size=block_size)
+        if max_context is None:
+            max_context = min(cfg.max_seq_len,
+                              self.pool.num_blocks * self.pool.block_size)
+        self.max_batch = int(max_batch or default_max_batch())
+        self.programs = ServePrograms(params, cfg, self.pool,
+                                      self.max_batch, max_context,
+                                      buckets=buckets)
+        self.queue = queue if queue is not None else RequestQueue(queue_cap)
+        self.step_deadline_s = (step_deadline_s if step_deadline_s
+                                is not None else _step_deadline_s())
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.dead = False
+        self._slots = [None] * self.max_batch
+        # the stream currently mid-admission (popped from the queue but
+        # not yet in a slot): a fault landing inside _admit — including
+        # the watchdog's ASYNC StallError, which can fire between any two
+        # bytecodes of the prefill — must find it here, or recovery would
+        # drain only _slots and silently lose the stream
+        self._admitting = None
+        self._default_retries = RetryPolicy().max_attempts
+
+    # ------------------------------------------------------------ admission
+    def _worst_blocks(self, request):
+        """Blocks reserved at admission: the FULL possible context. Greedy
+        reservation keeps the invariant that an admitted stream can always
+        finish — mid-stream KV exhaustion cannot exist. The final emitted
+        token's KV is never written (the stream retires before feeding
+        it), so the worst case is prompt + max_new_tokens - 1 positions."""
+        return self.pool.blocks_for(len(request.prompt)
+                                    + request.max_new_tokens - 1)
+
+    def _note_shed(self, reason, detail=""):
+        _telem.inc("serve.shed")
+        _telem.inc("serve.shed.%s" % reason)
+        _flight.note_event("serve_shed",
+                           "%s %s" % (reason, detail) if detail else reason)
+
+    def _shed(self, exc, reason):
+        self._note_shed(reason)
+        raise exc
+
+    def submit(self, request):
+        """Admit a request into the queue; returns a `StreamHandle`.
+        Raises `Overloaded` (structured, never an OOM later) when the
+        queue is full or the request can never fit the KV pool/buckets."""
+        _faults.check("serve.admit", context="request=%s"
+                      % request.request_id)
+        _telem.inc("serve.requests")
+        # the longest context this request can ever re-prefill (a resumed
+        # stream prefills prompt + all-but-one emitted budget)
+        max_prefill = len(request.prompt) + request.max_new_tokens - 1
+        # the explicit max_context bound matters when the last bucket
+        # rounded UP past it (block alignment): bucket existence alone
+        # would admit positions beyond the model's trained context
+        if (self._worst_blocks(request) > self.pool.num_blocks
+                or self.programs.bucket_for(max_prefill) is None
+                or max_prefill > self.programs.max_context):
+            self._shed(Overloaded(
+                "request %s can never fit: prompt %d + budget %d tokens "
+                "vs pool of %d blocks x %d (max context %d)"
+                % (request.request_id, len(request.prompt),
+                   request.max_new_tokens, self.pool.num_blocks,
+                   self.pool.block_size, self.programs.max_context),
+                reason="too_large",
+                kv_needed_blocks=self._worst_blocks(request),
+                kv_free_blocks=self.pool.free_blocks), "too_large")
+        handle = StreamHandle(request)
+        retries = (request.retries if request.retries is not None
+                   else self._default_retries)
+        stream = _Stream(handle, retries_left=retries)
+        try:
+            self.queue.push(stream)
+        except Overloaded:
+            self._note_shed("queue_full")
+            raise
+        return handle
+
+    # ------------------------------------------------------------- stepping
+    def warmup(self):
+        self.programs.warmup()
+        return self
+
+    def _free_slot(self):
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _retire(self, slot, stream, error=None):
+        # terminal event FIRST: if an async fault lands mid-retire, the
+        # stream is done-marked while still findable in its slot, and
+        # _drain_stream's done() branch finishes the cleanup — the other
+        # order would strand a finished stream in neither place
+        if error is not None:
+            stream.handle._fail(error)
+        else:
+            _telem.inc("serve.completed")
+            stream.handle._complete()
+        self.pool.free(stream.kv_id)
+        self._slots[slot] = None
+
+    def _finish_check(self, slot, stream, token, now):
+        handle = stream.handle
+        request = stream.request
+        if stream.expired(now):
+            self._note_shed("deadline", stream.handle.id)
+            self._retire(slot, stream, DeadlineExceeded(
+                "request %s missed its %.3gs deadline after %d token(s)"
+                % (request.request_id, request.deadline_s,
+                   len(handle.tokens)), tokens=handle.tokens))
+            return True
+        if (len(handle.tokens) >= request.max_new_tokens
+                or (request.eos_id is not None
+                    and token == request.eos_id)):
+            self._retire(slot, stream)
+            return True
+        return False
+
+    def _admit(self):
+        """Fill free batch slots from the queue: pop → reserve KV → prefill
+        (prompt + any already-emitted tokens — the resume path) → join the
+        running batch. A transiently unfit head request goes back to the
+        front and admission stops (backpressure, streams keep decoding)."""
+        admitted = 0
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            # chained assignment marks the stream in-flight in the same
+            # statement that pops it (a fault can land at any bytecode
+            # boundary; the remaining pop->mark window is ~one store);
+            # the pop also transfers queue-lock-governed ownership to us
+            self._admitting = stream = self.queue.pop(self)
+            if stream is None:
+                break
+            if stream.finished():
+                # a fault landed between the stream's last token and its
+                # _finish_check: it came back complete — retire it here
+                # instead of re-prefilling one token too many
+                _telem.inc("serve.completed")
+                stream.handle._complete()
+                self._admitting = None
+                continue
+            now = time.monotonic()
+            if stream.expired(now):
+                self._note_shed("deadline", stream.handle.id)
+                stream.handle._fail(DeadlineExceeded(
+                    "request %s missed its %.3gs deadline in the queue"
+                    % (stream.handle.id, stream.request.deadline_s),
+                    tokens=stream.handle.tokens))
+                self._admitting = None
+                continue
+            try:
+                self.pool.alloc(stream.kv_id,
+                                len(stream.request.prompt)
+                                + stream.request.max_new_tokens - 1)
+            except Overloaded:
+                # transient: the pool drains as running streams finish
+                self.queue.requeue(stream)
+                self._admitting = None
+                break
+            # the table is immutable for the stream's in-flight life
+            # (worst case reserved above): build the padded row once,
+            # decode reuses it every step
+            stream.table_row = self.pool.table(
+                stream.kv_id, self.programs.blocks_per_stream)
+            context = stream.context
+            width = self.programs.bucket_for(len(context))
+            table = stream.table_row[:width // self.pool.block_size]
+            t0 = time.perf_counter()
+            token = self.programs.prefill(context, table)
+            _telem.inc("serve.prefills")
+            _telem.observe("serve.prefill_ms",
+                           (time.perf_counter() - t0) * 1e3)
+            now = time.monotonic()
+            stream.handle.tokens.append(token)
+            stream.last_token_t = now
+            _telem.inc("serve.tokens")
+            if stream.handle.ttft_ms is None:
+                # time-to-first-token counts the queue wait, not just the
+                # prefill — that is the latency the client experienced
+                stream.handle.ttft_ms = (time.perf_counter()
+                                         - stream.t_submit) * 1e3
+                _telem.observe("serve.ttft_ms", stream.handle.ttft_ms)
+            self._slots[slot] = stream
+            self._admitting = None
+            _telem.inc("serve.admitted")
+            admitted += 1
+            self._finish_check(slot, stream, token, now)
+        return admitted
+
+    def _decode(self):
+        """One decode step over every active slot (fixed program shape:
+        inactive slots ride along masked)."""
+        active = [(i, s) for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return 0
+        tokens = np.zeros(self.max_batch, np.int32)
+        positions = np.full(self.max_batch, -1, np.int32)
+        tables = np.full((self.max_batch, self.programs.blocks_per_stream),
+                         self.pool.num_blocks, np.int32)
+        for i, s in active:
+            tokens[i] = s.handle.tokens[-1]
+            positions[i] = len(s.context) - 1
+            tables[i] = s.table_row
+        out = self.programs.decode(tokens, positions, tables)
+        _telem.inc("serve.decode_steps")
+        now = time.monotonic()
+        for i, s in active:
+            token = int(out[i])
+            s.handle.tokens.append(token)
+            _telem.inc("serve.tokens")
+            if s.last_token_t is not None:
+                tpot = (now - s.last_token_t) * 1e3
+                s.handle.tpot_ms.append(tpot)
+                _telem.observe("serve.tpot_ms", tpot)
+            s.last_token_t = now
+            self._finish_check(i, s, token, now)
+        return len(active)
+
+    def step(self):
+        """One scheduler iteration: (maybe) admit, (maybe) decode. Returns
+        True while there is in-flight or queued work. Raises the injected/
+        real `RetriableError`s the recovery path (`run`) absorbs."""
+        if not self.programs._warm:
+            self.warmup()
+        t0 = time.perf_counter()
+        with _watchdog.guard("serve.step", deadline_s=self.step_deadline_s):
+            _faults.check("serve.step", context="replica=%s" % self.name)
+            self._admit()
+            decoded = self._decode()
+        occupancy = sum(1 for s in self._slots if s is not None)
+        _telem.set_gauge("serve.batch_occupancy", occupancy)
+        if decoded:
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            _telem.observe("serve.step_ms", dur_ms)
+            _telem.step_event("serve.step", dur_ms)
+        return occupancy > 0 or len(self.queue) > 0
+
+    # ------------------------------------------------------------- recovery
+    def _drain_stream(self, stream, exc):
+        """Free one in-flight stream's blocks and send it back to the
+        queue (front, budget decremented) — or fail it when the budget is
+        spent. Returns 1 when the stream was requeued."""
+        stream.table_row = None     # blocks are going back to the pool
+        if stream.handle.done():
+            # retirement's terminal event already fired when the fault
+            # landed; only the pool/slot cleanup remained
+            self.pool.free(stream.kv_id)
+            return 0
+        self.pool.free(stream.kv_id)
+        stream.retries_left -= 1
+        if stream.retries_left < 0:
+            _telem.inc("serve.failed")
+            stream.handle._fail(RetryExhausted(
+                "stream %s: replica-fault retry budget spent; last "
+                "error: %s: %s" % (stream.handle.id,
+                                   type(exc).__name__, exc),
+                site="serve.step", last_error=exc))
+            return 0
+        stream.handle.requeues += 1
+        self.queue.requeue(stream)
+        _telem.inc("serve.requeued_streams")
+        return 1
+
+    def _recover(self, exc):
+        """Drain after a replica fault: every in-flight stream — the batch
+        slots AND a stream caught mid-admission — frees its blocks and
+        re-enters the shared queue (front) to resume, here or on a
+        surviving replica, by re-prefill. Budget-exhausted streams fail
+        with `RetryExhausted` instead of looping forever."""
+        drained = 0
+        admitting, self._admitting = self._admitting, None
+        if admitting is not None and not admitting.handle.done() \
+                and self.queue.owned_by(admitting, self):
+            # drain the mid-admission stream ONLY if it is still OURS:
+            # if the fault landed in the one-bytecode window after our
+            # requeue ran (ownership already handed to the queue — or
+            # beyond, to a sibling replica's pop), a second requeue would
+            # admit one stream into two slots. The owner field is written
+            # and read under the queue lock, so this cannot race a
+            # sibling's pop the way a membership check would.
+            drained += self._drain_stream(admitting, exc)
+        for i, stream in enumerate(self._slots):
+            if stream is None:
+                continue
+            self._slots[i] = None
+            if stream is admitting:
+                # the fault landed between slot assignment and the
+                # _admitting clear: the stream is in BOTH places — drain
+                # it once, or two admissions would share one handle and
+                # one block table (duplicated, corrupted output)
+                continue
+            drained += self._drain_stream(stream, exc)
+        # a fault between a donating program call and pool.update leaves
+        # deleted pool buffers; every stream re-prefills anyway, so just
+        # re-materialize the storage
+        self.pool.ensure_storage()
+        # ... and one landing inside an alloc/free can tear the free-list
+        # (blocks in neither a table nor the list): rebuild it as the
+        # complement of the surviving tables
+        self.pool.reconcile()
+        _telem.inc("serve.recoveries")
+        _flight.note_event("serve_recover", "%s: %s (requeued %d)"
+                           % (self.name, type(exc).__name__, drained))
+        return drained
+
+    def run(self, max_steps=None, stop=None):
+        """Drive the scheduler: until idle (stop=None — the batch-drain
+        mode tests and benches use), or until `stop` (an Event) is set —
+        the long-lived replica-thread mode, parking on the queue when
+        idle. Retriable faults drain-and-continue up to `max_restarts`;
+        past the budget the replica re-raises (marked `dead`) with its
+        streams already requeued for the survivors."""
+        steps = 0
+        t0 = time.perf_counter()
+        tokens0 = _telem.registry.counter("serve.tokens").value
+        try:
+            while stop is None or not stop.is_set():
+                try:
+                    busy = self.step()
+                except RetriableError as exc:
+                    self._recover(exc)
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        self.dead = True
+                        _telem.inc("serve.replica_deaths")
+                        raise
+                    continue
+                except Exception as exc:
+                    # a NON-retriable escape (device loss surfacing as a
+                    # runtime error, a programming bug) still must not
+                    # strand in-flight streams: drain them to the queue
+                    # for the survivors, then die
+                    self._recover(exc)
+                    self.dead = True
+                    _telem.inc("serve.replica_deaths")
+                    raise
+                steps += 1
+                if max_steps is not None and steps >= max_steps:
+                    break
+                if not busy:
+                    if stop is None:
+                        break
+                    self.queue.wait_nonempty(timeout=0.05)
+        finally:
+            dt = time.perf_counter() - t0
+            if dt > 0:
+                tokens = (_telem.registry.counter("serve.tokens").value
+                          - tokens0)
+                _telem.set_gauge("serve.tokens_per_s", round(tokens / dt, 2))
+        return steps

@@ -40,6 +40,9 @@ def _merge_hist(a, b):
             "min": min(mins) if mins else None,
             "max": max(maxs) if maxs else None,
             "avg": (total / count) if count else None,
+            # same-named histograms share bounds across ranks; keep them
+            # so quantile estimation stays exact-edged post-merge
+            "bounds": a.get("bounds") or b.get("bounds"),
             "buckets": buckets}
 
 

@@ -35,9 +35,10 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 __all__ = ["prometheus_text", "snapshot_payload", "parse_prometheus_text",
-           "start_http_server", "stop_http_server", "start_stream",
-           "stop_stream", "maybe_start_from_env", "MetricsServer",
-           "SnapshotStreamer", "default_stream_interval_s"]
+           "histogram_quantiles", "start_http_server", "stop_http_server",
+           "start_stream", "stop_stream", "maybe_start_from_env",
+           "MetricsServer", "SnapshotStreamer",
+           "default_stream_interval_s"]
 
 _LOG = logging.getLogger("mxnet_tpu.telemetry")
 
@@ -77,6 +78,76 @@ def _hist_bounds(buckets):
         raw = k[len("le_"):]
         return float("inf") if raw == "inf" else float(raw)
     return sorted(buckets, key=key)
+
+
+# quantiles derived for every histogram by the exporter; the rolling
+# step-time windows (telemetry.anomaly) stay the EXACT source for step
+# sites — this is the estimate that covers everything else a scraper sees
+_QUANTILES = (0.5, 0.99)
+
+
+def histogram_quantiles(h, qs=_QUANTILES):
+    """Estimate quantiles from a histogram snapshot's sparse buckets.
+
+    NOTE the input format: `Histogram.snapshot()` emits PER-BUCKET counts
+    (non-cumulative; empty buckets omitted) — not the cumulative `le`
+    series `/metrics` renders. The math is `prometheus
+    histogram_quantile` semantics on top of that (linear interpolation
+    inside the bucket holding the target rank; the overflow bucket
+    answers with the observed max), sharpened with the snapshot's exact
+    min/max where the registry recorded them. Returns
+    {"p50": ..., "p99": ...} or None for an empty histogram.
+
+    Before this, scrapers got exact p50/p99 only for the step sites whose
+    rolling windows `telemetry.anomaly` keeps; every other histogram
+    (ckpt.save_ms, serve.ttft_ms, compile_ms, ...) exported buckets and
+    left the tail math to the server. Now the quantiles ride the scrape
+    itself (`/metrics` gauge series, `/snapshot`/stream payloads)."""
+    count = h.get("count") or 0
+    if not count:
+        return None
+    buckets = h.get("buckets", {})
+    # densify over the histogram's full bound list when the snapshot
+    # carries it: the rank-holding bucket's true lower edge may be an
+    # EMPTY (hence omitted) bucket's bound — interpolating from the
+    # previous present bound instead would pull the estimate below every
+    # observation in the bucket
+    bounds = h.get("bounds")
+    if bounds:
+        items = [(float(b), buckets.get("le_%g" % b, 0)) for b in bounds]
+        items.append((float("inf"), buckets.get("le_inf", 0)))
+    else:  # legacy dump without bounds: present buckets only
+        items = []
+        for k in _hist_bounds(buckets):
+            raw = k[len("le_"):]
+            bound = float("inf") if raw == "inf" else float(raw)
+            items.append((bound, buckets[k]))
+    mn, mx = h.get("min"), h.get("max")
+    out = {}
+    for q in qs:
+        target = q * count
+        cum = 0
+        lower = 0.0
+        val = None
+        for bound, n in items:
+            if cum + n >= target:
+                if bound == float("inf"):
+                    val = mx
+                else:
+                    val = lower + (bound - lower) * (target - cum) / n
+                break
+            cum += n
+            if bound != float("inf"):
+                lower = bound
+        if val is None:
+            val = mx
+        if val is not None:
+            if mn is not None:
+                val = max(val, mn)
+            if mx is not None:
+                val = min(val, mx)
+        out["p%g" % (q * 100)] = val
+    return out
 
 
 def prometheus_text(snap=None, rank=None):
@@ -121,6 +192,15 @@ def prometheus_text(snap=None, rank=None):
         lines.append("%s_sum%s %s" % (san, label, _fmt_value(h.get("sum"))))
         lines.append("%s_count%s %s" % (san, label,
                                         _fmt_value(h.get("count"))))
+        # derived quantiles as gauge series (<name>_p50/<name>_p99): the
+        # sparse buckets stay authoritative; these save every scraper the
+        # histogram_quantile() reimplementation and carry the exact
+        # min/max clamp the raw buckets cannot express
+        quants = histogram_quantiles(h)
+        for key, value in sorted((quants or {}).items()):
+            lines.append("# TYPE %s_%s gauge" % (san, key))
+            lines.append("%s_%s%s %s" % (san, key, label,
+                                         _fmt_value(value)))
     return "\n".join(lines) + "\n"
 
 
@@ -154,12 +234,19 @@ def snapshot_payload():
     identity (rank, trace id) and rolling step-latency quantiles."""
     telem = _telem()
     from . import anomaly
+    snap = telem.snapshot()
+    hist_q = {}
+    for name, h in snap.get("histograms", {}).items():
+        q = histogram_quantiles(h)
+        if q:
+            hist_q[name] = q
     return {
         "ts": time.time(),
         "rank": telem.safe_rank(),
         "trace_id": telem.trace_id(),
-        "snapshot": telem.snapshot(),
+        "snapshot": snap,
         "step_quantiles": anomaly.quantiles_all(),
+        "hist_quantiles": hist_q,
         "flight_steps": len(_flight_recorder()),
     }
 

@@ -130,9 +130,14 @@ class Histogram:
                     buckets["le_%g" % bound] = n
             if self._buckets[-1]:
                 buckets["le_inf"] = self._buckets[-1]
+            # bounds travel with the sparse buckets: a quantile estimator
+            # needs the rank-holding bucket's TRUE lower edge, which the
+            # present-buckets dict alone cannot name when the bucket
+            # below it is empty (and therefore omitted)
             return {"count": self._count, "sum": self._sum,
                     "min": self._min, "max": self._max,
                     "avg": (self._sum / self._count) if self._count else None,
+                    "bounds": list(self.bounds),
                     "buckets": buckets}
 
 

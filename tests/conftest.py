@@ -96,3 +96,4 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running (full-size model zoo / multi-process)")
     config.addinivalue_line("markers", "lint: tracelint self-check (mx.analysis over mxnet_tpu/; run alone with -m lint)")
     config.addinivalue_line("markers", "obs: observability endpoint tests (live /metrics HTTP server on localhost)")
+    config.addinivalue_line("markers", "serve: serving-engine tests (continuous batching, paged KV cache, replica supervision)")

@@ -87,6 +87,62 @@ def test_prometheus_text_gauges_and_histograms():
     assert 'mxnet_tpu_t_lat_ms_sum{rank="0"} 302.5' in text
 
 
+def test_histogram_quantiles_interpolation():
+    """Telemetry-v2 follow-on: p50/p99 derived from the sparse cumulative
+    buckets (prometheus histogram_quantile semantics + exact min/max
+    clamp) for ALL histograms, not just the rolling step windows."""
+    from mxnet_tpu.telemetry.metrics import Histogram
+    h = Histogram("t", bounds=(1, 2, 4, 8, 16))
+    for v in (0.5, 1.5, 1.7, 3, 3, 3, 5, 6, 7, 12):
+        h.observe(v)
+    q = export.histogram_quantiles(h.snapshot())
+    # p50: rank 5 lands in (2,4] with 3 before it -> 2 + 2*(5-3)/3
+    assert abs(q["p50"] - (2 + 2 * (5 - 3) / 3)) < 1e-9
+    # p99: interpolation says 15.2 inside (8,16]; exact max clamps to 12
+    assert q["p99"] == 12
+    # overflow bucket answers with the observed max
+    h2 = Histogram("o", bounds=(1,))
+    for v in (5.0, 9.0):
+        h2.observe(v)
+    assert export.histogram_quantiles(h2.snapshot())["p99"] == 9.0
+    assert export.histogram_quantiles(Histogram("e").snapshot()) is None
+    # the rank-holding bucket's TRUE lower edge holds even when the
+    # buckets below it are empty (omitted from the sparse snapshot):
+    # 1 obs at 0.5 and 9 at 15.0 -> p50 lives in (8,16], never below 8
+    h3 = Histogram("s", bounds=(1, 2, 4, 8, 16))
+    h3.observe(0.5)
+    for _ in range(9):
+        h3.observe(15.0)
+    q3 = export.histogram_quantiles(h3.snapshot())
+    assert q3["p50"] == pytest.approx(8 + 8 * (5 - 1) / 9)
+    assert q3["p50"] >= 8
+
+
+def test_prometheus_text_emits_quantile_series():
+    _seed_metrics()
+    text = export.prometheus_text(rank=0)
+    assert "# TYPE mxnet_tpu_t_lat_ms_p50 gauge" in text
+    p50 = [l for l in text.splitlines()
+           if l.startswith('mxnet_tpu_t_lat_ms_p50{rank="0"}')]
+    p99 = [l for l in text.splitlines()
+           if l.startswith('mxnet_tpu_t_lat_ms_p99{rank="0"}')]
+    assert len(p50) == 1 and len(p99) == 1
+    snap_h = telemetry.snapshot()["histograms"]["t.lat_ms"]
+    q = export.histogram_quantiles(snap_h)
+    assert float(p50[0].rsplit(" ", 1)[1]) == pytest.approx(q["p50"])
+    assert float(p99[0].rsplit(" ", 1)[1]) == pytest.approx(q["p99"])
+    # the quantile gauges must not confuse the counter round-trip
+    assert export.parse_prometheus_text(text) == \
+        telemetry.snapshot()["counters"]
+
+
+def test_snapshot_payload_hist_quantiles():
+    _seed_metrics()
+    payload = export.snapshot_payload()
+    assert "t.lat_ms" in payload["hist_quantiles"]
+    assert set(payload["hist_quantiles"]["t.lat_ms"]) == {"p50", "p99"}
+
+
 # ===========================================================================
 # live endpoint
 # ===========================================================================
@@ -794,6 +850,39 @@ def test_parse_log_anomalies_mode(tmp_path):
     assert "step_time,count,1" in r.stdout
     assert "step_time.trainer,count,1" in r.stdout
     assert "trainer.step_ms,max_ms,999" in r.stdout
+
+
+def test_parse_log_serve_mode(tmp_path):
+    """`parse_log.py --serve`: tokens/s, ttft/tpot quantiles, pressure
+    gauges, and shed counts from a telemetry dump (ISSUE 8 CI satellite)."""
+    telemetry.inc("serve.requests", 10)
+    telemetry.inc("serve.completed", 8)
+    telemetry.inc("serve.tokens", 64)
+    telemetry.inc("serve.shed", 2)
+    telemetry.inc("serve.shed.queue_full", 2)
+    telemetry.set_gauge("serve.tokens_per_s", 123.4)
+    telemetry.set_gauge("serve.queue_depth", 0)
+    telemetry.set_gauge("serve.queue_depth", 3)
+    telemetry.set_gauge("serve.queue_depth", 0)
+    for ms in (5.0, 6.0, 50.0):
+        telemetry.observe("serve.ttft_ms", ms)
+        telemetry.observe("serve.tpot_ms", ms / 10)
+    dump = str(tmp_path / "serve.json")
+    telemetry.dump(dump)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         dump, "--serve", "--format", "csv"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "metric,value"
+    rows = dict(l.rsplit(",", 1) for l in lines[1:])
+    assert rows["tokens_per_s"] == "123.4"
+    assert rows["requests"] == "10"
+    assert rows["shed"] == "2" and rows["shed.queue_full"] == "2"
+    assert rows["queue_depth_peak"] == "3"
+    assert float(rows["ttft_ms_p50"]) > 0
+    assert float(rows["tpot_ms_p99"]) > 0
 
 
 def test_mxtop_once_from_stream(tmp_path):

@@ -220,3 +220,54 @@ def test_sharded_train_step_grad_accum():
         y = (x @ w_true)[:, None]
         p, s, loss = step(p, s, (jnp.asarray(x), jnp.asarray(y)), i)
     np.testing.assert_allclose(np.asarray(p["w"]), w_true, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# elastic place() buffer donation (resilience-v2 follow-on: grow-back
+# re-layout must peak at max(old, new) + one leaf, not old + new)
+# ---------------------------------------------------------------------------
+def test_reshard_pytree_donate_deletes_sources():
+    from mxnet_tpu.parallel.sharding import LLAMA_RULES, reshard_pytree
+    mesh = par.local_mesh(4, axis="data")
+    params = {"layers": {"0": {"mlp": {"w1": jnp.ones((8, 16))}}},
+              "norm": jnp.arange(8.0)}
+    sources = jax.tree_util.tree_leaves(params)
+    expect = [np.asarray(x) for x in sources]
+    out = reshard_pytree(params, LLAMA_RULES, mesh, donate=True)
+    assert all(x.is_deleted() for x in sources)
+    for got, want in zip(jax.tree_util.tree_leaves(out), expect):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    # default stays non-destructive
+    params2 = {"w": jnp.arange(6.0)}
+    src2 = jax.tree_util.tree_leaves(params2)
+    reshard_pytree(params2, LLAMA_RULES, mesh)
+    assert not any(x.is_deleted() for x in src2)
+
+
+def test_place_donates_and_step_continues():
+    """place() consumes its inputs by default (the relayout adapters drop
+    them immediately); the re-laid state must be bit-identical and the
+    rebuilt step must run on it."""
+    mesh = par.local_mesh(2, axis="data")
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch @ params["w"]) ** 2)
+
+    step = par.ShardedTrainStep(loss_fn, {"w": jnp.ones((4,))}, mesh,
+                                optimizer="adam", lr=0.01)
+    p, s = step.init()
+    p, s, _ = step(p, s, jnp.ones((4, 4)), 0)
+    expect_w = np.asarray(p["w"])
+    old_leaves = jax.tree_util.tree_leaves((p, s))
+    rebuilt = step.rebuild_for_mesh(par.local_mesh(4, axis="data"))
+    p2, s2 = rebuilt.place(p, s)
+    assert all(x.is_deleted() for x in old_leaves)
+    np.testing.assert_array_equal(np.asarray(p2["w"]), expect_w)
+    # optimizer-state scalars survived the donated move
+    assert int(s2["t"]) == 1
+    p3, s3, loss = rebuilt(p2, s2, jnp.ones((8, 4)), 1)
+    assert np.isfinite(float(loss))
+    # opt-out keeps sources alive (A/B comparisons)
+    keep = jax.tree_util.tree_leaves((p3, s3))
+    rebuilt.place(p3, s3, donate=False)
+    assert not any(x.is_deleted() for x in keep)

@@ -265,6 +265,115 @@ def _print_anomalies(rows, fmt):
         print(line % r)
 
 
+def _hist_quantile(h, q):
+    """Quantile estimate from a snapshot histogram's sparse PER-BUCKET
+    counts (non-cumulative — `Histogram.snapshot()` format, not the
+    cumulative `le` series of a Prometheus scrape). Stdlib re-derivation
+    of telemetry.export.histogram_quantiles — this tool must run without
+    mxnet_tpu importable."""
+    count = h.get("count") or 0
+    if not count:
+        return None
+    buckets = h.get("buckets", {})
+    bounds = h.get("bounds")
+    if bounds:
+        # densify: an empty (omitted) bucket's bound can be the true
+        # lower edge of the rank-holding bucket
+        items = [(float(b), buckets.get("le_%g" % b, 0)) for b in bounds]
+        items.append((float("inf"), buckets.get("le_inf", 0)))
+    else:  # legacy dump without bounds
+        items = []
+        for key, n in buckets.items():
+            raw = key[len("le_"):]
+            items.append((float("inf") if raw == "inf" else float(raw), n))
+        items.sort()
+    target = q * count
+    cum = 0
+    lower = 0.0
+    val = None
+    for bound, n in items:
+        if cum + n >= target:
+            val = (h.get("max") if bound == float("inf")
+                   else lower + (bound - lower) * (target - cum) / n)
+            break
+        cum += n
+        if bound != float("inf"):
+            lower = bound
+    if val is None:
+        val = h.get("max")
+    if val is None:
+        return None
+    if h.get("min") is not None:
+        val = max(val, h["min"])
+    if h.get("max") is not None:
+        val = min(val, h["max"])
+    return round(val, 3)
+
+
+# the serving headline, in client-experience order: traffic in, latency
+# felt, pressure and shedding, recovery churn
+_SERVE_COUNTERS = ("requests", "admitted", "completed", "tokens",
+                   "prefills", "decode_steps", "shed", "failed",
+                   "recoveries", "requeued_streams", "compile", "retrace")
+
+
+def parse_serve(obj):
+    """Extract the serving story from a telemetry snapshot: serve.*
+    counters, TTFT/TPOT quantiles derived from the latency histograms,
+    and the pressure gauges (queue depth, batch occupancy, KV-pool
+    blocks). Returns [(metric, value)] rows."""
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    counters = obj.get("counters", {})
+    gauges = obj.get("gauges", {})
+    hists = obj.get("histograms", {})
+    rows = []
+    tps = gauges.get("serve.tokens_per_s")
+    if tps is not None:
+        rows.append(("tokens_per_s", tps.get("value")))
+    for name in _SERVE_COUNTERS:
+        key = "serve.%s" % name
+        if key in counters:
+            rows.append((name, counters[key]))
+        prefix = key + "."
+        for sub in sorted(counters):
+            if sub.startswith(prefix):
+                rows.append((sub[len("serve."):], counters[sub]))
+    for hname, label in (("serve.ttft_ms", "ttft_ms"),
+                         ("serve.tpot_ms", "tpot_ms"),
+                         ("serve.step_ms", "step_ms"),
+                         ("serve.prefill_ms", "prefill_ms")):
+        h = hists.get(hname)
+        if h:
+            rows.append((label + "_p50", _hist_quantile(h, 0.50)))
+            rows.append((label + "_p99", _hist_quantile(h, 0.99)))
+    for gname, label in (("serve.queue_depth", "queue_depth"),
+                         ("serve.batch_occupancy", "batch_occupancy"),
+                         ("serve.kv.blocks_in_use", "kv_blocks_in_use"),
+                         ("serve.replicas_alive", "replicas_alive")):
+        g = gauges.get(gname)
+        if g is not None:
+            rows.append((label, g.get("value")))
+            rows.append((label + "_peak", g.get("max")))
+    return rows
+
+
+def _print_serve(rows, fmt):
+    if not rows:
+        print("no serve.* metrics in this dump (no serving ran, or "
+              "telemetry disabled)", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| metric | value |")
+        print("| --- | --- |")
+        line = "| %s | %s |"
+    else:
+        print("metric,value")
+        line = "%s,%s"
+    for r in rows:
+        print(line % r)
+
+
 # severity ordering for the lint table: errors first, then by location
 _LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
 
@@ -357,6 +466,10 @@ def main():
                         help="flight-recorder mode: per-step table from a "
                              "telemetry.flight.dump() JSON file — the last "
                              "N steps before a crash")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving mode: tokens/s, ttft/tpot quantiles, "
+                             "queue/batch/KV pressure, shed and recovery "
+                             "counts from a telemetry JSON dump")
     parser.add_argument("--anomalies", action="store_true",
                         help="anomaly mode: telemetry.anomaly.* counters + "
                              "step-time histograms from a telemetry JSON "
@@ -364,6 +477,11 @@ def main():
                              "or SLO?")
     args = parser.parse_args()
     obj = _load_json(args.logfile)
+    if args.serve:
+        if obj is None:
+            sys.exit("--serve input is not a JSON object: %s" % args.logfile)
+        _print_serve(parse_serve(obj), args.format)
+        return
     if args.flight:
         if obj is None:
             sys.exit("--flight input is not a JSON object: %s"

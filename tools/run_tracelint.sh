@@ -42,5 +42,8 @@ else
     set -- --cache "$@"
 fi
 # tools/mxtop.py rides along: the dashboard spawns no traces itself but
-# shares the telemetry thread model the TPU006 rule audits
+# shares the telemetry thread model the TPU006 rule audits. The package
+# root covers mxnet_tpu/serve/ too — the serving scheduler/replica
+# threads are TPU006-clean with zero suppressions (tests/test_serve.py
+# asserts it under the lint marker).
 exec python -m mxnet_tpu.analysis mxnet_tpu tools/mxtop.py --fail-on=error "$@"
