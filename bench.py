@@ -484,6 +484,76 @@ def bench_comm(on_accel):
     return payload
 
 
+def bench_zero(on_accel):
+    """BENCH=zero: ZeRO-1 weight-update sharding microbench. A
+    resnet18-shaped parameter set (62 tensors, ~11.7M params) trains
+    through the kvstore with the optimizer ON the store, first as the
+    ZeRO sharded updater (reduce-scatter → one fused flat shard update per
+    dtype-bucket → all-gather), then as the replicated per-parameter
+    updater for the vs_baseline ratio. The JSON row carries the ledger
+    that grades a ZeRO implementation: `opt_state_bytes_per_rank`
+    (sharded-state footprint — divide `opt_state_bytes_replicated` by the
+    world size and you should land here), `collectives_per_step`, and
+    `fused_update_ms` (mean host wall time of the fused shard dispatch).
+
+    Single-process rows run at world=1 (the comm legs are identity), so
+    the number that moves OFF-chip is dispatch count: 62 per-param
+    optimizer launches collapse into one fused launch per bucket."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, telemetry
+
+    shapes = resnet18_grad_shapes()
+    steps = 20 if on_accel else 5
+    rng = _np.random.RandomState(0)
+    grads = [nd.array(rng.randn(*s).astype(_np.float32)) for s in shapes]
+    nbytes = sum(g.size * 4 for g in grads)
+
+    def run(zero):
+        kv = mx.kv.create("device")
+        kv.set_optimizer(mx.optimizer.create(
+            "sgd", learning_rate=0.1, momentum=0.9, rescale_grad=1.0),
+            zero=zero)
+        keys = list(range(len(shapes)))
+        for k, s in zip(keys, shapes):
+            kv.init(k, nd.array(rng.randn(*s).astype(_np.float32)))
+        kv.push(keys, grads)  # warm the fused programs + freeze the layout
+        _sync(kv._store["0"].data_jax)
+        telemetry.reset()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            kv.push(keys, grads)
+        _sync(kv._store["0"].data_jax)
+        dt = (time.perf_counter() - t0) / steps
+        snap = telemetry.snapshot()
+        return dt, snap
+
+    dt_zero, snap = run(True)
+    dt_repl, _ = run(False)
+    counters = snap["counters"]
+    hist = snap["histograms"].get("opt.fused_update_ms", {})
+    state_bytes = snap["gauges"].get(
+        "opt.state_bytes_per_rank", {}).get("value", 0)
+    world = 1  # single-process bench; dist rows come from tools/launch.py
+    return {
+        "metric": ("zero_update_mb_per_sec" if on_accel
+                   else "zero_update_cpu_mb_per_sec"),
+        "value": round(nbytes / 1e6 / dt_zero, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(dt_repl / dt_zero, 4),  # speedup vs replicated
+        "params_per_step": len(shapes),
+        "world": world,
+        "opt_state_bytes_per_rank": int(state_bytes),
+        "opt_state_bytes_replicated": int(state_bytes) * world,
+        "collectives_per_step": counters.get("comm.collectives", 0) // steps,
+        "reduce_scatter_per_step":
+            counters.get("comm.reduce_scatter", 0) // steps,
+        "all_gather_per_step": counters.get("comm.all_gather", 0) // steps,
+        "fused_updates_per_step": hist.get("count", 0) // steps,
+        "fused_update_ms": round(hist.get("sum", 0.0)
+                                 / max(1, hist.get("count", 0)), 4),
+    }
+
+
 def bench_resilience(on_accel):
     """BENCH=resilience: recovery-path microbench for the resilience v2
     stack. A small Gluon MLP trains under `ResilientRunner` while the
@@ -813,6 +883,9 @@ def main():
         return
     if which == "comm":
         _emit(bench_comm(on_accel))
+        return
+    if which == "zero":
+        _emit(bench_zero(on_accel))
         return
     if which == "resilience":
         _emit(bench_resilience(on_accel))

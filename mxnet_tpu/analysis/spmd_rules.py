@@ -36,6 +36,7 @@ _COLLECTIVE_NAMES = frozenset({
     "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
     "reduce_scatter", "ppermute", "pshuffle", "all_to_all",
     "all_reduce", "psum_bucketed", "all_reduce_multi", "barrier",
+    "reduce_scatter_multi", "all_gather_multi",
 })
 
 # everything whose axis_name argument must resolve against a declared
@@ -48,6 +49,8 @@ _AXIS_ARG_POS = {
     "axis_index": 0,
     "all_reduce_multi": 2,
     "psum_bucketed": 1,
+    "reduce_scatter_multi": 1,   # (xs, axis_name, ...)
+    "all_gather_multi": 2,       # (shards, layout, axis_name)
 }
 _AXIS_KWARGS = ("axis_name", "axis")
 _DEFAULT_AXIS_POS = 1   # psum(x, axis_name), all_gather(x, axis_name), ...

@@ -27,6 +27,12 @@ segments for the gradient path:
   knob): a concat/split identity that hands XLA one fused flat tensor per
   bucket, so cross-replica grad reductions combine bucket-wise instead of
   per-leaf.
+* `BucketLayout`/`BucketSpec` freeze a bucketing run into a PERSISTENT,
+  checkpointable bucket→key layout — the unit of ZeRO-1 weight-update
+  sharding (`optimizer.zero`): each bucket is the reduce-scatter segment,
+  its flat size padded to a world-size multiple so every rank owns one
+  contiguous equal shard (`pack_flat`/`unpack_flat` are the jitted
+  concat+pad / split inverses).
 
 Telemetry: every flushed bucket counts `comm.bucket.count`,
 `comm.bucket.bytes` and `comm.bucket.flush_reason.<reason>`; empty grads
@@ -51,7 +57,8 @@ import jax.numpy as jnp
 __all__ = ["bulk", "set_bulk_size", "DEFAULT_BUCKET_MB", "bucket_bytes",
            "set_bucket_mb", "bucket_mb_scope", "Bucket", "GradBucketer",
            "bucketize", "fused_bucket_fn", "pack_bucket", "unpack_bucket",
-           "reassociate_bucketed"]
+           "reassociate_bucketed", "BucketSpec", "BucketLayout",
+           "pack_flat", "unpack_flat"]
 
 _BULK_SIZE = 15  # the reference default (MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN)
 
@@ -305,6 +312,198 @@ def unpack_bucket(bucket, flat):
     """One jitted split of a flat vector back to the bucket's shapes."""
     return fused_bucket_fn("unpack", _identity, bucket.shapes,
                            bucket.dtype)(flat)
+
+
+# ---------------------------------------------------------------------------
+# persistent bucket layout — the unit of ZeRO weight-update sharding
+# ---------------------------------------------------------------------------
+class BucketSpec:
+    """One bucket of a frozen `BucketLayout`: the static shape of a comm
+    segment (no array payloads). `padded` is the flat element count rounded
+    up to the next multiple of the layout's world size, so the bucket
+    reduce-scatters into `world` equal contiguous shards of `shard`
+    elements each (the zero-fill rides inside the fused pack program, the
+    same trick as `all_reduce_multi`'s odd-leading-dim padding)."""
+
+    __slots__ = ("index", "keys", "shapes", "dtype", "sizes", "size",
+                 "padded", "shard")
+
+    def __init__(self, index, keys, shapes, dtype, world):
+        self.index = int(index)
+        self.keys = [str(k) for k in keys]
+        self.shapes = [tuple(int(d) for d in s) for s in shapes]
+        self.dtype = _np.dtype(dtype)
+        self.sizes = [int(_np.prod(s, dtype=_np.int64)) for s in self.shapes]
+        self.size = int(sum(self.sizes))
+        world = max(1, int(world))
+        self.padded = (self.size + world - 1) // world * world
+        self.shard = self.padded // world
+
+    def __len__(self):
+        return len(self.keys)
+
+    def key_range(self):
+        if len(self.keys) == 1:
+            return str(self.keys[0])
+        return "%s..%s" % (self.keys[0], self.keys[-1])
+
+    def nbytes(self):
+        return self.size * self.dtype.itemsize
+
+    def shard_nbytes(self):
+        return self.shard * self.dtype.itemsize
+
+    def segments(self):
+        """[(key, offset, size, shape)] over the unpadded flat vector."""
+        out, off = [], 0
+        for k, n, s in zip(self.keys, self.sizes, self.shapes):
+            out.append((k, off, n, s))
+            off += n
+        return out
+
+    def shard_segments(self, rank):
+        """The pieces of `rank`'s shard, as (key, start_in_shard,
+        length, start_in_key) — the map a per-parameter quantity (lr/wd
+        multipliers) needs to land on the owned flat shard. Padding tail
+        elements belong to no key and are simply absent."""
+        lo, hi = rank * self.shard, (rank + 1) * self.shard
+        out = []
+        for k, off, n, _ in self.segments():
+            s, e = max(off, lo), min(off + n, hi)
+            if s < e:
+                out.append((k, s - lo, e - s, s - off))
+        return out
+
+    def __repr__(self):
+        return ("BucketSpec(#%d keys=[%s] %d elems pad=%d shard=%d %s)"
+                % (self.index, self.key_range(), self.size, self.padded,
+                   self.shard, self.dtype))
+
+
+class BucketLayout:
+    """Persistent bucket→key layout: frozen after the first flush,
+    checkpointable, the contract between gradient reduce-scatter, the
+    sharded optimizer state, and the weight all-gather. Once frozen the
+    SAME layout must describe every subsequent step — owned shards,
+    per-bucket residuals, and checkpoints all key on bucket indices, so a
+    drifting membership would silently corrupt state. `assert_matches`
+    enforces that."""
+
+    VERSION = 1
+
+    def __init__(self, buckets, world):
+        self.world = max(1, int(world))
+        self.buckets = list(buckets)
+
+    @classmethod
+    def from_entries(cls, entries, world, cap_bytes=None):
+        """Freeze a layout from (key, array) pairs by running them through
+        the standard `GradBucketer` packing (same caps, same dtype splits,
+        same oversize rules as the allreduce path)."""
+        buckets = []
+        for i, b in enumerate(bucketize(entries, cap_bytes)):
+            buckets.append(BucketSpec(i, b.keys, b.shapes, b.dtype, world))
+        return cls(buckets, world)
+
+    def __len__(self):
+        return len(self.buckets)
+
+    def __iter__(self):
+        return iter(self.buckets)
+
+    def keys(self):
+        out = []
+        for b in self.buckets:
+            out.extend(b.keys)
+        return out
+
+    def assert_matches(self, keys):
+        """The frozen-layout guard: every step after the first must feed
+        the exact key sequence the layout was frozen from."""
+        keys = [str(k) for k in keys]
+        if keys != self.keys():
+            raise ValueError(
+                "bucket layout is frozen: step fed keys %s but the layout "
+                "holds %s — a changed parameter set needs a new layout "
+                "(and fresh sharded optimizer state)" % (keys, self.keys()))
+
+    def total_nbytes(self):
+        return sum(b.nbytes() for b in self.buckets)
+
+    def to_payload(self):
+        """JSON-able dict — checkpointed next to the sharded state so a
+        restore (possibly onto a different world size) can re-derive every
+        shard boundary without replaying a bucketing pass."""
+        return {
+            "version": self.VERSION,
+            "world": self.world,
+            "buckets": [{"keys": list(b.keys),
+                         "shapes": [list(s) for s in b.shapes],
+                         "dtype": str(b.dtype)} for b in self.buckets],
+        }
+
+    @classmethod
+    def from_payload(cls, payload, world=None):
+        """Rebuild from `to_payload` output; `world` overrides the saved
+        world size (the elastic-restore path: same buckets, new shard
+        boundaries)."""
+        if int(payload.get("version", -1)) != cls.VERSION:
+            raise ValueError("unsupported bucket-layout payload version %r"
+                             % (payload.get("version"),))
+        world = payload["world"] if world is None else world
+        buckets = [BucketSpec(i, b["keys"], b["shapes"], b["dtype"], world)
+                   for i, b in enumerate(payload["buckets"])]
+        return cls(buckets, world)
+
+    def rebuild_for_world(self, world):
+        """Same buckets, re-partitioned for a different world size — the
+        elastic shrink/grow primitive."""
+        return BucketLayout.from_payload(self.to_payload(), world=world)
+
+    def __repr__(self):
+        return ("BucketLayout(%d buckets, %d keys, world=%d, %dB)"
+                % (len(self.buckets), len(self.keys()), self.world,
+                   self.total_nbytes()))
+
+
+def pack_flat(spec, raws):
+    """ONE jitted concat(+zero-pad to `spec.padded`) of a bucket's raveled
+    arrays — the reduce-scatter-ready flat vector. Traceable: also usable
+    inside shard_map'd code (the cache key is static)."""
+    key = ("pack_pad", tuple(spec.shapes), str(spec.dtype), spec.padded)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        pad = spec.padded - spec.size
+        dtype = jnp.dtype(spec.dtype)
+
+        def run(*rs):
+            parts = [r.reshape(-1) for r in rs]
+            if pad:
+                parts.append(jnp.zeros((pad,), dtype))
+            return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+        fn = jax.jit(run)
+        _FUSED_CACHE[key] = fn
+    return fn(*raws)
+
+
+def unpack_flat(spec, flat):
+    """ONE jitted split of a padded flat vector back to the bucket's
+    shapes (the padding tail is dropped)."""
+    key = ("unpack_pad", tuple(spec.shapes), str(spec.dtype), spec.padded)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        splits = list(_np.cumsum(spec.sizes)[:-1])
+        shapes = spec.shapes
+
+        def run(f):
+            f = f[:sum(spec.sizes)]
+            parts = jnp.split(f, splits) if splits else [f]
+            return tuple(p.reshape(s) for p, s in zip(parts, shapes))
+
+        fn = jax.jit(run)
+        _FUSED_CACHE[key] = fn
+    return fn(flat)
 
 
 def reassociate_bucketed(raws, bucket_mb=None):

@@ -27,7 +27,7 @@ class Trainer:
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, zero=None):
         param_list = []
         if isinstance(params, (dict, ParameterDict)):
             for key in sorted(list(params.keys())):
@@ -47,6 +47,20 @@ class Trainer:
             self._param2idx[param.name] = i
             self._params.append(param)
             param._set_trainer(self) if hasattr(param, "_set_trainer") else None
+        # ZeRO-1 weight-update sharding (opt-in: zero=True or
+        # MXNET_TPU_ZERO=1): the optimizer runs ON the kvstore as a
+        # sharded ZeroUpdater — reduce-scattered grads, per-rank optimizer
+        # state, all-gathered weights (the update_on_kvstore analog)
+        self._zero = opt.zero_enabled(zero)
+        if self._zero:
+            if not kvstore:
+                raise ValueError(
+                    "zero=True needs a kvstore (the sharded update runs on "
+                    "the store); got kvstore=%r" % (kvstore,))
+            if update_on_kvstore is False:
+                raise ValueError(
+                    "zero=True updates ON the kvstore; "
+                    "update_on_kvstore=False contradicts it")
         self._compression_params = compression_params
         optimizer_params = optimizer_params if optimizer_params else {}
         self._scale = float(optimizer_params.get("rescale_grad", 1.0))
@@ -113,6 +127,18 @@ class Trainer:
         update_on_kvstore = config["update_on_kvstore"]
         kvstore = None
         sparse_params = any(p._stype != "default" for p in self._params)
+        if self._zero:
+            if not kvstore_name:
+                raise ValueError(
+                    "zero=True needs a kvstore (the sharded update runs on "
+                    "the store); got kvstore=%r" % (kvstore_name,))
+            if update_on_kvstore is False:
+                raise ValueError(
+                    "zero=True updates ON the kvstore; "
+                    "update_on_kvstore=False contradicts it")
+            if sparse_params:
+                raise ValueError("zero=True requires dense parameters")
+            update_on_kvstore = True
         if kvstore_name:
             # single-device non-dist: aggregation is a no-op, skip the store
             # entirely (reference: _init_kvstore with one context and dense
@@ -134,7 +160,7 @@ class Trainer:
                 # reference default: update on kvstore for dist and sparse
                 update_on_kvstore = self._distributed or sparse_params
             if update_on_kvstore:
-                kvstore.set_optimizer(self._optimizer)
+                kvstore.set_optimizer(self._optimizer, zero=self._zero)
             self._kvstore = kvstore
             self._update_on_kvstore = update_on_kvstore
         else:
@@ -244,11 +270,14 @@ class Trainer:
         if not self._kvstore:
             return
         from .. import engine as _engine
-        if _engine.bucket_bytes():
+        # ZeRO always takes the multi-key path: the sharded updater needs
+        # the FULL key set per step (its bucket layout is frozen); the
+        # bucket-cap escape hatch then means one big bucket, not per-key
+        if _engine.bucket_bytes() or self._zero:
             entries = [(i, p) for i, p in enumerate(self._params)
                        if p.grad_req != "null"]
-            if len(entries) > 1 and all(p._stype == "default"
-                                        for _, p in entries):
+            if entries and (len(entries) > 1 or self._zero) and all(
+                    p._stype == "default" for _, p in entries):
                 # bucketed engine path: ONE multi-key call, gradients fed in
                 # reverse-registration order (approximating backward
                 # completion order — the last layers' grads are ready

@@ -115,13 +115,42 @@ class KVStore:
     def set_gradient_compression(self, compression_params):
         """reference: KVStore::SetGradientCompression (2bit/signum).
         Stored and applied by dist backends; local stores note it only."""
+        from ..optimizer.zero import ZeroUpdater
+        if isinstance(self._updater, ZeroUpdater):
+            raise MXNetError(
+                "gradient compression cannot be enabled on a store running "
+                "the ZeRO sharded update (no compressed reduce-scatter)")
         self._compression_params = dict(compression_params)
 
-    def set_optimizer(self, optimizer):
+    def set_optimizer(self, optimizer, zero=None):
         """Run the optimizer on the store (server-side update semantics).
         reference: kvstore.py (set_optimizer) — pickles the optimizer to
-        servers; here the updater runs wherever the merged value lives."""
-        self._set_updater(opt.get_updater(optimizer))
+        servers; here the updater runs wherever the merged value lives.
+
+        zero=True (or `MXNET_TPU_ZERO=1`) swaps the replicated Updater for
+        the ZeRO-1 `optimizer.zero.ZeroUpdater`: gradients leave the store
+        as bucket-wise reduce-scatter, optimizer state lives only for the
+        owned shards, updated weights return via all-gather (SGD/Adam
+        only; the comm backend comes from `_zero_comm` — identity on a
+        local store, cross-worker collectives on the dist store)."""
+        from ..optimizer.zero import ZeroUpdater, zero_enabled
+        if zero_enabled(zero):
+            if getattr(self, "_gc", None) is not None:
+                raise MXNetError(
+                    "ZeRO sharded update and gradient compression are "
+                    "mutually exclusive: the reduce-scatter leg has no "
+                    "compressed form (quantized partial sums break the "
+                    "error-feedback residual). Disable one of them.")
+            self._set_updater(ZeroUpdater(opt.create(optimizer),
+                                          comm=self._zero_comm()))
+        else:
+            self._set_updater(opt.get_updater(optimizer))
+
+    def _zero_comm(self):
+        """Collective backend for the ZeRO updater; the base store is
+        single-rank (identity exchanges)."""
+        from ..optimizer.zero import ZeroComm
+        return ZeroComm()
 
     def _set_updater(self, updater):
         self._updater = updater
@@ -215,6 +244,8 @@ class KVStoreLocal(KVStore):
         self._check_keys(keys)
         if _telem.ENABLED:
             _record_comm("push", values)
+        if self._maybe_push_zero(keys, values):
+            return
         cap = _engine.bucket_bytes()
         if cap and len(keys) > 1:
             entries = self._bucketable_entries(keys, values)
@@ -242,6 +273,29 @@ class KVStoreLocal(KVStore):
             else:
                 stored._write(merged.as_in_context(
                     stored.context)._read().astype(stored.dtype))
+
+    # -- ZeRO weight-update sharding path -------------------------------
+    def _maybe_push_zero(self, keys, values):
+        """Route a push through the ZeRO-1 sharded updater when one is
+        set: local replica merge per key, then ONE `ZeroUpdater.step` over
+        the full key set — reduce-scatter / fused shard update /
+        all-gather at bucket granularity, the store ending with the
+        all-gathered full weights. Returns True when handled."""
+        from ..optimizer.zero import ZeroUpdater
+        if not isinstance(self._updater, ZeroUpdater):
+            return False
+        entries = self._bucketable_entries(keys, values)
+        if entries is None:
+            raise MXNetError(
+                "ZeRO sharded update requires dense gradients with a "
+                "uniform replica count (keys %s)" % (keys,))
+        zkeys, grads, weights = [], [], []
+        for k, vals in entries:
+            zkeys.append(k)
+            grads.append(self._merge(vals)._read())
+            weights.append(self._store[k])
+        self._updater.step(zkeys, grads, weights)
+        return True
 
     # -- bucketed engine path -------------------------------------------
     def _bucketable_entries(self, keys, values):

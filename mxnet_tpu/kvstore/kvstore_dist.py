@@ -47,6 +47,39 @@ def _sum0(x):
     return jnp.sum(x, axis=0)
 
 
+def _concat0(x):
+    # (world, S) worker-sharded -> (world*S,) replicated: XLA inserts the
+    # all-gather (stable fn identity keeps the jit cache warm)
+    return x.reshape(-1)
+
+
+class DistZeroComm:
+    """Cross-worker `optimizer.zero.ZeroComm` backend: each exchange is one
+    on-device XLA program over the worker mesh (psum_scatter out, all_gather
+    back) — the ZeRO analog of `_cross_worker`'s allreduce placement."""
+
+    def __init__(self, store):
+        self._store = store
+
+    @property
+    def world(self):
+        return dist.num_workers()
+
+    @property
+    def rank(self):
+        return dist.rank()
+
+    def reduce_scatter(self, spec, flat):
+        if self.world == 1:
+            return flat
+        return self._store._cross_worker_scatter(flat)
+
+    def all_gather(self, spec, shard):
+        if self.world == 1:
+            return shard
+        return jnp.asarray(self._store._cross_worker_gather(shard))
+
+
 class GradientCompression:
     """2-bit threshold compression with error feedback and REAL bit packing.
     reference: src/kvstore/gradient_compression.cc (GradientCompression,
@@ -109,7 +142,9 @@ class KVStoreDist(KVStoreLocal):
                 "(reference parity note, SURVEY.md §2.3)")
         dist.initialize()
         self._gc = None
+        self._gc_layout = None
         self._decode_fns = {}
+        self._zero_fns = {}
 
     @property
     def rank(self):
@@ -120,11 +155,18 @@ class KVStoreDist(KVStoreLocal):
         return dist.num_workers()
 
     def set_gradient_compression(self, compression_params):
+        from ..optimizer.zero import ZeroUpdater
+        from ..base import MXNetError
+        if isinstance(self._updater, ZeroUpdater):
+            raise MXNetError(
+                "gradient compression cannot be enabled on a store running "
+                "the ZeRO sharded update (no compressed reduce-scatter)")
         params = dict(compression_params)
         ctype = params.get("type", "2bit")
         if ctype != "2bit":
             raise ValueError("unsupported compression type %s" % ctype)
         self._gc = GradientCompression(params.get("threshold", 0.5))
+        self._gc_layout = None  # residuals key on the layout; start fresh
         self._compression_params = params
         self._decode_fns.clear()  # cached decoders hold the previous gc
 
@@ -155,6 +197,44 @@ class KVStoreDist(KVStoreLocal):
         out = jax.jit(reduce_fn,
                       out_shardings=NamedSharding(mesh, P()))(garr)
         return out.addressable_data(0)
+
+    def _cross_worker_scatter(self, flat):
+        """Reduce-scatter a (world*S,)-flat local contribution across the
+        worker mesh: ONE on-device psum_scatter inside a shard_map, each
+        worker keeping only its contiguous (S,) shard of the sum — 1/world
+        of the allreduce return traffic (the ZeRO gradient leg)."""
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self._worker_mesh()
+        n = dist.num_workers()
+        key = ("scatter", int(flat.size), str(flat.dtype))
+        fn = self._zero_fns.get(key)
+        if fn is None:
+            fn = jax.jit(shard_map(
+                lambda t: lax.psum_scatter(
+                    t.reshape(-1), "worker", scatter_dimension=0,
+                    tiled=True)[None],
+                mesh=mesh, in_specs=P("worker"), out_specs=P("worker")))
+            self._zero_fns[key] = fn
+        dev = mesh.devices.ravel()[dist.rank()]
+        local = jax.device_put(jnp.asarray(flat)[None], dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (n,) + tuple(local.shape[1:]),
+            NamedSharding(mesh, P("worker")), [local])
+        return fn(garr).addressable_data(0)[0]
+
+    def _cross_worker_gather(self, shard):
+        """All-gather each worker's (S,) shard back to the full replicated
+        (world*S,) vector (the ZeRO weight-return leg) — rides the same
+        one-program `_cross_worker` placement as the allreduce."""
+        return self._cross_worker(shard, _concat0)
+
+    def _zero_comm(self):
+        return DistZeroComm(self)
 
     def _allreduce(self, raw, site="kvstore.push", context=None):
         """Sum a host-local array across all workers (replicated result) —
@@ -223,14 +303,23 @@ class KVStoreDist(KVStoreLocal):
         self._check_keys(keys)
         if _telem.ENABLED:
             _record_comm("push", values)
+        if self._maybe_push_zero(keys, values):
+            return
         cap = _engine.bucket_bytes()
-        if cap and len(keys) > 1 and self._gc is None:
-            # 2-bit compression stays per-key: its error-feedback residual
-            # is keyed state and bucket membership may shift between steps
+        if cap and len(keys) > 1:
             entries = self._bucketable_entries(keys, values)
             if entries is not None:
-                self._push_bucketed(entries, cap)
-                return
+                if self._gc is not None:
+                    # 2-bit compression rides the PERSISTENT bucket layout:
+                    # membership is frozen after the first flush, so the
+                    # error-feedback residual keys on the bucket (a shifting
+                    # membership — the reason compression used to stay
+                    # per-key — cannot happen by construction)
+                    if self._push_bucketed_compressed(entries):
+                        return
+                else:
+                    self._push_bucketed(entries, cap)
+                    return
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             k = str(k)
@@ -278,6 +367,76 @@ class KVStoreDist(KVStoreLocal):
         else:
             stored._write(merged.as_in_context(
                 stored.context)._read().astype(stored.dtype))
+
+    def _push_bucketed_compressed(self, entries):
+        """2-bit gradient compression at bucket granularity (the carried
+        compression-bucketing follow-on): the persistent `BucketLayout`
+        frozen at the first multi-key push keeps bucket membership stable
+        across steps, so the error-feedback residual keys on the BUCKET —
+        one quantize, one ceil(n/4)-byte allreduce, one fused decode+sum
+        per bucket instead of per parameter. Elementwise this is identical
+        to the per-key path (packing is a concatenation; quantization and
+        the residual are elementwise), so the two stay bit-identical.
+
+        A pushed key set that no longer matches the frozen layout (e.g. a
+        fine-tune freeze flipped a grad_req) RE-FREEZES for the new set:
+        the old buckets' accumulated residuals are dropped — a one-time,
+        loudly-warned loss of quantization error (any keying scheme loses
+        residual continuity when the key set changes) — and the bucketed
+        path continues for the new stable set. Returns True (handled)."""
+        from .. import telemetry as _telem
+        keys = [k for k, _ in entries]
+        if self._gc_layout is not None:
+            try:
+                self._gc_layout.assert_matches(keys)
+            except ValueError:
+                warnings.warn(
+                    "gradient-compression bucket layout re-frozen: the "
+                    "pushed key set changed, so the per-bucket "
+                    "error-feedback residuals accumulated so far are "
+                    "dropped (one-time quantization-error loss)")
+                for rk in [k for k in self._gc._residual
+                           if str(k).startswith("__bucket__")]:
+                    del self._gc._residual[rk]
+                self._gc_layout = None
+        merged = {k: self._merge(vals) for k, vals in entries}
+        just_frozen = False
+        if self._gc_layout is None:
+            # the bucketize pass inside from_entries ticks the
+            # comm.bucket.{count,bytes,flush_reason} counters for this
+            # step already
+            self._gc_layout = _engine.BucketLayout.from_entries(
+                ((k, merged[k]._read()) for k in keys), 1,
+                _engine.bucket_bytes())
+            just_frozen = True
+        for spec in self._gc_layout:
+            context = "bucket=[%s] %dB 2bit" % (spec.key_range(),
+                                                spec.nbytes())
+            # per-STEP bucket counters, matching _push_bucketed's
+            # accounting (steady-state stats must not diverge between the
+            # compressed and uncompressed modes); the freeze step was
+            # already counted by the bucketize pass above
+            if not just_frozen:
+                _telem.inc("comm.bucket.count")
+                _telem.inc("comm.bucket.bytes", spec.nbytes())
+            flat = _engine.pack_flat(
+                spec, [merged[k]._read() for k in spec.keys])
+            ts = _telem.span_clock()
+            t0 = time.perf_counter()
+            summed = self._allreduce_compressed(
+                flat, "__bucket__%d" % spec.index)
+            _telem.record_span("comm.bucket[%s]" % spec.key_range(),
+                               "comm", ts, time.perf_counter() - t0)
+            for k, part in zip(spec.keys, _engine.unpack_flat(spec, summed)):
+                stored = self._store[k]
+                val = nd.from_jax(part, ctx=stored.context)
+                if self._updater is not None:
+                    idx = int(k) if k.isdigit() else k
+                    self._updater(idx, val, stored)
+                else:
+                    stored._write(val.as_in_context(
+                        stored.context)._read().astype(stored.dtype))
+        return True
 
     def _push_bucketed(self, entries, cap, outs=None):
         """Bucketed cross-worker path (overrides the local-merge version the

@@ -2,5 +2,7 @@
 from . import optimizer
 from .optimizer import *  # noqa: F401,F403
 from .optimizer import Optimizer, create, register, get_updater, Updater
+from .zero import ZeroComm, ZeroUpdater, get_zero_updater, zero_enabled
 
-__all__ = optimizer.__all__
+__all__ = optimizer.__all__ + ["ZeroComm", "ZeroUpdater",
+                               "get_zero_updater", "zero_enabled"]

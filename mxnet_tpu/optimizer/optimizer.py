@@ -128,6 +128,69 @@ def _fused_fn(kind, momentum_on, clip_on):
     return fn
 
 
+def _fused_flat_fn(kind, momentum_on, clip_on, mp_on):
+    """ONE jitted pass over a flat parameter SHARD — the ZeRO-1 update
+    kernel (reference blueprint: "Tensor Processing Primitives", PAPERS.md:
+    one fused sweep over params+grads+momentum instead of three).
+
+    Where `_fused_fn` walks per-parameter lists, this variant takes a
+    single contiguous flat buffer per operand (one dtype-bucket's owned
+    shard, `mx.engine.BucketSpec`): weight, grad, and state are 1-D
+    vectors, and lr/wd arrive as per-ELEMENT vectors (host-built from the
+    bucket's shard_segments, so per-parameter lr_mult/wd_mult and Adam
+    bias correction survive the flattening; padding tail elements carry
+    lr=wd=0). `mp_on` threads an fp32 master shard for fp16 weights (the
+    multi-precision contract of `mp_sgd_*`): math runs on the master, the
+    returned weight is cast to the wire dtype for the all-gather.
+
+    Arithmetic matches `_fused_fn`/the optimizer ops elementwise, so the
+    ZeRO path stays bit-identical to the replicated update on fp32."""
+    import jax as _jax
+    key = ("flat", kind, momentum_on, clip_on, mp_on)
+    fn = _FUSED_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def prep(g, w32, rescale, clip, wd_vec):
+        g = g.astype(jnp.float32) * rescale
+        if clip_on:
+            g = jnp.clip(g, -clip, clip)
+        return g + wd_vec * w32
+
+    if kind == "sgd":
+        def impl(w, g, mom, master, lr_vec, wd_vec, momentum, rescale,
+                 clip):
+            w32 = master if mp_on else w.astype(jnp.float32)
+            g32 = prep(g, w32, rescale, clip, wd_vec)
+            if momentum_on:
+                m = mom.astype(jnp.float32) * momentum - lr_vec * g32
+                new_mom = m.astype(mom.dtype)
+                w32n = w32 + m
+            else:
+                new_mom = mom
+                w32n = w32 - lr_vec * g32
+            return (w32n.astype(w.dtype), new_mom,
+                    w32n if mp_on else master)
+    elif kind == "adam":
+        # omb1/omb2 = 1-beta1 / 1-beta2 computed by the CALLER in python
+        # double (as the eager op path does) — deriving them in-trace from
+        # the f32 betas rounds differently and breaks bit parity
+        def impl(w, g, mean, var, master, lr_vec, wd_vec, beta1, omb1,
+                 beta2, omb2, eps, rescale, clip):
+            w32 = master if mp_on else w.astype(jnp.float32)
+            g32 = prep(g, w32, rescale, clip, wd_vec)
+            m = beta1 * mean + omb1 * g32
+            v = beta2 * var + omb2 * g32 * g32
+            w32n = w32 - lr_vec * m / (jnp.sqrt(v) + eps)
+            return (w32n.astype(w.dtype), m.astype(mean.dtype),
+                    v.astype(var.dtype), w32n if mp_on else master)
+    else:
+        raise KeyError(kind)
+
+    fn = _FUSED_CACHE[key] = _jax.jit(impl)
+    return fn
+
+
 class Optimizer:
     """Base optimizer. reference: python/mxnet/optimizer/optimizer.py."""
 

@@ -33,7 +33,7 @@ from .sharding import ShardingRules
 
 __all__ = ["save_sharded", "restore_sharded", "latest_step",
            "latest_committed_step", "save_train_state",
-           "restore_train_state"]
+           "restore_train_state", "save_zero_state", "restore_zero_state"]
 
 
 def _mgr(path, keep=None):
@@ -172,6 +172,55 @@ def restore_sharded(path, step=None, mesh=None, rules=None, template=None,
             int(step), args=ocp.args.StandardRestore(template))
     finally:
         mgr.close()
+
+
+def _zero_payload_to_tree(payload):
+    """ZeRO state payload (`optimizer.zero.ZeroUpdater.state_payload`) →
+    an orbax-friendly pytree: the frozen bucket layout travels as a
+    JSON-in-uint8 leaf (every orbax codec round-trips arrays; not every
+    one round-trips nested str/int metadata), state slots keyed by
+    stringified bucket index."""
+    import json
+    import numpy as _np
+    layout = payload.get("layout")
+    tree = {"zero_format": _np.asarray([payload["zero_format"]], _np.int64),
+            "layout_json": _np.frombuffer(
+                json.dumps(layout).encode("utf-8"), _np.uint8).copy(),
+            "state": {str(b): {str(name): _np.asarray(arr)
+                               for name, arr in slots.items()}
+                      for b, slots in payload.get("state", {}).items()}}
+    return tree
+
+
+def _zero_tree_to_payload(tree):
+    import json
+    import numpy as _np
+    layout = json.loads(bytes(bytearray(
+        _np.asarray(tree["layout_json"], _np.uint8))).decode("utf-8"))
+    state = {int(b): dict(slots) for b, slots in tree["state"].items()}
+    return {"zero_format": int(_np.asarray(tree["zero_format"])[0]),
+            "layout": layout, "state": state}
+
+
+def save_zero_state(path, updater, step=0, keep=None, coordinated=False):
+    """Checkpoint a ZeRO-1 sharded optimizer state (the
+    `optimizer.zero.ZeroUpdater`) through orbax: per-rank owned shards are
+    all-gathered into the world-size-independent full state, saved next to
+    the frozen bucket layout — `restore_zero_state` then re-partitions
+    onto whatever world size the restoring updater runs (elastic
+    shrink/grow). `coordinated=True` rides the two-phase commit like any
+    other sharded save."""
+    save_sharded(path, _zero_payload_to_tree(updater.state_payload()),
+                 step=step, keep=keep, coordinated=coordinated)
+
+
+def restore_zero_state(path, updater, step=None, coordinated=False):
+    """Restore a `save_zero_state` checkpoint into `updater`, sliced for
+    the updater's CURRENT world/rank (which may differ from the saving
+    fleet's). Returns the updater."""
+    tree = restore_sharded(path, step=step, coordinated=coordinated)
+    updater.load_state_payload(_zero_tree_to_payload(tree))
+    return updater
 
 
 def save_train_state(path, params, opt_state, step, keep=None):

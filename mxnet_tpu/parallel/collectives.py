@@ -23,7 +23,8 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
-           "psum_bucketed", "all_reduce_multi", "barrier", "allreduce_bench"]
+           "psum_bucketed", "all_reduce_multi", "reduce_scatter_multi",
+           "all_gather_multi", "barrier", "allreduce_bench"]
 
 
 def all_reduce(x, axis_name):
@@ -223,6 +224,69 @@ def all_reduce_multi(arrays, mesh=None, axis=None, bucket_mb=None):
             out[i] = jnp.zeros((-(-a.shape[0] // n),) + tuple(a.shape[1:]),
                                a.dtype)
     return out
+
+
+# ---------------------------------------------------------------------------
+# ZeRO weight-update sharding primitives: bucket-wise reduce-scatter and
+# all-gather over a persistent BucketLayout (mx.engine). Each bucket is ONE
+# fused flatten(+zero-pad)→collective launch — the reduce-scatter analog of
+# psum_bucketed, with the bucket as the scatter segment.
+# ---------------------------------------------------------------------------
+def reduce_scatter_multi(xs, axis_name, axis_size=None, layout=None,
+                         bucket_mb=None):
+    """Reduce-scatter a LIST of per-device arrays over a mesh axis (inside
+    shard_map/jit) as few fused flat collectives: arrays pack into the
+    persistent buckets of `layout` (frozen from the inputs on first use —
+    pass the returned layout back in on later steps), each bucket's flat
+    vector is zero-padded to a multiple of the axis size (`mx.engine`
+    BucketSpec padding, the PR 7 odd-leading-dim trick) and ONE
+    `lax.psum_scatter` hands this device its contiguous
+    ``padded/axis_size`` shard of the bucket sum.
+
+    Returns ``(shards, layout)``: shards[b] aligns with layout.buckets[b].
+    Under jit the `comm.reduce_scatter` counter ticks once per bucket per
+    (re)trace — collectives-per-program, not per step."""
+    from .. import engine as _engine
+    from .. import telemetry as _telem
+    if any(int(x.size) == 0 for x in xs):
+        # the bucketer skips empties, which would silently drop slots and
+        # misalign the all_gather_multi return — make the caller decide
+        raise ValueError("reduce_scatter_multi: zero-size arrays have no "
+                         "shard; filter them out before the call")
+    if layout is None:
+        if axis_size is None:
+            raise ValueError(
+                "reduce_scatter_multi needs axis_size (static) or a frozen "
+                "layout to derive shard boundaries")
+        layout = _engine.BucketLayout.from_entries(
+            enumerate(xs), axis_size, _engine.bucket_bytes(bucket_mb))
+    else:
+        layout.assert_matches([str(i) for i in range(len(xs))])
+    by_key = {str(i): x for i, x in enumerate(xs)}
+    shards = []
+    for spec in layout:
+        flat = _engine.pack_flat(spec, [by_key[k] for k in spec.keys])
+        _telem.inc("comm.reduce_scatter")
+        shards.append(lax.psum_scatter(flat, axis_name,
+                                       scatter_dimension=0, tiled=True))
+    return shards, layout
+
+
+def all_gather_multi(shards, layout, axis_name):
+    """Inverse of `reduce_scatter_multi`: all-gather each bucket's
+    per-device shard back to the full padded flat vector (ONE
+    `lax.all_gather` per bucket) and unpack to the original shapes, pad
+    dropped. Returns the arrays in the layout's key order (= the input
+    order `reduce_scatter_multi` saw)."""
+    from .. import engine as _engine
+    from .. import telemetry as _telem
+    outs = {}
+    for spec, shard in zip(layout, shards):
+        _telem.inc("comm.all_gather")
+        flat = lax.all_gather(shard, axis_name, tiled=True)
+        for k, part in zip(spec.keys, _engine.unpack_flat(spec, flat)):
+            outs[k] = part
+    return [outs[k] for k in layout.keys()]
 
 
 def allreduce_bench(size_mb=64, iters=20, mesh=None, dtype=jnp.float32):

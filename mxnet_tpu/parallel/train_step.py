@@ -104,7 +104,7 @@ class ShardedTrainStep:
 
     def __init__(self, loss_fn, params, mesh, rules=None, optimizer="adamw",
                  lr=1e-3, batch_spec=None, grad_accum=1, donate=True,
-                 remat=False, bucket_mb=None, **opt_kwargs):
+                 remat=False, bucket_mb=None, zero=False, **opt_kwargs):
         self.loss_fn = loss_fn
         self._init_params = params
         self.mesh = mesh
@@ -120,6 +120,18 @@ class ShardedTrainStep:
         # buckets (identity math) so GSPMD emits bucketed cross-replica
         # reductions; None disables, 0 is the per-leaf escape hatch
         self.bucket_mb = bucket_mb
+        # zero: ZeRO-1 for the functional path — optimizer-state leaves
+        # shard their leading dim over the DATA axis on top of the
+        # existing mesh rules, and GSPMD materializes the paper's
+        # automatic weight-update sharding (grads arrive reduce-scattered
+        # where state lives, the weight delta all-gathers back); params
+        # and the forward stay exactly as the rules say
+        self.zero = bool(zero)
+        self._zero_axis = None
+        if self.zero:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if sizes.get("data", 1) > 1:
+                self._zero_axis = ("data", sizes["data"])
         self._sig_seen = set()   # batch signatures, for the retrace guard
         self._sig_last = None
         self._batch_spec_arg = batch_spec  # user-given (None = derive)
@@ -149,14 +161,40 @@ class ShardedTrainStep:
 
     def _state_specs(self, opt_state):
         """Optimizer-state specs: per-param slots inherit the param's spec;
-        scalars replicate."""
+        scalars replicate. With ``zero=True`` each state leaf additionally
+        shards its leading dim over the data axis (when free and
+        divisible) — ZeRO-1 composed onto the existing rules."""
         out = {}
         for key, val in opt_state.items():
             if isinstance(val, jnp.ndarray) and val.ndim == 0:
                 out[key] = P()
             else:
-                out[key] = self.rules.tree_specs(val, self.mesh)
+                specs = self.rules.tree_specs(val, self.mesh)
+                if self._zero_axis is not None:
+                    specs = _tmap(
+                        lambda leaf, s: self._zero_spec(
+                            s, getattr(leaf, "shape", ())), val, specs)
+                out[key] = specs
         return out
+
+    def _zero_spec(self, spec, shape):
+        """Compose the ZeRO data-axis shard onto a rules-derived spec:
+        claim the leading dim when no axis holds it yet, the data axis is
+        unused elsewhere in the spec, and the dim divides evenly; anything
+        else keeps the rules' spec untouched (correctness first — GSPMD
+        padding surprises are not worth a silent layout change)."""
+        axis, size = self._zero_axis
+        if not shape or shape[0] % size:
+            return spec
+        entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+        used = set()
+        for e in entries:
+            for a in (e if isinstance(e, tuple) else (e,)):
+                if a is not None:
+                    used.add(a)
+        if axis in used or entries[0] is not None:
+            return spec
+        return P(*((axis,) + entries[1:]))
 
     # ------------------------------------------------------------------
     # elastic re-layout (resilience: the device set changed under the run)
@@ -198,7 +236,7 @@ class ShardedTrainStep:
             optimizer=(self._opt_init, self._opt_update), lr=self.lr,
             batch_spec=self._batch_spec_arg, grad_accum=self.grad_accum,
             donate=self.donate, remat=self._remat, bucket_mb=self.bucket_mb,
-            **self.opt_kwargs)
+            zero=self.zero, **self.opt_kwargs)
 
     # ------------------------------------------------------------------
     def _build(self, params, opt_state):

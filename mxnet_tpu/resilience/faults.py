@@ -9,6 +9,7 @@ Instrumented sites:
 
 ``kvstore.push`` / ``kvstore.pull``   per-key store traffic (local + dist)
 ``collective.all_reduce`` / ``collective.barrier``   eager collectives
+``collective.reduce_scatter`` / ``collective.all_gather``  ZeRO comm legs
 ``train.step``                        inside the fused/sharded step
 ``run.step``                          the runner's pre-mutation boundary
 ``dist.initialize``                   coordinator rendezvous

@@ -1012,6 +1012,42 @@ def test_tpu008_passes_bound_axis_and_divisible_dims():
     assert not only(f, "TPU008")
 
 
+def test_tpu008_knows_zero_sharding_collectives():
+    """ISSUE 9 satellite: the ZeRO weight-update collectives
+    (`reduce_scatter_multi` / `all_gather_multi`) are rendezvous ops —
+    divergent-branch placement and unbound axis names must flag exactly
+    like psum."""
+    f = lint("""
+    import jax
+    from mxnet_tpu.parallel.collectives import (reduce_scatter_multi,
+                                                all_gather_multi)
+    @jax.jit
+    def step(xs, layout):
+        if xs[0].sum() > 0:
+            shards, layout = reduce_scatter_multi(xs, "data", axis_size=4)
+            xs = all_gather_multi(shards, layout, "data")
+        return xs
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 2
+    assert all("deadlock" in h.message for h in hits)
+
+
+def test_tpu008_zero_collectives_axis_binding_checked():
+    f = lint("""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.collectives import all_gather_multi
+    mesh = Mesh(None, ("data",))
+    @jax.jit
+    def step(shards, layout):
+        return all_gather_multi(shards, layout, "worker")
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 1
+    assert "worker" in hits[0].message
+
+
 def test_tpu008_passes_cond_with_collective_free_branches():
     f = lint("""
     import jax

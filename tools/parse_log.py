@@ -147,7 +147,8 @@ def parse_comm(obj):
         obj = obj["telemetry"]
     counters = obj.get("counters", {})
     rows = []
-    ordered = ("comm.collectives", "comm.bucket.count", "comm.bucket.bytes",
+    ordered = ("comm.collectives", "comm.reduce_scatter", "comm.all_gather",
+               "comm.bucket.count", "comm.bucket.bytes",
                "comm.bucket.skipped", "kvstore.push_calls",
                "kvstore.push_bytes", "kvstore.pull_calls",
                "kvstore.pull_bytes")
@@ -157,6 +158,16 @@ def parse_comm(obj):
     for name in sorted(counters):
         if name.startswith("comm.bucket.flush_reason."):
             rows.append((name, counters[name]))
+    # ZeRO weight-update sharding: sharded-state footprint + fused-update
+    # latency ride the same table (the --comm story is the whole sync)
+    state_gauge = obj.get("gauges", {}).get("opt.state_bytes_per_rank")
+    if isinstance(state_gauge, dict) and state_gauge.get("value"):
+        rows.append(("opt.state_bytes_per_rank", state_gauge["value"]))
+    fused = obj.get("histograms", {}).get("opt.fused_update_ms")
+    if isinstance(fused, dict) and fused.get("count"):
+        rows.append(("opt.fused_updates", fused["count"]))
+        rows.append(("opt.fused_update_ms_avg",
+                     round(fused.get("sum", 0.0) / fused["count"], 3)))
     buckets = counters.get("comm.bucket.count", 0)
     if buckets:
         rows.append(("avg_bucket_kb",
