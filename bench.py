@@ -406,6 +406,139 @@ def bench_fused_train_stage(on_accel):
     return results["pallas_fused"], results["xla_composed"]
 
 
+def bench_fused_bwd(on_accel):
+    """BENCH=fused_bwd (ISSUE 10): the fused CBR BACKWARD program vs the
+    composed Conv->BN(batch stats)->ReLU backward, isolated via jax.vjp —
+    the lowered program is the pure backward, whose inputs are whatever
+    each forward SAVED. The composed path materializes/loads its AD
+    residuals (xhat, pre-relu activation); the fused custom-vjp re-streams
+    conv_out through `_kernel_train_bwd` twice and loads nothing else.
+    Logs both programs' cost_analysis bytes (round-3 CPU-backend
+    methodology off-chip; interpret-mode wall times are NOT perf
+    evidence) and emits bytes_fused/bytes_composed in the row."""
+    import numpy as onp
+    from jax import lax
+    from mxnet_tpu.ops import fused_conv as fc
+
+    N, H, W, C = (64, 14, 14, 256) if on_accel else (4, 8, 8, 16)
+    rng = onp.random.RandomState(0)
+    dt = jnp.bfloat16 if on_accel else jnp.float32
+    x = jnp.asarray(rng.randn(N, H, W, C), dtype=dt)
+    w = jnp.asarray(rng.randn(3, 3, C, C) * 0.05, dtype=dt)
+    gamma = jnp.asarray(rng.rand(C) + 0.5, dtype=jnp.float32)
+    beta = jnp.asarray(rng.randn(C) * 0.1, dtype=jnp.float32)
+    cot = (jnp.asarray(rng.rand(N, H, W, C), dtype=dt),
+           jnp.zeros((C,), jnp.float32), jnp.zeros((C,), jnp.float32))
+
+    def composed(x_, w_, g_, b_):
+        conv = lax.conv_general_dilated(
+            x_, w_, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        mean = jnp.mean(conv, axis=(0, 1, 2))
+        var = jnp.var(conv, axis=(0, 1, 2))
+        xhat = (conv - mean) * jax.lax.rsqrt(var + 1e-3)
+        out = jnp.maximum(xhat * g_ + b_, 0.0).astype(x_.dtype)
+        return out, mean, var
+
+    def fused(x_, w_, g_, b_):
+        return fc._cbr_train(1e-3, False, x_, w_, g_, b_, None)
+
+    speed, bytes_ = {}, {}
+    for f, tag in ((composed, "composed"), (fused, "fused")):
+        _, vjp = jax.vjp(f, x, w, gamma, beta)
+        bwd = jax.jit(lambda c, vjp=vjp: vjp(c))
+        try:
+            cost = bwd.lower(cot).compile().cost_analysis()
+            cost = cost[0] if isinstance(cost, list) else cost
+            bytes_[tag] = cost.get("bytes accessed", float("nan"))
+            print("# fused_bwd %s bytes accessed: %.3e"
+                  % (tag, bytes_[tag]), file=sys.stderr)
+        except Exception as e:          # cost analysis is best-effort
+            bytes_[tag] = None
+            print("# fused_bwd %s cost_analysis unavailable: %s"
+                  % (tag, e), file=sys.stderr)
+        out = bwd(cot)
+        _sync(out[0])
+        n = 50 if on_accel else 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = bwd(cot)
+        _sync(out[0])
+        speed[tag] = n * N / (time.perf_counter() - t0)
+    return {
+        "metric": ("fused_cbr_bwd_img_per_sec" if on_accel
+                   else "fused_cbr_bwd_cpu_img_per_sec"),
+        "value": round(speed["fused"], 2),
+        "unit": "img/s",
+        "vs_baseline": round(speed["fused"] / speed["composed"], 4),
+        "bytes_fused": bytes_["fused"],
+        "bytes_composed": bytes_["composed"],
+    }
+
+
+def bench_fused_opt(on_accel):
+    """BENCH=fused_opt (ISSUE 10): the Pallas flat-segment Adam kernel vs
+    the XLA composite `_fused_flat_xla` over a resnet18-sized flat shard
+    (one pass over w/g/mean/var instead of separate elementwise loops).
+    Emits elems/s, vs_baseline = pallas/xla wall ratio, and both
+    programs' cost_analysis bytes. Off-chip the kernel runs through the
+    interpreter, whose per-grid-step block-copy emulation (dynamic-slice/
+    update-slice pairs) DOMINATES the counted bytes for a pure
+    elementwise kernel — the cpu row is a dispatch-correctness smoke
+    (expect bytes_fused > bytes_composed and vs_baseline < 1 there); the
+    chip-queue row is the evidence, as with BENCH=comm."""
+    import numpy as onp
+    from mxnet_tpu.ops import fused_optimizer as fo
+    from mxnet_tpu.optimizer.optimizer import _fused_flat_xla
+
+    n = 11_700_000 if on_accel else 262_144
+    rng = onp.random.RandomState(0)
+    w = jnp.asarray(rng.randn(n).astype(onp.float32))
+    g = jnp.asarray(rng.randn(n).astype(onp.float32))
+    mean = jnp.zeros((n,), jnp.float32)
+    var = jnp.abs(g) * 0.1
+    lr = jnp.full((n,), 0.001, jnp.float32)
+    wd = jnp.full((n,), 0.01, jnp.float32)
+    args = (w, g, mean, var, None, lr, wd, jnp.float32(0.9),
+            jnp.float32(0.1), jnp.float32(0.999), jnp.float32(0.001),
+            jnp.float32(1e-8), jnp.float32(1.0), jnp.float32(0.0))
+
+    impls = {
+        "composed": _fused_flat_xla("adam", True, False, False),
+        "fused": fo.flat_update_fn("adam", True, False, False),
+    }
+    speed, bytes_ = {}, {}
+    for tag, fn in impls.items():
+        try:
+            cost = jax.jit(fn).lower(*args).compile().cost_analysis()
+            cost = cost[0] if isinstance(cost, list) else cost
+            bytes_[tag] = cost.get("bytes accessed", float("nan"))
+            print("# fused_opt %s bytes accessed: %.3e"
+                  % (tag, bytes_[tag]), file=sys.stderr)
+        except Exception as e:
+            bytes_[tag] = None
+            print("# fused_opt %s cost_analysis unavailable: %s"
+                  % (tag, e), file=sys.stderr)
+        out = fn(*args)
+        _sync(out[0])
+        reps = 50 if on_accel else 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        _sync(out[0])
+        speed[tag] = reps * n / (time.perf_counter() - t0)
+    return {
+        "metric": ("fused_opt_flat_elems_per_sec" if on_accel
+                   else "fused_opt_flat_cpu_elems_per_sec"),
+        "value": round(speed["fused"], 2),
+        "unit": "elems/s",
+        "vs_baseline": round(speed["fused"] / speed["composed"], 4),
+        "bytes_fused": bytes_["fused"],
+        "bytes_composed": bytes_["composed"],
+    }
+
+
 def resnet18_grad_shapes():
     """resnet18 (classes=1000) parameter shapes: conv1 + 8 basic blocks
     (2 convs + 2 BN pairs each, stage-transition downsamples) + fc — the
@@ -880,6 +1013,13 @@ def main():
             "unit": "img/s",
             "vs_baseline": round(fast / base, 4),   # vs XLA composed
         })
+        return
+    if which in ("fused_bwd", "fused_opt"):
+        os.environ.setdefault("MXNET_TPU_USE_PALLAS", "1")
+        if not on_accel:
+            os.environ.setdefault("MXNET_FLASH_INTERPRET", "1")
+        _emit((bench_fused_bwd if which == "fused_bwd"
+               else bench_fused_opt)(on_accel))
         return
     if which == "comm":
         _emit(bench_comm(on_accel))

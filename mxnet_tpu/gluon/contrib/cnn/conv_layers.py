@@ -166,8 +166,13 @@ class FusedConvBNReLUTrain(HybridBlock):
     Training rides `_contrib_conv_bn_relu_train`: the batch statistics are
     computed in the conv kernel's epilogue from the f32 VMEM accumulator
     (the stats reduction never re-reads the conv output from HBM), then
-    one normalize+relu pass; the backward recomputes xhat instead of
-    materializing it. Inference folds the running stats and takes the
+    one normalize+relu pass; the BACKWARD is the ISSUE 10 fused Pallas
+    kernel (`_kernel_train_bwd`): conv_out/dy stream through VMEM, xhat
+    and the relu mask are recomputed in-register, and the dgamma/dbeta
+    reductions + dconv (+dres) tiles all come out of ONE pallas_call —
+    this block gains it for free through the op's custom-vjp, so the
+    `MXNET_TPU_FUSED_CONVBN=1` headline resnet50 trains on it end to end.
+    Inference folds the running stats and takes the
     `_contrib_conv_bn_relu` inference kernel.
 
     Drop-in for a Conv2D(3x3, NHWC, no bias) -> BatchNorm -> relu chain;

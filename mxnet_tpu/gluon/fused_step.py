@@ -669,12 +669,19 @@ class FusedTrainStep:
         # programs are keyed by input nesting: a call with equal shapes but a
         # different pytree structure must not reuse a stale trace
         prog = self._programs.get(repr(in_fmt))
+        pallas_before = None
         if prog is None:
             _telem.inc("fused_step.compile")
             _telem.note_compile(
                 "fused_step:%s" % getattr(self._net, "name", "net"))
             prog = self._make_program(in_fmt)
             self._programs[repr(in_fmt)] = prog
+            if _telem.ENABLED:
+                # ISSUE 10 dispatch observability: Pallas call sites (the
+                # fused conv fwd/bwd) count ops.pallas.dispatch while the
+                # first call TRACES this program — the delta across the
+                # trace is the number of kernels fused into the step
+                pallas_before = _telem.counter("ops.pallas.dispatch").value
         jitted, holder = prog
 
         from .. import random as _random
@@ -732,6 +739,12 @@ class FusedTrainStep:
             train_raws, other_raws, state_raws,
             scal_dev, rescale_dev,
             data_raws, label_raw, rng_key)
+        if pallas_before is not None:
+            # unconditionally: a recompile that fuses ZERO kernels (gate
+            # turned off, shapes fell back) must not leave a stale count
+            _telem.set_gauge(
+                "fused_step.pallas_kernels",
+                _telem.counter("ops.pallas.dispatch").value - pallas_before)
 
         with autograd.pause():
             for p_nd, raw in zip(self._train_nds, new_train):

@@ -79,6 +79,18 @@ class DistZeroComm:
             return shard
         return jnp.asarray(self._store._cross_worker_gather(shard))
 
+    def all_reduce(self, spec, value):
+        """Cross-rank SUM of a small per-bucket vector (LAMB's per-segment
+        squared norms) — one psum over the worker mesh. Raw primitive like
+        the sibling legs: fault injection, retry, and the comm.collectives
+        count are applied ONCE by ZeroUpdater._lamb_shard_update (routing
+        through `_allreduce` here would nest a second retry loop and
+        double-count the collective)."""
+        if self.world == 1:
+            return value
+        return jnp.asarray(self._store._cross_worker(jnp.asarray(value),
+                                                     _sum0))
+
 
 class GradientCompression:
     """2-bit threshold compression with error feedback and REAL bit packing.

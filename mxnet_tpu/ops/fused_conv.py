@@ -33,6 +33,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import pallas_stats as _pstats
 from .registry import register, get
 
 __all__ = ["fold_bn_params"]
@@ -40,6 +41,10 @@ __all__ = ["fold_bn_params"]
 
 def _interpret():
     return os.environ.get("MXNET_FLASH_INTERPRET", "0") == "1"
+
+
+# version-tolerant Mosaic params shim — shared by every kernel module
+_compiler_params = _pstats.compiler_params
 
 
 def fold_bn_params(gamma, beta, moving_mean, moving_var, eps=1e-3):
@@ -103,11 +108,7 @@ def _pallas_conv_bn_relu(x, w, scale, shift, residual=None, block_co=128):
     n_co = pl.cdiv(Cout, block_co)
     has_res = residual is not None
 
-    try:
-        cparams = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel"))
-    except TypeError:
-        cparams = None
+    cparams = _compiler_params(("parallel", "parallel"))
 
     in_specs = [
         pl.BlockSpec((1, H, W, C), lambda n, c: (n, 0, 0, 0)),
@@ -159,8 +160,11 @@ def _conv_bn_relu(x, w, scale, shift, *residual):
 def _conv_bn_relu_tpu(x, w, scale, shift, *residual):
     res = residual[0] if residual else None
     if not _shapes_ok(x, w):
+        _pstats.note_fallback("cbr_infer", "shape")
         return _xla_conv_bn_relu(x, w, scale, shift, res)
-    return _pallas_conv_bn_relu(x, w, scale, shift, res)
+    _pstats.note_dispatch("cbr_infer")
+    with _pstats.kernel_span("cbr_infer"):
+        return _pallas_conv_bn_relu(x, w, scale, shift, res)
 
 
 # ---------------------------------------------------------------------------
@@ -218,11 +222,7 @@ def _pallas_conv_stats(x, w):
     block_co = _stats_block_co(Cout)
     n_co = Cout // block_co
 
-    try:
-        cparams = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel"))
-    except TypeError:
-        cparams = None
+    cparams = _compiler_params(("parallel", "parallel"))
 
     conv_out, partial = pl.pallas_call(
         functools.partial(_kernel_train, block_co=block_co, H=H, W=W, C=C),
@@ -254,14 +254,21 @@ def _xla_conv_stats(x, w):
     return conv_out.astype(x.dtype), s, sq
 
 
-def _use_pallas_train(x, w):
+def _pallas_train_gate():
+    """Is the Pallas training path REQUESTED (independent of shapes)?
+    Interpreter runs always request it (that is what they test); compiled
+    runs need the TPU backend plus the MXNET_TPU_USE_PALLAS opt-in."""
     if _interpret():
-        return _shapes_ok(x, w) and _stats_block_co(w.shape[-1])
+        return True
     if jax.default_backend() not in ("tpu", "axon"):
         return False
-    if os.environ.get("MXNET_TPU_USE_PALLAS", "0") != "1":
+    return os.environ.get("MXNET_TPU_USE_PALLAS", "0") == "1"
+
+
+def _use_pallas_train(x, w):
+    if not _pallas_train_gate():
         return False
-    return _shapes_ok(x, w) and _stats_block_co(w.shape[-1])
+    return bool(_shapes_ok(x, w) and _stats_block_co(w.shape[-1]))
 
 
 def _normalize_relu(conv_out, mean, invstd, gamma, beta, residual):
@@ -275,8 +282,12 @@ def _normalize_relu(conv_out, mean, invstd, gamma, beta, residual):
 def _cbr_train_compute(eps, x, w, gamma, beta, residual):
     """Shared forward: pass-1 conv+stats, pass-2 normalize+relu."""
     if _use_pallas_train(x, w):
-        conv_out, s, sq = _pallas_conv_stats(x, w)
+        _pstats.note_dispatch("cbr_train_fwd")
+        with _pstats.kernel_span("cbr_train_fwd"):
+            conv_out, s, sq = _pallas_conv_stats(x, w)
     else:
+        if _pallas_train_gate():
+            _pstats.note_fallback("cbr_train_fwd", "shape")
         conv_out, s, sq = _xla_conv_stats(x, w)
     M = x.shape[0] * x.shape[1] * x.shape[2]
     mean = s / M
@@ -301,13 +312,126 @@ def _cbr_train_fwd_rule(eps, has_res, x, w, gamma, beta, residual):
                               residual)
 
 
-def _cbr_train_bwd_rule(eps, has_res, saved, cots):
-    x, w, conv_out, mean, invstd, gamma, beta, residual = saved
-    # mean/var cotangents are dropped: running-stat updates are stop-grad
-    # (reference BatchNorm semantics)
-    g_out = cots[0].astype(jnp.float32)
-    # recompute xhat and the pre-relu activation from conv_out + stats —
-    # nothing beyond conv_out was materialized by the forward
+# ---------------------------------------------------------------------------
+# FUSED BACKWARD (round-6 / ISSUE 10 tentpole): the composed backward
+# recomputes xhat/the relu mask and runs its per-channel reductions (dgamma,
+# dbeta, the two Σdxhat moments) plus the dconv elementwise pass as separate
+# XLA loops — each re-reading conv_out and dy from HBM. `_kernel_train_bwd`
+# is ONE pallas_call over grid (co_block, phase, n):
+#
+#   phase 0  streams every (n, co) tile of conv_out/dy once, recomputes
+#            xhat and the relu mask IN VMEM, and accumulates the two
+#            per-channel reductions (Σg = dbeta, Σg·xhat = dgamma) in a
+#            VMEM scratch accumulator — the only full reductions the BN
+#            backward needs (the dxhat moments are gamma·Σg and
+#            gamma·Σg·xhat, derived in-register);
+#   phase 1  streams the tiles a second time (the data dependency of
+#            dconv on the global sums makes a second streaming pass the
+#            information-theoretic minimum — nothing is ever
+#            materialized between the passes) and emits the dconv tiles
+#            (+ dres = masked dy when the block has a residual input).
+#
+# HBM traffic: 2×(conv_out + dy [+ residual]) reads + 1×dconv (+dres)
+# write + O(C) stats. The composed program additionally materializes (or
+# re-derives through separate fusions) xhat and the pre-relu activation.
+# The phase-0 visits of the dconv/dres output map to block (0, c) and
+# write nothing, so no garbage tile ever rides back to HBM.
+# dx/dw still ride XLA's transposed convs — those are MXU-optimal.
+# ---------------------------------------------------------------------------
+def _kernel_train_bwd(co_ref, dy_ref, m_ref, i_ref, g_ref, b_ref, *rest,
+                      block_co, H, W, N, M, has_residual):
+    if has_residual:
+        r_ref, dco_ref, dg_ref, db_ref, dr_ref, acc = rest
+    else:
+        dco_ref, dg_ref, db_ref, acc = rest
+    phase = pl.program_id(1)
+    n = pl.program_id(2)
+    conv = co_ref[0].astype(jnp.float32).reshape(H * W, block_co)
+    dy = dy_ref[0].astype(jnp.float32).reshape(H * W, block_co)
+    mean = m_ref[...].astype(jnp.float32)
+    invstd = i_ref[...].astype(jnp.float32)
+    gamma = g_ref[...].astype(jnp.float32)
+    xhat = (conv - mean) * invstd
+    y = xhat * gamma + b_ref[...].astype(jnp.float32)
+    if has_residual:
+        y = y + r_ref[0].astype(jnp.float32).reshape(H * W, block_co)
+    g = jnp.where(y > 0, dy, 0.0)
+
+    @pl.when(phase == 0)
+    def _():
+        @pl.when(n == 0)
+        def _():
+            acc[...] = jnp.zeros_like(acc)
+        acc[0, :] += jnp.sum(g, axis=0)
+        acc[1, :] += jnp.sum(g * xhat, axis=0)
+
+        @pl.when(n == N - 1)
+        def _():
+            db_ref[...] = acc[0, :]
+            dg_ref[...] = acc[1, :]
+
+    @pl.when(phase == 1)
+    def _():
+        dxhat = g * gamma
+        mean_dxhat = gamma * (acc[0, :] / M)
+        mean_dxhat_xhat = gamma * (acc[1, :] / M)
+        dconv = invstd * (dxhat - mean_dxhat - xhat * mean_dxhat_xhat)
+        dco_ref[0] = dconv.reshape(H, W, block_co)
+        if has_residual:
+            dr_ref[0] = g.reshape(H, W, block_co).astype(dr_ref.dtype)
+
+
+def _pallas_cbr_bwd(conv_out, dy, mean, invstd, gamma, beta, residual=None):
+    """One fused backward launch: (dconv f32, dgamma f32, dbeta f32
+    [, dres residual-dtype]) from conv_out + dy + saved stats."""
+    N, H, W, Cout = conv_out.shape
+    block_co = _stats_block_co(Cout)
+    n_co = Cout // block_co
+    has_res = residual is not None
+
+    cparams = _compiler_params(("arbitrary", "arbitrary", "arbitrary"))
+
+    tile = pl.BlockSpec((1, H, W, block_co), lambda c, p, n: (n, 0, 0, c))
+    chan = pl.BlockSpec((block_co,), lambda c, p, n: (c,))
+    # phase-0 visits of the elementwise outputs park on block (0, c):
+    # consecutive same-index visits never copy out, so the only HBM write
+    # is phase 1's real tile
+    out_tile = pl.BlockSpec((1, H, W, block_co),
+                            lambda c, p, n: (n * p, 0, 0, c))
+    in_specs = [tile, tile, chan, chan, chan, chan]
+    args = [conv_out, dy, mean, invstd, gamma, beta]
+    out_specs = [out_tile, chan, chan]
+    out_shapes = [jax.ShapeDtypeStruct((N, H, W, Cout), jnp.float32),
+                  jax.ShapeDtypeStruct((Cout,), jnp.float32),
+                  jax.ShapeDtypeStruct((Cout,), jnp.float32)]
+    if has_res:
+        in_specs.append(tile)
+        args.append(residual)
+        out_specs.append(out_tile)
+        out_shapes.append(
+            jax.ShapeDtypeStruct((N, H, W, Cout), residual.dtype))
+
+    outs = pl.pallas_call(
+        functools.partial(_kernel_train_bwd, block_co=block_co, H=H, W=W,
+                          N=N, M=float(N * H * W), has_residual=has_res),
+        grid=(n_co, 2, N),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[pltpu.VMEM((2, block_co), jnp.float32)],
+        interpret=_interpret(),
+        **({"compiler_params": cparams} if cparams else {}),
+    )(*args)
+    dconv, dgamma, dbeta = outs[:3]
+    dres = outs[3] if has_res else None
+    return dconv, dgamma, dbeta, dres
+
+
+def _xla_cbr_bwd(conv_out, dy, mean, invstd, gamma, beta, residual=None):
+    """Composite backward epilogue (the pre-round-6 path, and the escape
+    hatch): recompute xhat/mask, three reductions, dconv pass — all as
+    separate XLA ops over HBM-resident tensors."""
+    g_out = dy.astype(jnp.float32)
     xhat, y = _normalize_relu(conv_out, mean, invstd, gamma, beta, residual)
     g = jnp.where(y > 0, g_out, 0.0)
     axes = (0, 1, 2)
@@ -317,13 +441,33 @@ def _cbr_train_bwd_rule(eps, has_res, saved, cots):
     mean_dxhat = jnp.mean(dxhat, axis=axes)
     mean_dxhat_xhat = jnp.mean(dxhat * xhat, axis=axes)
     dconv = invstd * (dxhat - mean_dxhat - xhat * mean_dxhat_xhat)
+    dres = g.astype(residual.dtype) if residual is not None else None
+    return dconv, dgamma, dbeta, dres
+
+
+def _cbr_train_bwd_rule(eps, has_res, saved, cots):
+    x, w, conv_out, mean, invstd, gamma, beta, residual = saved
+    # mean/var cotangents are dropped: running-stat updates are stop-grad
+    # (reference BatchNorm semantics)
+    if _use_pallas_train(x, w):
+        _pstats.note_dispatch("cbr_train_bwd")
+        with _pstats.kernel_span("cbr_train_bwd"):
+            dconv, dgamma, dbeta, dres = _pallas_cbr_bwd(
+                conv_out, cots[0], mean, invstd, gamma, beta,
+                residual if has_res else None)
+    else:
+        if _pallas_train_gate():
+            _pstats.note_fallback("cbr_train_bwd", "shape")
+        dconv, dgamma, dbeta, dres = _xla_cbr_bwd(
+            conv_out, cots[0], mean, invstd, gamma, beta,
+            residual if has_res else None)
 
     _, conv_vjp = jax.vjp(_conv3x3_same, x.astype(jnp.float32),
                           w.astype(jnp.float32))
     dx, dw = conv_vjp(dconv)
-    dres = g.astype(residual.dtype) if has_res else None
     return (dx.astype(x.dtype), dw.astype(w.dtype),
-            dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype), dres)
+            dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype),
+            dres if has_res else None)
 
 
 _cbr_train.defvjp(_cbr_train_fwd_rule, _cbr_train_bwd_rule)

@@ -1,0 +1,81 @@
+"""Pallas kernel-layer shared utilities: dispatch observability — the
+counters/spans that make the kernel layer auditable (ISSUE 10 tentpole
+part 3) — plus the version-tolerance shims every kernel module needs.
+
+Every Pallas kernel call site in the ops layer reports through here:
+
+* ``ops.pallas.dispatch`` (+ ``ops.pallas.dispatch.<kernel>``) counts each
+  decision to run a Pallas kernel;
+* ``ops.pallas.fallback`` (+ ``ops.pallas.fallback.<reason>``) counts each
+  time the Pallas path was REQUESTED (gate on) but the shape/dtype gate sent
+  the call to the XLA composite instead — fallbacks are counted, never
+  errors, so an ineligible tensor silently gets the always-correct path;
+* ``kernel_span(name)`` wraps a dispatch in a ``pallas.<name>`` telemetry
+  span (cat ``kernel``) so chrome traces show which stages ran fused.
+
+Counting context: eager call sites count once per call; sites inside a
+``custom_vjp``/``jit`` trace (the fused conv backward under a compiled train
+step) count once per (re)trace — dispatches-per-program, not per step, the
+same convention as `engine.reassociate_bucketed`. ``parse_log --kernels``
+renders the table.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+__all__ = ["note_dispatch", "note_fallback", "kernel_span",
+           "compiler_params"]
+
+
+def compiler_params(semantics):
+    """Version-tolerant Mosaic params: the class is `CompilerParams` on
+    current jax and `TPUCompilerParams` on the 0.4.3x line (the bare
+    AttributeError killed every interpret-mode kernel test on jaxlib
+    0.4.36); None when neither accepts dimension_semantics. Shared by
+    fused_conv, fused_optimizer, and parallel/flash_attention."""
+    from jax.experimental.pallas import tpu as pltpu
+    for name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, name, None)
+        if cls is not None:
+            try:
+                return cls(dimension_semantics=semantics)
+            except TypeError:
+                return None
+    return None
+
+
+def note_dispatch(kernel):
+    """Count one Pallas kernel dispatch (total + per-kernel)."""
+    from .. import telemetry as _telem
+    if _telem.ENABLED:
+        _telem.inc("ops.pallas.dispatch")
+        _telem.inc("ops.pallas.dispatch.%s" % kernel)
+
+
+def note_fallback(kernel, reason):
+    """Count one gated-but-ineligible call routed to the XLA composite."""
+    from .. import telemetry as _telem
+    if _telem.ENABLED:
+        _telem.inc("ops.pallas.fallback")
+        _telem.inc("ops.pallas.fallback.%s" % reason)
+        _telem.inc("ops.pallas.fallback.%s.%s" % (kernel, reason))
+
+
+@contextlib.contextmanager
+def kernel_span(kernel):
+    """`pallas.<kernel>` telemetry span around a dispatch. Measures host
+    wall time of the dispatch (eager: launch + any sync the caller does
+    inside; traced: trace time) — perf evidence comes from the bench, the
+    span is for WHICH-stage-ran-fused attribution."""
+    from .. import telemetry as _telem
+    if not _telem.ENABLED:
+        yield
+        return
+    ts = _telem.span_clock()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _telem.record_span("pallas.%s" % kernel, "kernel", ts,
+                           time.perf_counter() - t0)

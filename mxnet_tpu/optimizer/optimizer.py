@@ -40,9 +40,14 @@ def _run_op(name, *arrays, **kwargs):
 
     row_sparse grads with lazy_update=True take the lazy path (reference:
     optimizer_op.cc rowsparse kernels): only rows present in grad.indices
-    are touched — momentum/history of absent rows is NOT decayed."""
+    are touched — momentum/history of absent rows is NOT decayed.
+
+    Dispatch resolves through `registry.best_fn` (like the ndarray invoke
+    layer) so the Pallas tpu_impl overrides in ops/fused_optimizer.py
+    serve the eager per-parameter update path on accelerator contexts."""
     from ..ndarray.sparse import RowSparseNDArray
     op = _reg.get(name)
+    fn = op.best_fn(arrays[0].context.device_type in ("gpu", "tpu"))
     grad = arrays[1] if len(arrays) > 1 else None
     if isinstance(grad, RowSparseNDArray) and kwargs.get("lazy_update") \
             and grad._indices.shape[0] < grad.shape[0]:
@@ -50,7 +55,7 @@ def _run_op(name, *arrays, **kwargs):
         w_full = arrays[0]._read()
         state_fulls = [a._read() for a in arrays[2:]]
         row_args = [w_full[idx], grad._values] + [s[idx] for s in state_fulls]
-        out = op.fn(*row_args, **kwargs)
+        out = fn(*row_args, **kwargs)
         if not isinstance(out, tuple):
             out = (out,)
         targets = [arrays[0]] + list(arrays[2:])
@@ -60,7 +65,7 @@ def _run_op(name, *arrays, **kwargs):
             target._write(full.at[idx].set(new.astype(full.dtype)))
         return
     raws = [a._read() for a in arrays]
-    out = op.fn(*raws, **kwargs)
+    out = fn(*raws, **kwargs)
     if not isinstance(out, tuple):
         out = (out,)
     targets = [arrays[0]] + list(arrays[2:])
@@ -129,19 +134,35 @@ def _fused_fn(kind, momentum_on, clip_on):
 
 
 def _fused_flat_fn(kind, momentum_on, clip_on, mp_on):
-    """ONE jitted pass over a flat parameter SHARD — the ZeRO-1 update
+    """ONE fused pass over a flat parameter SHARD — the ZeRO-1 update
     kernel (reference blueprint: "Tensor Processing Primitives", PAPERS.md:
     one fused sweep over params+grads+momentum instead of three).
 
-    Where `_fused_fn` walks per-parameter lists, this variant takes a
-    single contiguous flat buffer per operand (one dtype-bucket's owned
-    shard, `mx.engine.BucketSpec`): weight, grad, and state are 1-D
-    vectors, and lr/wd arrive as per-ELEMENT vectors (host-built from the
-    bucket's shard_segments, so per-parameter lr_mult/wd_mult and Adam
-    bias correction survive the flattening; padding tail elements carry
-    lr=wd=0). `mp_on` threads an fp32 master shard for fp16 weights (the
-    multi-precision contract of `mp_sgd_*`): math runs on the master, the
-    returned weight is cast to the wire dtype for the all-gather.
+    Dispatcher (ISSUE 10): when the Pallas optimizer layer is requested
+    (`ops.fused_optimizer.use_pallas_flat` — interpreter runs, or TPU +
+    MXNET_TPU_USE_PALLAS), the returned callable is the Pallas
+    flat-segment kernel, with counted automatic fallback to the XLA
+    composite for ineligible operands; otherwise it is `_fused_flat_xla`,
+    the always-available XLA escape hatch. Both share one signature per
+    kind and the same elementwise arithmetic (bit-identical on the
+    interpreter — tests assert it)."""
+    from ..ops import fused_optimizer as _fops
+    if kind in ("sgd", "adam") and _fops.use_pallas_flat():
+        return _fops.flat_update_fn(kind, momentum_on, clip_on, mp_on)
+    return _fused_flat_xla(kind, momentum_on, clip_on, mp_on)
+
+
+def _fused_flat_xla(kind, momentum_on, clip_on, mp_on):
+    """The XLA composite flat-shard update (pre-ISSUE-10 `_fused_flat_fn`
+    body): one jitted pass taking a single contiguous flat buffer per
+    operand (one dtype-bucket's owned shard, `mx.engine.BucketSpec`):
+    weight, grad, and state are 1-D vectors, and lr/wd arrive as
+    per-ELEMENT vectors (host-built from the bucket's shard_segments, so
+    per-parameter lr_mult/wd_mult and Adam bias correction survive the
+    flattening; padding tail elements carry lr=wd=0). `mp_on` threads an
+    fp32 master shard for fp16 weights (the multi-precision contract of
+    `mp_sgd_*`): math runs on the master, the returned weight is cast to
+    the wire dtype for the all-gather.
 
     Arithmetic matches `_fused_fn`/the optimizer ops elementwise, so the
     ZeRO path stays bit-identical to the replicated update on fp32."""

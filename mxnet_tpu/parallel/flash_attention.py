@@ -161,12 +161,9 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q=128, block_k=128):
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_q=Sq, seq_k=Sk)
 
-    try:
-        cparams = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary"))
-    except TypeError:
-        cparams = None
+    from ..ops.pallas_stats import compiler_params
+    cparams = compiler_params(("parallel", "parallel", "parallel",
+                               "arbitrary"))
 
     call = pl.pallas_call(
         kernel,
@@ -323,12 +320,9 @@ def _pallas_backward_inner(q, k, v, lse, delta, do, causal, sm_scale,
     nk = pl.cdiv(Sk, block_k)
     group = H // Hkv
 
-    try:
-        cparams = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary"))
-    except TypeError:
-        cparams = None
+    from ..ops.pallas_stats import compiler_params
+    cparams = compiler_params(("parallel", "parallel", "parallel",
+                               "arbitrary"))
     copt = {"compiler_params": cparams} if cparams else {}
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))

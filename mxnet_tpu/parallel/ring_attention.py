@@ -67,6 +67,19 @@ def _pvary(t, axis_name):
         return lax.pcast(t, (axis_name,), to="varying")
     return lax.pvary(t, (axis_name,))
 
+def _axis_size(axis_name):
+    """Static mapped-axis size, version-tolerant: `lax.axis_size` only
+    exists on newer jax; the 0.4.x line exposes it through the axis
+    frame (an int on 0.4.37). The ring permutation schedule needs a
+    python int, so `lax.psum(1, ...)` (traced) is not a substitute."""
+    size = getattr(lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    import jax.core as _core
+    frame = _core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
 def _merge_blocks(o_run, lse_run, o_blk, lse_blk):
     """Combine two normalized attention partials by their logsumexps."""
     m = jnp.maximum(lse_run, lse_blk)
@@ -78,7 +91,7 @@ def _merge_blocks(o_run, lse_run, o_blk, lse_blk):
 
 
 def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, H, Sq, D = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -133,7 +146,7 @@ def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, sm_scale):
 
 def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, res, do):
     q, k, v, o, lse = res
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -201,7 +214,7 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, sm_scale=None):
     if _fa_use_pallas(q, k) and q.shape[2] == k.shape[2]:
         return _ring_flash(q, k, v, axis_name, bool(causal),
                            float(sm_scale))
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, H, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]

@@ -341,6 +341,20 @@ class ShardedTrainStep:
             _telem.note_compile("ShardedTrainStep")
             self._batch_proto = batch
             self._compiled = self._build(params, opt_state)
+            if _telem.ENABLED:
+                # ISSUE 10 dispatch observability: Pallas call sites count
+                # ops.pallas.dispatch while the first call TRACES this
+                # program — the delta is the number of kernels fused into
+                # the sharded step (mirrors fused_step.pallas_kernels)
+                before = _telem.counter("ops.pallas.dispatch").value
+                out = self._compiled(params, opt_state, batch,
+                                     jnp.asarray(step_num, jnp.int32))
+                # unconditional: a zero-kernel recompile must clear a
+                # stale count from an earlier gated-on program
+                _telem.set_gauge(
+                    "train_step.pallas_kernels",
+                    _telem.counter("ops.pallas.dispatch").value - before)
+                return out
         return self._compiled(params, opt_state, batch,
                               jnp.asarray(step_num, jnp.int32))
 

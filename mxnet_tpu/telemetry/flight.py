@@ -51,6 +51,7 @@ _DELTA_COUNTERS = (
     "cachedop.compile", "fused_step.compile", "train_step.compile",
     "cachedop.retrace", "fused_step.retrace", "train_step.retrace",
     "ndarray.sync.asnumpy",
+    "ops.pallas.dispatch", "ops.pallas.fallback",
     "resilience.retries", "resilience.restores", "resilience.stalls",
     "resilience.checkpoints", "resilience.faults_injected",
     "resilience.preempt.notices",

@@ -97,3 +97,19 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "lint: tracelint self-check (mx.analysis over mxnet_tpu/; run alone with -m lint)")
     config.addinivalue_line("markers", "obs: observability endpoint tests (live /metrics HTTP server on localhost)")
     config.addinivalue_line("markers", "serve: serving-engine tests (continuous batching, paged KV cache, replica supervision)")
+    config.addinivalue_line("markers", "pallas: Pallas kernel parity tests (CPU backend runs the real kernels through the interpreter — parity evidence only, never perf evidence)")
+
+
+@pytest.fixture(autouse=True)
+def _pallas_interpret_mode(request, monkeypatch):
+    """Tests marked `pallas` run every kernel through the Pallas
+    interpreter on the CPU backend (this container has no TPU chip); the
+    on-chip suite (MXNET_TEST_DEVICE=tpu) clears any inherited interpret
+    flag so the native Mosaic path cannot be silently skipped."""
+    if request.node.get_closest_marker("pallas") is not None:
+        from mxnet_tpu.test_utils import is_accel_test_device
+        if is_accel_test_device():
+            monkeypatch.delenv("MXNET_FLASH_INTERPRET", raising=False)
+        else:
+            monkeypatch.setenv("MXNET_FLASH_INTERPRET", "1")
+    yield

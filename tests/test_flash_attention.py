@@ -138,11 +138,15 @@ def test_ring_flash_matches_full_attention(H, Hkv, causal):
     w = _rand((B, H, S, D), 23)
     sc = D ** -0.5
 
-    f = shard_map(
-        lambda q_, k_, v_: ra.ring_attention(q_, k_, v_, axis_name="seq",
-                                             causal=causal),
-        mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
-        out_specs=P(None, None, "seq", None), check_vma=False)
+    body = lambda q_, k_, v_: ra.ring_attention(q_, k_, v_,  # noqa: E731
+                                                axis_name="seq",
+                                                causal=causal)
+    kw = dict(mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
+              out_specs=P(None, None, "seq", None))
+    try:
+        f = shard_map(body, check_vma=False, **kw)
+    except TypeError:   # the 0.4.x line names the flag check_rep
+        f = shard_map(body, check_rep=False, **kw)
     o_ring = f(q, k, v)
     o_ref = fa._ref_attention(q, k, v, causal, sc)
     onp.testing.assert_allclose(o_ring, o_ref, atol=5e-4, rtol=1e-4)

@@ -11,7 +11,13 @@ import pytest
 import jax.numpy as jnp
 
 import mxnet_tpu  # noqa: F401
+from mxnet_tpu import telemetry
 from mxnet_tpu.ops import fused_conv as fc
+
+# kernel parity through the interpreter on the CPU backend (this container
+# has no chip): interpreter numbers are PARITY evidence only, never perf
+# evidence — the interpreter serializes the grid
+pytestmark = pytest.mark.pallas
 
 
 @pytest.fixture(autouse=True)
@@ -202,6 +208,64 @@ def test_train_backward_matches_composed(res):
     for gg, ww, nm in zip(got, want, names):
         onp.testing.assert_allclose(gg, ww, atol=2e-3, rtol=2e-3,
                                     err_msg=nm)
+
+
+@pytest.mark.parametrize("res", [False, True])
+def test_pallas_bwd_matches_xla_epilogue(res):
+    """ISSUE 10 tentpole: the single-pallas_call backward (`_pallas_cbr_bwd`,
+    phase-grid: reductions then dconv/dres) against the composite XLA
+    epilogue on the same saved tensors. Interpreter run on the CPU backend
+    — parity evidence only, not perf evidence. Reduction association
+    differs (per-image accumulate vs whole-tensor reduce), so parity is
+    fp32-round-off, not bitwise."""
+    N, H, W, C, Cout = 2, 8, 8, 16, 32
+    x, w, g, b, _, _ = _mk(N, H, W, C, Cout, seed=17)
+    out, mean, var, invstd, conv_out = fc._cbr_train_compute(
+        1e-3, x, w, g, b, None)
+    rng = onp.random.RandomState(18)
+    dy = jnp.asarray(rng.randn(N, H, W, Cout).astype("float32"))
+    residual = (jnp.asarray(rng.randn(N, H, W, Cout).astype("float32"))
+                if res else None)
+    got = fc._pallas_cbr_bwd(conv_out, dy, mean, invstd, g, b, residual)
+    want = fc._xla_cbr_bwd(conv_out, dy, mean, invstd, g, b, residual)
+    names = ["dconv", "dgamma", "dbeta", "dres"]
+    for a, e, nm in zip(got, want, names):
+        if e is None:
+            assert a is None, nm
+            continue
+        onp.testing.assert_allclose(a, e, atol=2e-4, rtol=2e-5, err_msg=nm)
+
+
+def test_bwd_dispatch_and_fallback_counters():
+    """Every Pallas dispatch/fallback is visible in telemetry: a good-shape
+    backward counts ops.pallas.dispatch.cbr_train_bwd; a shape the kernel
+    cannot tile (C not a multiple of 8) counts a fallback REASON and still
+    produces gradients through the XLA composite."""
+    import jax
+
+    def counters():
+        return dict(telemetry.snapshot()["counters"])
+
+    def grad_of(C):
+        x, w, g, b, _, _ = _mk(1, 8, 8, C, C, seed=19)
+
+        def loss(x_, w_, g_, b_):
+            out, _, _ = fc._cbr_train(1e-3, False, x_, w_, g_, b_, None)
+            return jnp.sum(out)
+        return jax.grad(loss, argnums=(1,))(x, w, g, b)
+
+    before = counters()
+    grad_of(16)
+    mid = counters()
+    assert mid.get("ops.pallas.dispatch.cbr_train_bwd", 0) > \
+        before.get("ops.pallas.dispatch.cbr_train_bwd", 0)
+    assert mid.get("ops.pallas.dispatch.cbr_train_fwd", 0) > \
+        before.get("ops.pallas.dispatch.cbr_train_fwd", 0)
+    (dw,) = grad_of(12)   # 12 % 8 != 0 -> counted fallback, never an error
+    after = counters()
+    assert after.get("ops.pallas.fallback.cbr_train_bwd.shape", 0) > \
+        mid.get("ops.pallas.fallback.cbr_train_bwd.shape", 0)
+    assert onp.isfinite(onp.asarray(dw)).all()
 
 
 def test_train_op_through_registry_tape():

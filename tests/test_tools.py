@@ -70,3 +70,43 @@ def test_parse_log(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "| 0 | 0.61 | 0.58 | 12.5 |" in r.stdout
     assert "| 1 | 0.75 | 0.71 | 11.0 |" in r.stdout
+
+
+def test_parse_log_kernels(tmp_path):
+    """--kernels: Pallas dispatch/fallback table from a telemetry dump,
+    and the bytes ratio from a BENCH=fused_* row (ISSUE 10)."""
+    import json
+    dump = tmp_path / "telemetry.json"
+    dump.write_text(json.dumps({
+        "counters": {
+            "ops.pallas.dispatch": 7,
+            "ops.pallas.dispatch.cbr_train_bwd": 2,
+            "ops.pallas.dispatch.flat_adam": 5,
+            "ops.pallas.fallback": 1,
+            "ops.pallas.fallback.shape": 1,
+            "ops.pallas.fallback.cbr_train_bwd.shape": 1,
+        },
+        "gauges": {"fused_step.pallas_kernels": {"value": 32, "max": 32}},
+        "histograms": {"opt.fused_update_ms":
+                       {"count": 4, "sum": 8.0, "max": 3.0}},
+    }))
+    cmd = [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+           "--kernels", str(dump)]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "| dispatch | flat_adam | 5 |" in r.stdout
+    assert "| fallback | cbr_train_bwd.shape | 1 |" in r.stdout
+    assert "| program | fused_step.pallas_kernels | 32 |" in r.stdout
+    assert "| latency | fused_update_ms_avg | 2.0 |" in r.stdout
+
+    row = tmp_path / "bench_row.json"
+    row.write_text(json.dumps({
+        "metric": "fused_cbr_bwd_cpu_img_per_sec", "value": 5489.0,
+        "vs_baseline": 1.1, "bytes_fused": 438000.0,
+        "bytes_composed": 497000.0}))
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "parse_log.py"),
+                        "--kernels", str(row)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "| bench | bytes_ratio | 0.8813 |" in r.stdout

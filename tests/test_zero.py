@@ -94,6 +94,15 @@ class _FakeComm:
         return jnp.asarray(np.concatenate(
             [parts[r] for r in range(self.world)]))
 
+    def all_reduce(self, spec, value):
+        # LAMB per-segment norm completion (ISSUE 10): sum in rank order,
+        # matching the replicated baseline's accumulation
+        parts = self._exchange("ar", spec, value)
+        total = parts[0].copy()
+        for r in range(1, self.world):
+            total = total + parts[r]
+        return jnp.asarray(total)
+
 
 def _run_fleet(world, fn):
     """Run fn(rank, comm) on `world` threads; re-raise the first error."""
@@ -338,6 +347,183 @@ def test_zero_multi_precision_restore_keeps_master_bits():
         np.testing.assert_array_equal(a, b)
 
 
+# ===========================================================================
+# LAMB through the ZeroUpdater (ISSUE 10: closes the PR 9 "fused flat
+# kernels for more optimizers" follow-on — the per-segment norm kernel)
+# ===========================================================================
+_LAMB_KW = {"learning_rate": 0.01, "beta1": 0.9, "beta2": 0.999,
+            "epsilon": 1e-6, "rescale_grad": 1.0}
+
+
+def test_zero_lamb_resnet18_sized_parity_vs_eager():
+    """ISSUE 10 satellite: ZeRO LAMB (two-pass flat update with
+    per-segment norms completed by ONE tiny all-reduce) vs the eager
+    per-param LAMB updater on the resnet18-sized 62-tensor key set,
+    world=2. The flat path accumulates each parameter's ‖w‖/‖g‖ in shard
+    segments rather than `jnp.linalg.norm`'s single reduce, so parity is
+    fp32-round-off (documented tolerance), not bitwise."""
+    shapes = _resnet18_grad_shapes()
+    assert len(shapes) == 62
+    world, steps = 2, 2
+    rng = np.random.RandomState(5)
+    init_w = [rng.randn(*s).astype(np.float32) for s in shapes]
+    grads = [[(rng.randn(*s) * 0.1).astype(np.float32) for s in shapes]
+             for _ in range(world)]
+    ref = _replicated_final("lamb", shapes, init_w, grads, steps,
+                            **_LAMB_KW)
+    zouts = _zero_final("lamb", shapes, init_w, grads, steps, world,
+                        **_LAMB_KW)
+    for rank in range(world):
+        for a, b in zip(zouts[rank], ref):
+            np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6)
+
+
+def test_zero_lamb_world4_cross_boundary_segments():
+    """Shapes that straddle shard boundaries at world=4: each rank sees
+    only a PARTIAL slice of most parameters, so the trust-ratio norms are
+    only correct if the per-segment partials really complete across ranks
+    through comm.all_reduce."""
+    shapes = [(7, 3), (11,), (6, 5), (9,)]   # 21+11+30+9 = 71, world 4
+    world, steps = 4, 3
+    rng = np.random.RandomState(6)
+    init_w = [rng.randn(*s).astype(np.float32) for s in shapes]
+    grads = [[(rng.randn(*s) * 0.1).astype(np.float32) for s in shapes]
+             for _ in range(world)]
+    ref = _replicated_final("lamb", shapes, init_w, grads, steps,
+                            **_LAMB_KW)
+    zouts = _zero_final("lamb", shapes, init_w, grads, steps, world,
+                        **_LAMB_KW)
+    for rank in range(world):
+        for a, b in zip(zouts[rank], ref):
+            np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6)
+    # the norm exchange is visible in telemetry
+    assert _counters().get("comm.all_reduce", 0) > 0
+
+
+def test_zero_lamb_bounds_and_wd():
+    """lower/upper trust-ratio bounds and weight decay follow the eager
+    lamb_update_phase1/phase2 semantics through the flat path."""
+    shapes = [(16,), (4, 4)]
+    rng = np.random.RandomState(7)
+    init_w = [rng.randn(*s).astype(np.float32) for s in shapes]
+    grads = [[(rng.randn(*s) * 0.1).astype(np.float32) for s in shapes]]
+    kw = dict(_LAMB_KW, wd=0.01, lower_bound=0.5, upper_bound=2.0)
+    ref = _replicated_final("lamb", shapes, init_w, grads, 2, **kw)
+    zouts = _zero_final("lamb", shapes, init_w, grads, 2, 1, **kw)
+    for a, b in zip(zouts[0], ref):
+        np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6)
+
+
+def test_zero_lamb_state_roundtrip_resume_parity():
+    """save/restore mid-run: resume + 1 step == uninterrupted 2 steps
+    (the lamb mean/var slots ride the generic world-portable payload)."""
+    shapes = [(6, 2), (10,)]
+    rng = np.random.RandomState(9)
+    init_w = [rng.randn(*s).astype(np.float32) for s in shapes]
+    grads = [[(rng.randn(*s) * 0.1).astype(np.float32) for s in shapes]
+             for _ in range(2)]
+    keys = ["0", "1"]
+
+    zu = ZeroUpdater(opt_create("lamb", **_LAMB_KW))
+    ws = [nd.array(w) for w in init_w]
+    for gs in grads:
+        zu.step(keys, [jnp.asarray(g) for g in gs], ws)
+    ref = [w.asnumpy() for w in ws]
+
+    zu2 = ZeroUpdater(opt_create("lamb", **_LAMB_KW))
+    ws2 = [nd.array(w) for w in init_w]
+    zu2.step(keys, [jnp.asarray(g) for g in grads[0]], ws2)
+    payload = zu2.state_payload()
+    zu3 = ZeroUpdater(opt_create("lamb", **_LAMB_KW))
+    zu3.optimizer._index_update_count = dict(
+        zu2.optimizer._index_update_count)
+    zu3.optimizer.num_update = zu2.optimizer.num_update
+    zu3.load_state_payload(payload)
+    ws3 = [nd.array(w.asnumpy()) for w in ws2]
+    zu3.step(keys, [jnp.asarray(g) for g in grads[1]], ws3)
+    for a, b in zip((w.asnumpy() for w in ws3), ref):
+        np.testing.assert_array_equal(a, b)
+
+
+# ===========================================================================
+# Pallas flat kernels through the ZeroUpdater (ISSUE 10 tentpole): the
+# interpreter runs the REAL kernels on the CPU backend — parity evidence
+# only, never perf evidence
+# ===========================================================================
+@pytest.mark.pallas
+@pytest.mark.parametrize("optname,opt_kw", [
+    ("sgd", _SGD_DYADIC),
+    ("adam", _ADAM_DYADIC),
+])
+def test_zero_pallas_flat_kernels_world2_bit_parity(optname, opt_kw):
+    """With the Pallas gate on, ZeroUpdater dispatches the flat-segment
+    kernels (counted in ops.pallas.dispatch.*) and the world=2 sharded run
+    stays BIT-identical to the replicated eager baseline (dyadic
+    hyperparameters, the FMA-immunity trick above)."""
+    from mxnet_tpu.ops import fused_optimizer as fo
+    assert fo.use_pallas_flat()
+    shapes = [(5, 3), (17,), (4, 4), (3,)]
+    world, steps = 2, 2
+    rng = np.random.RandomState(12)
+    init_w = [rng.randn(*s).astype(np.float32) for s in shapes]
+    grads = [[rng.randn(*s).astype(np.float32) for s in shapes]
+             for _ in range(world)]
+    before = _counters()
+    ref = _replicated_final(optname, shapes, init_w, grads, steps, **opt_kw)
+    zouts = _zero_final(optname, shapes, init_w, grads, steps, world,
+                        **opt_kw)
+    after = _counters()
+    for rank in range(world):
+        for a, b in zip(zouts[rank], ref):
+            np.testing.assert_array_equal(a, b)
+    key = "ops.pallas.dispatch.flat_%s" % optname
+    assert after.get(key, 0) > before.get(key, 0)
+
+
+@pytest.mark.pallas
+def test_zero_pallas_multi_precision_fp16_bit_parity():
+    """fp16 + fp32-master through the Pallas flat kernel: bit-identical
+    to the replicated mp_sgd_mom_update baseline."""
+    shapes = [(6, 2), (10,)]
+    rng = np.random.RandomState(13)
+    init_w = [(rng.randn(*s) * 0.1).astype(np.float16) for s in shapes]
+    grads = [[(rng.randn(*s) * 0.1).astype(np.float16) for s in shapes]]
+    kw = {"learning_rate": 0.125, "momentum": 0.5, "rescale_grad": 1.0,
+          "multi_precision": True}
+    ref = _replicated_final("sgd", shapes, init_w, grads, 3, **kw)
+    zouts = _zero_final("sgd", shapes, init_w, grads, 3, 1, **kw)
+    for a, b in zip(zouts[0], ref):
+        assert a.dtype == np.float16
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.pallas
+def test_zero_pallas_lamb_world2_parity():
+    """LAMB's Pallas two-pass (phase1+norm partials, trust-ratio apply)
+    through the sharded updater, world=2 — fp32-round-off parity vs the
+    eager per-param baseline (norm association differs; see module
+    docstring of ops/fused_optimizer.py)."""
+    shapes = [(7, 3), (11,), (5, 5)]
+    world, steps = 2, 2
+    rng = np.random.RandomState(14)
+    init_w = [rng.randn(*s).astype(np.float32) for s in shapes]
+    grads = [[(rng.randn(*s) * 0.1).astype(np.float32) for s in shapes]
+             for _ in range(world)]
+    before = _counters()
+    ref = _replicated_final("lamb", shapes, init_w, grads, steps,
+                            **_LAMB_KW)
+    zouts = _zero_final("lamb", shapes, init_w, grads, steps, world,
+                        **_LAMB_KW)
+    after = _counters()
+    for rank in range(world):
+        for a, b in zip(zouts[rank], ref):
+            np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6)
+    assert after.get("ops.pallas.dispatch.flat_lamb1", 0) > \
+        before.get("ops.pallas.dispatch.flat_lamb1", 0)
+    assert after.get("ops.pallas.dispatch.flat_lamb2", 0) > \
+        before.get("ops.pallas.dispatch.flat_lamb2", 0)
+
+
 def test_zero_and_compression_are_mutually_exclusive():
     from mxnet_tpu.base import MXNetError
     kv = _dist_store()
@@ -367,7 +553,7 @@ def test_zero_skips_zero_size_grads_consistently():
 
 
 def test_zero_rejects_unsupported_optimizer():
-    with pytest.raises(ValueError, match="SGD and Adam"):
+    with pytest.raises(ValueError, match="SGD, Adam and LAMB"):
         ZeroUpdater(opt_create("rmsprop"))
 
 

@@ -17,6 +17,9 @@ Queue (BASELINE.md "chip queue", round-4 ordering):
   4. bench_bert_gluon   BENCH=bert_gluon python bench.py
   5. bench_functional   BENCH=functional python bench.py
   6. bench_fused        BENCH=fused python bench.py    (cost bytes on stderr)
+     + bench_fused_train / bench_fused_bwd / bench_fused_opt — the
+       training-form fusion, the fused CBR backward, and the Pallas flat
+       optimizer kernel (ISSUE 10), all logging cost_analysis bytes
   7. longcontext        python tools/longcontext_probe.py   (seq 4096 A/B)
   8. tpu_suite          MXNET_TEST_DEVICE=tpu pytest tests/ -q
                         -> summary recorded to TESTS_r05_tpu.json
@@ -60,6 +63,10 @@ QUEUE = [
     ("bench_fused", [sys.executable, "bench.py"], {"BENCH": "fused"}, 1800),
     ("bench_fused_train", [sys.executable, "bench.py"],
      {"BENCH": "fused_train"}, 1800),
+    ("bench_fused_bwd", [sys.executable, "bench.py"],
+     {"BENCH": "fused_bwd"}, 1800),
+    ("bench_fused_opt", [sys.executable, "bench.py"],
+     {"BENCH": "fused_opt"}, 1800),
     ("bench_gluon_fused", [sys.executable, "bench.py"],
      {"BENCH": "gluon_fused"}, 2400),
     ("longcontext", [sys.executable, "tools/longcontext_probe.py"], {},

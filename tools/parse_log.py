@@ -385,6 +385,67 @@ def _print_serve(rows, fmt):
         print(line % r)
 
 
+def parse_kernels(obj):
+    """Extract the Pallas kernel-layer story (ISSUE 10): which stages ran
+    fused (`ops.pallas.dispatch.<kernel>`), which calls fell back and WHY
+    (`ops.pallas.fallback.<reason>` / `.<kernel>.<reason>`), how many
+    kernels each compiled step program carries (`*.pallas_kernels`
+    gauges), and the fused-update latency histogram. Also accepts a
+    `BENCH=fused_bwd` / `BENCH=fused_opt` row (a dict with
+    bytes_fused/bytes_composed) and derives the traffic ratio.
+    Returns [(kind, name, value)] rows."""
+    rows = []
+    if "bytes_fused" in obj or "bytes_composed" in obj:
+        bf, bc = obj.get("bytes_fused"), obj.get("bytes_composed")
+        rows.append(("bench", obj.get("metric", "?"), obj.get("value")))
+        rows.append(("bench", "vs_baseline", obj.get("vs_baseline")))
+        if bf is not None:
+            rows.append(("bench", "bytes_fused", bf))
+        if bc is not None:
+            rows.append(("bench", "bytes_composed", bc))
+        if bf and bc:
+            rows.append(("bench", "bytes_ratio", round(bf / bc, 4)))
+        return rows
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    counters = obj.get("counters", {})
+    for total in ("ops.pallas.dispatch", "ops.pallas.fallback"):
+        kind = total.rsplit(".", 1)[-1]
+        if total in counters:
+            rows.append((kind, "total", counters[total]))
+        prefix = total + "."
+        for name in sorted(counters):
+            if name.startswith(prefix):
+                rows.append((kind, name[len(prefix):], counters[name]))
+    for gname in ("fused_step.pallas_kernels", "train_step.pallas_kernels"):
+        g = obj.get("gauges", {}).get(gname)
+        if isinstance(g, dict) and g.get("value") is not None:
+            rows.append(("program", gname, g["value"]))
+    fused = obj.get("histograms", {}).get("opt.fused_update_ms")
+    if isinstance(fused, dict) and fused.get("count"):
+        rows.append(("latency", "fused_updates", fused["count"]))
+        rows.append(("latency", "fused_update_ms_avg",
+                     round(fused.get("sum", 0.0) / fused["count"], 3)))
+        rows.append(("latency", "fused_update_ms_max", fused.get("max")))
+    return rows
+
+
+def _print_kernels(rows, fmt):
+    if not rows:
+        print("no ops.pallas.* counters in this dump (no Pallas dispatch "
+              "ran, or telemetry disabled)", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| kind | name | value |")
+        print("| --- | --- | --- |")
+        line = "| %s | %s | %s |"
+    else:
+        print("kind,name,value")
+        line = "%s,%s,%s"
+    for r in rows:
+        print(line % r)
+
+
 # severity ordering for the lint table: errors first, then by location
 _LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
 
@@ -481,6 +542,11 @@ def main():
                         help="serving mode: tokens/s, ttft/tpot quantiles, "
                              "queue/batch/KV pressure, shed and recovery "
                              "counts from a telemetry JSON dump")
+    parser.add_argument("--kernels", action="store_true",
+                        help="Pallas kernel-layer mode: dispatch/fallback "
+                             "counts by kernel/reason, per-program fused-"
+                             "kernel gauges, fused-update latency, and "
+                             "bytes ratios from BENCH=fused_* rows")
     parser.add_argument("--anomalies", action="store_true",
                         help="anomaly mode: telemetry.anomaly.* counters + "
                              "step-time histograms from a telemetry JSON "
@@ -504,6 +570,12 @@ def main():
             sys.exit("--anomalies input is not a JSON object: %s"
                      % args.logfile)
         _print_anomalies(parse_anomalies(obj), args.format)
+        return
+    if args.kernels:
+        if obj is None:
+            sys.exit("--kernels input is not a JSON object: %s"
+                     % args.logfile)
+        _print_kernels(parse_kernels(obj), args.format)
         return
     if args.comm:
         if obj is None:
