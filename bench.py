@@ -993,10 +993,127 @@ def _probe_backend(timeout=240):
     return jax.devices()[0]
 
 
+def bench_startup_child():
+    """The measured body of BENCH=startup, run in a fresh subprocess: the
+    program-build work a replica pays at boot — a symbolic Module bind +
+    whole-graph training forward, and an mx.serve warmup() (prefill
+    buckets + decode). With a warm MXNET_TPU_AOT_CACHE every one of these
+    executables restores from disk: compile_count drops to 0 and
+    cache_hits counts the restored programs. Prints ONE JSON line."""
+    t0 = time.perf_counter()
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym, telemetry
+    from mxnet_tpu.io.io import DataBatch
+    from mxnet_tpu.models.llama import LlamaConfig, llama_init
+    from mxnet_tpu.serve.kv_cache import KVBlockPool
+    from mxnet_tpu.serve.programs import ServePrograms
+
+    # 1) symbolic path: bind + one whole-graph forward+backward program
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=8)
+    net = sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    rng = np.random.RandomState(0)
+    batch = DataBatch([mx.nd.array(rng.rand(8, 16).astype(np.float32))],
+                      [mx.nd.array(rng.randint(0, 8, (8,))
+                                   .astype(np.float32))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+
+    # 2) serving path: every warmup executable a replica needs
+    cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, hidden_dim=64, rope_theta=10000.0,
+                      max_seq_len=32)
+    import jax
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    pool = KVBlockPool(cfg, num_blocks=16, block_size=8)
+    ServePrograms(params, cfg, pool, max_batch=2, max_context=16).warmup()
+
+    c = telemetry.snapshot()["counters"]
+    print(json.dumps({
+        "startup_s": round(time.perf_counter() - t0, 4),
+        "compile_count": (c.get("compiler.compile", 0)
+                          + c.get("serve.compile", 0)),
+        "cache_hits": c.get("compiler.cache.hits", 0),
+        "cache_misses": c.get("compiler.cache.misses", 0),
+        "cache_writes": c.get("compiler.cache.writes", 0),
+        "fallbacks": c.get("compiler.fallback", 0),
+    }))
+
+
+def bench_startup(on_accel):
+    """BENCH=startup (ISSUE 11): cold vs warm-AOT-cache process start.
+    Spawns the same child workload twice against ONE fresh cache
+    directory — the first run compiles and writes, the second must
+    restore every executable (compile_count 0, cache_hits > 0). A
+    pre-set MXNET_TPU_AOT_CACHE is deliberately ignored: the cold child
+    must actually be cold, or the row measures a warm restore twice.
+    value = the warm child's program-build seconds; vs_baseline =
+    cold/warm build-time ratio (how many times faster a fleet replica
+    boots once one sibling has paid the compiles)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="mx_aot_startup_")
+    env = dict(os.environ, BENCH="startup_child",
+               MXNET_TPU_AOT_CACHE=cache_dir)
+
+    def child(tag):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=900)
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError("startup child (%s) failed:\n%s"
+                               % (tag, proc.stderr[-2000:]))
+        row = json.loads(
+            [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")][-1])
+        row["process_wall_s"] = round(wall, 3)
+        return row
+
+    try:
+        cold = child("cold")
+        warm = child("warm")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return {
+        "metric": "startup_warm_s",
+        "value": warm["startup_s"],
+        "unit": "s",
+        "startup_cold_s": cold["startup_s"],
+        "startup_warm_s": warm["startup_s"],
+        "process_wall_cold_s": cold["process_wall_s"],
+        "process_wall_warm_s": warm["process_wall_s"],
+        "compile_count_cold": cold["compile_count"],
+        "compile_count_warm": warm["compile_count"],
+        "cache_hits_warm": warm["cache_hits"],
+        "cache_writes_cold": cold["cache_writes"],
+        "vs_baseline": round(cold["startup_s"]
+                             / max(warm["startup_s"], 1e-9), 4),
+    }
+
+
 def main():
     dev = _probe_backend()
     on_accel = dev.platform != "cpu"
     which = os.environ.get("BENCH", "gluon")
+    if which == "startup_child":
+        bench_startup_child()
+        return
+    if which == "startup":
+        _emit(bench_startup(on_accel))
+        return
     if which in ("fused", "fused_train"):
         os.environ.setdefault("MXNET_TPU_USE_PALLAS", "1")
         if not on_accel:

@@ -59,6 +59,7 @@ from . import native
 from . import resilience
 from . import analysis
 from . import serve
+from . import compiler
 from . import numpy as np  # noqa: F401 — mx.np numpy-compat namespace
 from . import numpy_extension as npx
 from . import lr_scheduler as _lrs_alias  # noqa: F401

@@ -126,6 +126,15 @@ register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
              "Kept for API compat (reference sharded big arrays across PS servers).")
 register_env("MXNET_PROFILER_AUTOSTART", False, bool,
              "Start the profiler at import (reference: MXNET_PROFILER_AUTOSTART).")
+register_env("MXNET_TPU_WHOLE_GRAPH", True, bool,
+             "Lower bound Symbol graphs to ONE compiled program (constant folding/CSE/DCE "
+             "at graph level, then a single XLA executable) instead of op-by-op dispatch; "
+             "unsupported graphs fall back op-by-op with a counted reason, never erroring.")
+register_env("MXNET_TPU_AOT_CACHE", "", str,
+             "Directory for the persistent AOT executable cache (compiled whole-graph/"
+             "serve/train-step programs serialized across processes); empty disables.")
+register_env("MXNET_TPU_AOT_CACHE_KEEP", 32, int,
+             "AOT cache retention: keep the newest N entries (oldest-mtime evicted).")
 
 
 class MXNetError(RuntimeError):

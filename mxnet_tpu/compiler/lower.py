@@ -1,0 +1,202 @@
+"""Whole-graph lowering: a bound Symbol graph → ONE compiled XLA program.
+
+The executor's op-by-op loop (`symbol/executor.py`) dispatches every NNVM
+node through `nd.invoke` — correct, but each op is its own XLA program and
+each call its own Python round-trip. This module walks the graph once,
+runs the graph-level pass pipeline (`passes.run_pipeline`), emits a single
+pure jax function over the executor's bound arrays, and
+`jit(...).lower().compile()`s it:
+
+* **forward** — (args..., aux...) → (head outputs...)
+* **forward+backward** — same inputs → (head outputs..., grads for every
+  argument whose grad_req wants one), differentiated with `jax.vjp` over
+  the whole program using the same ones-cotangent `autograd.backward`
+  defaults to (ops with custom VJPs — SoftmaxOutput, the regression heads
+  — keep their hand-coded gradients, because those live in the op fns
+  themselves);
+* **forward+backward w/ head grads** — the rare `backward(out_grads=...)`
+  path takes the cotangents as extra program inputs.
+
+Programs are memoized process-wide by (graph hash, mode, input signature),
+so N data-parallel executors of the same symbol share ONE executable, and
+persisted through the AOT cache (`compiler/cache.py`) so the next process
+skips XLA entirely. Telemetry: `compiler.lower_ms` / `compiler.compile_ms`
+histograms, `compiler.{lower,compile,program_runs}` counters, pass stats
+under `compiler.pass.*`, and every compile lands in the
+`telemetry.note_compile` ring tagged `[fresh]` or `[cached]`.
+"""
+from __future__ import annotations
+
+import time
+
+from .. import telemetry as _telem
+from ..ops import registry as _reg
+from . import passes as _passes
+from .cache import aot_cache, avals_sig, cache_key
+
+__all__ = ["GraphProgram", "UnsupportedGraphError"]
+
+UnsupportedGraphError = _passes.UnsupportedGraphError
+
+# process-wide compiled-program memo: data-parallel executor groups bind
+# the same symbol once per device slice — identical signatures must share
+# one executable (and one compile) instead of compiling per executor
+_MEMO = {}
+_MEMO_MAX = 128
+
+
+def _emit(ir, on_tpu):
+    """The whole-graph forward as a pure function of the flat inputs
+    (args... then aux...). Registry fns are resolved once, with the same
+    best_fn(on_tpu) dispatch `nd.invoke` uses, so specialization is
+    identical to the op-by-op path."""
+    pos = {name: i for i, name in enumerate(
+        list(ir.arg_names) + list(ir.aux_names))}
+    node_fns = [None if n.op is None else _reg.get(n.op).best_fn(on_tpu)
+                for n in ir.nodes]
+
+    def forward(*flat_inputs):
+        vals = [None] * len(ir.nodes)
+        for i, node in enumerate(ir.nodes):
+            if node.is_const:
+                vals[i] = node.const
+            elif node.is_var:
+                vals[i] = flat_inputs[pos[node.name]]
+            else:
+                ins = []
+                for (j, slot) in node.inputs:
+                    v = vals[j]
+                    if isinstance(v, (tuple, list)):
+                        v = v[slot]
+                    ins.append(v)
+                vals[i] = node_fns[i](*ins, **node.kwargs)
+        outs = []
+        for (j, slot) in ir.heads:
+            v = vals[j]
+            if isinstance(v, (tuple, list)):
+                v = v[slot]
+            outs.append(v)
+        return tuple(outs)
+
+    return forward
+
+
+class GraphProgram:
+    """The compiled whole-graph programs for one bound symbol.
+
+    Built once per Executor (cheap: graph walk + passes); the expensive
+    jit/compile happens lazily per (mode, input signature) and is shared
+    through the process memo + the persistent AOT cache.
+    """
+
+    def __init__(self, symbol, on_tpu=False, label=None):
+        t0 = time.perf_counter()
+        ir = _passes.from_symbol(symbol)
+        ir, stats = _passes.run_pipeline(ir, on_tpu)
+        self.ir = ir
+        self.stats = stats
+        self.on_tpu = on_tpu
+        self.graph_hash = _passes.graph_hash(ir)
+        self.label = label or (symbol.name or "graph")
+        self.n_heads = len(ir.heads)
+        self._forward = _emit(ir, on_tpu)
+        _telem.inc("compiler.lower")
+        _telem.observe("compiler.lower_ms",
+                       (time.perf_counter() - t0) * 1e3)
+        for k, v in stats.items():
+            if k != "ops" and v:
+                _telem.inc("compiler.pass.%s" % k, v)
+
+    # ------------------------------------------------------------ modes
+    def _fn_for(self, mode, wanted_idx, n_heads_grads):
+        import jax
+        import jax.numpy as jnp
+        forward = self._forward
+        if mode == "fwd":
+            return forward
+        wanted = list(wanted_idx)
+
+        def split(flat):
+            def inner(wanted_vals):
+                full = list(flat)
+                for i, v in zip(wanted, wanted_vals):
+                    full[i] = v
+                return forward(*full)
+            return inner
+
+        if mode == "fwdbwd":
+            def fwd_bwd(*flat):
+                outs, vjp = jax.vjp(split(flat),
+                                    [flat[i] for i in wanted])
+                cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+                (grads,) = vjp(cots)
+                return tuple(outs) + tuple(grads)
+            return fwd_bwd
+
+        # mode == "fwdbwd_og": the trailing n_heads_grads inputs are the
+        # user-supplied head cotangents
+        def fwd_bwd_og(*flat_and_cots):
+            flat = flat_and_cots[:-n_heads_grads]
+            cots = flat_and_cots[-n_heads_grads:]
+            outs, vjp = jax.vjp(split(flat), [flat[i] for i in wanted])
+            (grads,) = vjp(tuple(cots))
+            return tuple(outs) + tuple(grads)
+        return fwd_bwd_og
+
+    # ---------------------------------------------------------- compile
+    def compiled(self, mode, raws, wanted_idx=()):
+        """The compiled executable for `mode` at the signature of `raws`
+        (the already-flat input values). Checks, in order: process memo →
+        AOT cache → fresh lower+compile (stored back to both)."""
+        import jax
+        avals = tuple(jax.ShapeDtypeStruct(r.shape, r.dtype) for r in raws)
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in avals)
+        memo_key = (self.graph_hash, mode, sig, tuple(wanted_idx),
+                    self.on_tpu)
+        hit = _MEMO.get(memo_key)
+        if hit is not None:
+            return hit
+        label = "compiler:%s[%s]" % (self.label, mode)
+        key = cache_key(kind="symbol_graph", graph=self.graph_hash,
+                        mode=mode, wanted=list(wanted_idx),
+                        avals=avals_sig(list(avals)))
+        cache = aot_cache()
+        compiled = cache.load(key, label)
+        if compiled is None:
+            n_og = self.n_heads if mode == "fwdbwd_og" else 0
+            fn = self._fn_for(mode, wanted_idx, n_og)
+            t0 = time.perf_counter()
+            lowered = jax.jit(fn).lower(*avals)
+            compiled = lowered.compile()
+            _telem.inc("compiler.compile")
+            _telem.observe("compiler.compile_ms",
+                           (time.perf_counter() - t0) * 1e3)
+            _telem.note_compile(label + "[fresh]")
+            cache.store(key, compiled, label,
+                        meta={"graph": self.graph_hash, "mode": mode})
+        if len(_MEMO) >= _MEMO_MAX:
+            _MEMO.clear()
+        _MEMO[memo_key] = compiled
+        return compiled
+
+    # -------------------------------------------------------------- run
+    def run_forward(self, raws):
+        """One program dispatch: head outputs as a tuple of raw arrays."""
+        ex = self.compiled("fwd", raws)
+        _telem.inc("compiler.program_runs")
+        return ex(*raws)
+
+    def run_fwd_bwd(self, raws, wanted_idx, head_cots=None):
+        """Heads + gradients in one dispatch. `wanted_idx` indexes the
+        flat inputs whose gradient the executor wants; `head_cots`
+        (optional) are user out_grads — without them the program bakes the
+        ones-cotangent `autograd.backward` uses."""
+        if head_cots is None:
+            ex = self.compiled("fwdbwd", raws, wanted_idx)
+            out = ex(*raws)
+        else:
+            flat = tuple(raws) + tuple(head_cots)
+            ex = self.compiled("fwdbwd_og", flat, wanted_idx)
+            out = ex(*flat)
+        _telem.inc("compiler.program_runs")
+        return out[:self.n_heads], out[self.n_heads:]

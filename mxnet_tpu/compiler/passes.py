@@ -1,0 +1,322 @@
+"""Graph-level optimization passes over a Symbol graph.
+
+The reference executes NNVM graphs op-by-op (graph_executor.cc); Relay
+(PAPERS.md) showed the win of lowering the *whole* framework graph to one
+IR program and optimizing at graph level before the tensor compiler sees
+it, with TVM as the catalog of passes worth running. This module is that
+front end: a `Symbol` DAG lowers to a flat SSA-ish `GraphIR`, then
+
+* **constant folding** — nodes whose inputs are all literal constants are
+  evaluated once at lower time (eagerly, with the same registry fns the
+  op-by-op executor dispatches, so folded values are bit-identical to what
+  the eager path would compute);
+* **common-subexpression elimination** — structurally identical op nodes
+  (same op, same canonicalized hyper-params, same input value-slots)
+  merge into one;
+* **dead-node elimination** — nodes unreachable from the heads after
+  folding/CSE are dropped.
+
+XLA would eventually do some of this per-fusion-island, but running it at
+graph level shrinks the traced program (fewer primitives to lower, smaller
+HLO to hash for the AOT cache key) and is where layout planning and future
+graph rewrites belong.
+
+Anything the pipeline cannot express raises `UnsupportedGraphError` with a
+machine-readable reason — the executor counts it and falls back to op-by-op
+dispatch, never erroring.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..ops import registry as _reg
+
+__all__ = ["GraphIR", "Node", "UnsupportedGraphError", "from_symbol",
+           "fold_constants", "eliminate_common_subexpr",
+           "eliminate_dead_nodes", "run_pipeline", "graph_hash"]
+
+
+class UnsupportedGraphError(Exception):
+    """Graph contains something the whole-graph pipeline does not lower.
+
+    `reason` is a short machine-readable slug (`random_op:Dropout`,
+    `unknown_op:Custom`, ...) used for the counted-fallback telemetry
+    (`compiler.fallback.<reason>`)."""
+
+    def __init__(self, reason):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class Node:
+    """One IR node. Exactly one of the three kinds:
+
+    * variable (`op is None`, `const is None`): a graph input, fed by
+      position from the executor's bound arrays;
+    * constant (`op is None`, `const` set): a literal or folded value;
+    * op node (`op` set): `inputs` is a list of (producer_index, slot)
+      pairs into the IR's node list.
+    """
+
+    __slots__ = ("op", "name", "inputs", "kwargs", "num_outputs", "const",
+                 "is_aux")
+
+    def __init__(self, op, name, inputs=(), kwargs=None, num_outputs=1,
+                 const=None, is_aux=False):
+        self.op = op
+        self.name = name
+        self.inputs = list(inputs)
+        self.kwargs = dict(kwargs or {})
+        self.num_outputs = num_outputs
+        self.const = const
+        self.is_aux = is_aux
+
+    @property
+    def is_var(self):
+        return self.op is None and self.const is None
+
+    @property
+    def is_const(self):
+        return self.op is None and self.const is not None
+
+    def __repr__(self):
+        if self.is_var:
+            return "Var(%s)" % self.name
+        if self.is_const:
+            return "Const(%s)" % self.name
+        return "Op(%s:%s)" % (self.op, self.name)
+
+
+class GraphIR:
+    """Topologically ordered node list + output heads."""
+
+    def __init__(self, nodes, heads, arg_names, aux_names):
+        self.nodes = nodes            # list[Node], producers before users
+        self.heads = heads            # list[(node_index, slot)]
+        self.arg_names = arg_names    # positional input order (args...)
+        self.aux_names = aux_names    # ...then aux states
+
+    def n_ops(self):
+        return sum(1 for n in self.nodes if n.op is not None)
+
+
+def _literal_const(sym_node):
+    """Materialize a literal variable's value the same way the eager
+    executor does (`Symbol._literal_value`), but as raw jax values.
+    Python-float literals stay python floats: the eager path feeds the op
+    a float too, and jax's weak-type promotion must match bit for bit."""
+    import ast
+
+    import jax.numpy as jnp
+    a = sym_node._attrs
+    if "__literal__" in a:
+        return float(a["__literal__"])
+    if "__literal_zeros__" in a:
+        return jnp.zeros(ast.literal_eval(a["__literal_zeros__"]),
+                         dtype=jnp.float32)
+    if "__literal_ones__" in a:
+        return jnp.ones(ast.literal_eval(a["__literal_ones__"]),
+                        dtype=jnp.float32)
+    if "__literal_arange__" in a:
+        start, stop, step = ast.literal_eval(a["__literal_arange__"])
+        return jnp.arange(start, stop, step, dtype=jnp.float32)
+    return None
+
+
+def from_symbol(symbol):
+    """Lower a Symbol DAG to GraphIR, or raise UnsupportedGraphError."""
+    from ..symbol.symbol import _parse_attr
+    topo = symbol._topo()
+    index = {}
+    nodes = []
+    for n in topo:
+        if n._op == "_group":
+            continue  # structural: heads are resolved through _heads()
+        if n._op is None:
+            if n._is_literal():
+                const = _literal_const(n)
+                node = Node(None, n._name, const=const)
+            else:
+                node = Node(None, n._name, is_aux=n._is_aux())
+        else:
+            try:
+                op = _reg.get(n._op)
+            except KeyError:
+                raise UnsupportedGraphError("unknown_op:%s" % n._op)
+            if op.random:
+                # the eager path draws per-op keys from the global key
+                # table; a whole-graph program cannot replay that draw
+                # order bit-identically, so RNG graphs stay op-by-op
+                raise UnsupportedGraphError("random_op:%s" % n._op)
+            ins = []
+            for i in n._inputs:
+                base = i._base_node()
+                if id(base) not in index:
+                    raise UnsupportedGraphError("disconnected_input:%s"
+                                                % i._name)
+                ins.append((index[id(base)], i._out_index or 0))
+            kwargs = {k: _parse_attr(v) for k, v in n._kwargs.items()}
+            node = Node(n._op, n._name, ins, kwargs,
+                        num_outputs=n._num_outputs)
+        index[id(n)] = len(nodes)
+        nodes.append(node)
+    heads = []
+    for h in symbol._heads():
+        base = h._base_node()
+        hi = index[id(base)]
+        if h._out_index is not None:
+            heads.append((hi, h._out_index))
+        elif h._num_outputs > 1:
+            heads.extend((hi, s) for s in range(h._num_outputs))
+        else:
+            heads.append((hi, 0))
+    return GraphIR(nodes, heads, symbol.list_arguments(),
+                   symbol.list_auxiliary_states())
+
+
+# ---------------------------------------------------------------------------
+# passes — each returns (new_ir, n_changed)
+# ---------------------------------------------------------------------------
+def fold_constants(ir, on_tpu=False):
+    """Evaluate op nodes whose inputs are all constants, eagerly, with the
+    SAME resolved registry fn the op-by-op executor would dispatch — the
+    folded value is bit-identical to what eager execution produces."""
+    folded = 0
+    for node in ir.nodes:
+        if node.op is None:
+            continue
+        producers = [ir.nodes[j] for j, _ in node.inputs]
+        if not producers or not all(p.is_const for p in producers):
+            continue
+        ins = []
+        for (j, slot) in node.inputs:
+            v = ir.nodes[j].const
+            if isinstance(v, (tuple, list)):
+                v = v[slot]
+            ins.append(v)
+        fn = _reg.get(node.op).best_fn(on_tpu)
+        try:
+            value = fn(*ins, **node.kwargs)
+        except Exception:
+            continue  # leave it in the program; XLA folds what it can
+        node.op, node.inputs, node.kwargs = None, [], {}
+        node.const = value
+        folded += 1
+    return ir, folded
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def eliminate_common_subexpr(ir):
+    """Merge structurally identical op nodes. Variables stay keyed by
+    name; constants are left alone (value comparison on arrays is not
+    worth the bytes); op nodes key on (op, canon kwargs, resolved input
+    slots) — after remapping, so chains of duplicates collapse in one
+    sweep."""
+    remap = {}  # old index -> surviving index
+    seen = {}
+    new_nodes = []
+    for i, node in enumerate(ir.nodes):
+        if node.op is None:
+            remap[i] = len(new_nodes)
+            new_nodes.append(node)
+            continue
+        inputs = [(remap[j], s) for j, s in node.inputs]
+        key = (node.op, _hashable(node.kwargs), tuple(inputs))
+        hit = seen.get(key)
+        if hit is not None:
+            remap[i] = hit
+            continue
+        node.inputs = inputs
+        remap[i] = len(new_nodes)
+        seen[key] = len(new_nodes)
+        new_nodes.append(node)
+    merged = len(ir.nodes) - len(new_nodes)
+    ir.nodes = new_nodes
+    ir.heads = [(remap[j], s) for j, s in ir.heads]
+    return ir, merged
+
+
+def eliminate_dead_nodes(ir):
+    """Drop nodes unreachable from the heads. Variable nodes are dropped
+    from the node list too — the *positional input signature* (arg_names +
+    aux_names) is unchanged, so the executor feeds the same arrays and XLA
+    sees unused parameters it drops for free."""
+    live = set()
+    stack = [j for j, _ in ir.heads]
+    while stack:
+        j = stack.pop()
+        if j in live:
+            continue
+        live.add(j)
+        stack.extend(k for k, _ in ir.nodes[j].inputs)
+    if len(live) == len(ir.nodes):
+        return ir, 0
+    remap = {}
+    new_nodes = []
+    for i, node in enumerate(ir.nodes):
+        if i not in live:
+            continue
+        node.inputs = [(remap[j], s) for j, s in node.inputs]
+        remap[i] = len(new_nodes)
+        new_nodes.append(node)
+    removed = len(ir.nodes) - len(new_nodes)
+    ir.nodes = new_nodes
+    ir.heads = [(remap[j], s) for j, s in ir.heads]
+    return ir, removed
+
+
+def run_pipeline(ir, on_tpu=False):
+    """fold → CSE → DCE. Returns (ir, stats dict) — the stats land in
+    telemetry (`compiler.pass.*`) so `parse_log --compile` can show what
+    graph-level work the pipeline actually did."""
+    ir, folded = fold_constants(ir, on_tpu)
+    ir, merged = eliminate_common_subexpr(ir)
+    ir, removed = eliminate_dead_nodes(ir)
+    return ir, {"folded": folded, "cse_merged": merged,
+                "dce_removed": removed, "ops": ir.n_ops()}
+
+
+# ---------------------------------------------------------------------------
+# signature
+# ---------------------------------------------------------------------------
+def graph_hash(ir):
+    """Content hash of the optimized graph — the graph half of the AOT
+    cache key (shapes/dtypes/mesh/versions are folded in by
+    `cache.cache_key`). Constants hash by VALUE (bytes for arrays, repr
+    for scalars), not just shape/dtype: `_emit` bakes them into the
+    program as closure values, so two graphs differing only in constant
+    contents must never share a memo slot or cache entry."""
+    import numpy as _np
+    items = []
+    for node in ir.nodes:
+        if node.is_var:
+            items.append(["var", node.name, bool(node.is_aux)])
+        elif node.is_const:
+            c = node.const
+            if isinstance(c, (int, float)):
+                val = repr(c)
+            else:
+                val = hashlib.sha256(_np.asarray(c).tobytes()).hexdigest()
+            items.append(["const", list(getattr(c, "shape", ())),
+                          str(getattr(c, "dtype", type(c).__name__)),
+                          val])
+        else:
+            items.append(["op", node.op,
+                          sorted((str(k), repr(v))
+                                 for k, v in node.kwargs.items()),
+                          node.inputs])
+    blob = json.dumps([items, ir.heads, ir.arg_names, ir.aux_names],
+                      sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()

@@ -614,6 +614,7 @@ class FusedTrainStep:
 
         self._make_program = make_program
         self._programs = {}  # repr(in_fmt) -> (jitted, holder)
+        self._aot_progs = {}  # repr(in_fmt) -> (executable, sig) AOT slot
         self._scal_cache = None  # (lrs_np, wds_np, rescale) -> device arrays
         self._built = True
 
@@ -669,6 +670,7 @@ class FusedTrainStep:
         # programs are keyed by input nesting: a call with equal shapes but a
         # different pytree structure must not reuse a stale trace
         prog = self._programs.get(repr(in_fmt))
+        fresh_program = prog is None
         pallas_before = None
         if prog is None:
             _telem.inc("fused_step.compile")
@@ -735,10 +737,20 @@ class FusedTrainStep:
                               for r in data_raws)
             label_raw = jax.device_put(label_raw, self._label_sharding)
 
-        new_train, new_states, aux_new, loss_mean = jitted(
-            train_raws, other_raws, state_raws,
-            scal_dev, rescale_dev,
-            data_raws, label_raw, rng_key)
+        step_args = (train_raws, other_raws, state_raws,
+                     scal_dev, rescale_dev,
+                     data_raws, label_raw, rng_key)
+        if fresh_program:
+            # first dispatch of this program: give the persistent AOT
+            # cache a chance to skip the compile (the trace still runs
+            # inside lower() — it fills the holder's output format and
+            # aux targets, which are process-local and unserializable)
+            self._maybe_aot(jitted, step_args, sig, repr(in_fmt))
+        aot = self._aot_progs.get(repr(in_fmt))
+        if aot is not None and aot[1] == sig:
+            new_train, new_states, aux_new, loss_mean = aot[0](*step_args)
+        else:
+            new_train, new_states, aux_new, loss_mean = jitted(*step_args)
         if pallas_before is not None:
             # unconditionally: a recompile that fuses ZERO kernels (gate
             # turned off, shapes fell back) must not leave a stale count
@@ -754,3 +766,37 @@ class FusedTrainStep:
             for t, v in zip(holder.get("aux_targets", ()), aux_new):
                 t._write(v)
         return nd.from_jax(loss_mean, ctx=ctx)
+
+    def _maybe_aot(self, jitted, step_args, sig, fmt_key):
+        """Route this program's COMPILE through the persistent AOT cache
+        (ISSUE 11): lower() runs the trace either way (the holder metadata
+        needs it), the XLA compile is skipped on a warm cache. A program
+        that does not serialize is counted and left on the plain jit path
+        — never an error. The executable is pinned to its input signature;
+        a later shape change dispatches through the retracing jit.
+
+        Donating fused-step programs stay OFF the cache: a deserialized
+        executable with this program's many-small-donated-buffers aliasing
+        corrupts the heap on XLA:CPU (observed 2026-08-04 — repeatable
+        free() abort + value divergence after ~2 restored-exec steps,
+        while the same program compiled in-process is fine, and the
+        sharded-step / serve donated programs restore cleanly). Pass
+        donate=False to FusedTrainStep to opt a deployment into the
+        cold-start win; the skip is counted."""
+        from ..compiler.cache import (aot_cache, cache_key, hlo_hash,
+                                      load_or_compile)
+        if not aot_cache().enabled:
+            return
+        if self._donate:
+            _telem.inc("compiler.cache.skipped_donated")
+            return
+        try:
+            lowered = jitted.lower(*step_args)
+            key = cache_key(kind="fused_train_step", hlo=hlo_hash(lowered))
+            ex, restored = load_or_compile(key, lambda: lowered,
+                                           "fused_step")
+            if restored:
+                _telem.inc("fused_step.aot_restored")
+            self._aot_progs[fmt_key] = (ex, sig)
+        except Exception:  # noqa: BLE001 — cache is best-effort by contract
+            _telem.inc("compiler.cache.unusable")

@@ -41,8 +41,12 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=None, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, compile_graph=None):
         self.symbol = symbol
+        # whole-graph compiler knob, threaded to every executor's bind
+        # (ISSUE 11): None = the MXNET_TPU_WHOLE_GRAPH gate; identical
+        # batch slices share ONE compiled program through the process memo
+        self.compile_graph = compile_graph
         self.contexts = contexts
         self.workload = workload or [1] * len(contexts)
         self.param_names = param_names
@@ -89,6 +93,7 @@ class DataParallelExecutorGroup:
             shapes = {k: (nslice,) + tuple(v[1:])
                       for k, v in input_shapes.items()}
             exec_ = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
+                                            compile_graph=self.compile_graph,
                                             **shapes)
             self.execs.append(exec_)
         # grouped views over per-exec arrays

@@ -28,8 +28,14 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
                  fixed_param_names=None, state_names=None,
-                 group2ctxs=None, compression_params=None):
+                 group2ctxs=None, compression_params=None,
+                 compile_graph=None):
         super().__init__(logger=logger)
+        # whole-graph compiler (ISSUE 11): True/False pins the compiled
+        # fast path on/off for this module's executors; None defers to the
+        # MXNET_TPU_WHOLE_GRAPH gate (default on, counted op-by-op
+        # fallback on unsupported graphs)
+        self._compile_graph = compile_graph
         if context is None:
             context = ctx_mod.cpu()
         if isinstance(context, ctx_mod.Context):
@@ -221,7 +227,8 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group=None,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req, state_names=self._state_names)
+            grad_req=grad_req, state_names=self._state_names,
+            compile_graph=self._compile_graph)
         if shared_module is not None:
             self.params_initialized = True
             self._arg_params = shared_module._arg_params

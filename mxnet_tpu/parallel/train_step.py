@@ -145,6 +145,13 @@ class ShardedTrainStep:
         self._remat = remat
         self._compiled = None
         self._param_specs = None
+        # AOT-cached executable (ISSUE 11): when MXNET_TPU_AOT_CACHE is
+        # set, the first program is lowered once, keyed by its HLO hash,
+        # and the *compile* is skipped on a cache hit. A later batch-
+        # signature change routes through the plain jit (which retraces),
+        # never the fixed-shape executable.
+        self._aot = None
+        self._aot_sig = None
 
     # ------------------------------------------------------------------
     def init(self):
@@ -338,9 +345,14 @@ class ShardedTrainStep:
                         _retrace_reason((True, sig), (True, prev)))
         if self._compiled is None:
             _telem.inc("train_step.compile")
-            _telem.note_compile("ShardedTrainStep")
             self._batch_proto = batch
             self._compiled = self._build(params, opt_state)
+            self._aot = self._maybe_aot(params, opt_state, batch, step_num,
+                                        sig)
+            if self._aot is not None:
+                return self._aot(params, opt_state, batch,
+                                 jnp.asarray(step_num, jnp.int32))
+            _telem.note_compile("ShardedTrainStep")
             if _telem.ENABLED:
                 # ISSUE 10 dispatch observability: Pallas call sites count
                 # ops.pallas.dispatch while the first call TRACES this
@@ -355,8 +367,48 @@ class ShardedTrainStep:
                     "train_step.pallas_kernels",
                     _telem.counter("ops.pallas.dispatch").value - before)
                 return out
+        if self._aot is not None and sig == self._aot_sig:
+            return self._aot(params, opt_state, batch,
+                             jnp.asarray(step_num, jnp.int32))
         return self._compiled(params, opt_state, batch,
                               jnp.asarray(step_num, jnp.int32))
+
+    def _maybe_aot(self, params, opt_state, batch, step_num, sig):
+        """Lower the first program and route its COMPILE through the
+        persistent AOT cache: a warm cache (restarted elastic worker, a
+        fleet sibling) skips XLA and loads the serialized executable.
+        Returns the executable, or None when the cache is off or the
+        program does not serialize (counted, never raised)."""
+        from ..compiler.cache import (aot_cache, cache_key, hlo_hash,
+                                      load_or_compile)
+        if not aot_cache().enabled:
+            return None
+        try:
+            before = _telem.counter("ops.pallas.dispatch").value \
+                if _telem.ENABLED else 0
+            lowered = self._compiled.lower(params, opt_state, batch,
+                                           jnp.asarray(step_num, jnp.int32))
+            if _telem.ENABLED:
+                # the trace just ran inside lower(): the dispatch delta is
+                # the kernel count, same meaning as the first-call gauge
+                _telem.set_gauge(
+                    "train_step.pallas_kernels",
+                    _telem.counter("ops.pallas.dispatch").value - before)
+            key = cache_key(
+                kind="sharded_train_step", hlo=hlo_hash(lowered),
+                mesh={"axes": list(self.mesh.axis_names),
+                      "shape": list(self.mesh.devices.shape)})
+            ex, restored = load_or_compile(key, lambda: lowered,
+                                           "ShardedTrainStep")
+            if restored:
+                _telem.inc("train_step.aot_restored")
+            else:
+                _telem.note_compile("ShardedTrainStep")
+            self._aot_sig = sig
+            return ex
+        except Exception:  # noqa: BLE001 — cache is best-effort by contract
+            _telem.inc("compiler.cache.unusable")
+            return None
 
     def lower_text(self, params, opt_state, batch):
         """StableHLO text of the compiled step (for inspection/tests)."""

@@ -101,34 +101,63 @@ class ServePrograms:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             self.pool.pools)
 
+    def _cache_key(self, kind, **extra):
+        """AOT-cache signature for one serve executable: model geometry +
+        pool geometry + param avals (+ versions, folded in by cache_key).
+        Param VALUES stay out — executables are value-independent."""
+        import dataclasses
+
+        from ..compiler.cache import avals_sig, cache_key
+        cfg = (dataclasses.asdict(self.cfg)
+               if dataclasses.is_dataclass(self.cfg) else repr(self.cfg))
+        return cache_key(
+            kind="serve.%s" % kind, cfg=cfg,
+            block_size=self.pool.block_size, max_batch=self.max_batch,
+            blocks_per_stream=self.blocks_per_stream,
+            params=avals_sig(self.params), pools=avals_sig(self.pool.pools),
+            **extra)
+
+    def _compile_or_restore(self, jitted, avals, kind, key, name):
+        """One serve executable: AOT-cache hit restores the serialized
+        binary (zero fresh compiles — the fleet cold-start win); miss
+        lowers+compiles and stores it for the next replica. Either way the
+        compile ring records the program, tagged cached vs fresh."""
+        from ..compiler.cache import load_or_compile
+        label = "serve.%s" % name
+        t0 = time.perf_counter()
+        ex, restored = load_or_compile(
+            key, lambda: jitted.lower(self.params, self._pool_avals(),
+                                      *avals),
+            label, meta={"kind": kind})
+        if not restored:
+            _telem.inc("serve.compile")
+            _telem.observe("serve.compile_ms",
+                           (time.perf_counter() - t0) * 1e3)
+            _telem.note_compile(label)
+        return ex
+
     def _compile_prefill(self, bucket):
         i32 = jax.numpy.int32
-        t0 = time.perf_counter()
-        ex = self._prefill_jit.lower(
-            self.params, self._pool_avals(),
-            jax.ShapeDtypeStruct((bucket,), i32),
-            jax.ShapeDtypeStruct((), i32),
-            jax.ShapeDtypeStruct((bucket // self.pool.block_size,), i32),
-        ).compile()
-        _telem.inc("serve.compile")
-        _telem.observe("serve.compile_ms", (time.perf_counter() - t0) * 1e3)
-        _telem.note_compile("serve.prefill[S=%d]" % bucket)
+        ex = self._compile_or_restore(
+            self._prefill_jit,
+            (jax.ShapeDtypeStruct((bucket,), i32),
+             jax.ShapeDtypeStruct((), i32),
+             jax.ShapeDtypeStruct((bucket // self.pool.block_size,), i32)),
+            "prefill", self._cache_key("prefill", bucket=bucket),
+            "prefill[S=%d]" % bucket)
         self._prefill_exec[bucket] = ex
         return ex
 
     def _compile_decode(self):
         i32 = jax.numpy.int32
-        t0 = time.perf_counter()
-        ex = self._decode_jit.lower(
-            self.params, self._pool_avals(),
-            jax.ShapeDtypeStruct((self.max_batch,), i32),
-            jax.ShapeDtypeStruct((self.max_batch,), i32),
-            jax.ShapeDtypeStruct((self.max_batch, self.blocks_per_stream),
-                                 i32),
-        ).compile()
-        _telem.inc("serve.compile")
-        _telem.observe("serve.compile_ms", (time.perf_counter() - t0) * 1e3)
-        _telem.note_compile("serve.decode[B=%d]" % self.max_batch)
+        ex = self._compile_or_restore(
+            self._decode_jit,
+            (jax.ShapeDtypeStruct((self.max_batch,), i32),
+             jax.ShapeDtypeStruct((self.max_batch,), i32),
+             jax.ShapeDtypeStruct((self.max_batch, self.blocks_per_stream),
+                                  i32)),
+            "decode", self._cache_key("decode"),
+            "decode[B=%d]" % self.max_batch)
         self._decode_exec = ex
         return ex
 

@@ -3,9 +3,17 @@
 TPU-native analog of reference src/executor/graph_executor.cc via
 python/mxnet/executor.py. `forward` evaluates the graph through NDArray ops
 under autograd (recording when is_train), `backward` replays the tape into
-the bound grad arrays. Memory planning / op fusion (PlanMemory, bulk exec)
-are XLA's job; a jitted fast path is available via `hybridize`-style caching
-in CachedOp, which Module uses for its hot loop.
+the bound grad arrays.
+
+Whole-graph fast path (ISSUE 11): when `MXNET_TPU_WHOLE_GRAPH` is on (the
+default), forward/backward dispatch ONE compiled program for the entire
+graph — `mx.compiler.GraphProgram` lowers the Symbol through the
+graph-pass pipeline and `lower().compile()`s it once (forward, or
+forward+backward for training), replacing the per-op dispatch loop.
+Anything the pipeline cannot lower (random ops, unknown ops, AMP-wrapped
+dispatch) falls back to the op-by-op path below with a counted reason
+(`compiler.fallback.<reason>`) — never an error. Memory planning / op
+fusion (PlanMemory, bulk exec) remain XLA's job either way.
 """
 from __future__ import annotations
 
@@ -13,7 +21,8 @@ import numpy as _np
 
 from .. import autograd
 from .. import ndarray as nd
-from ..base import MXNetError
+from .. import telemetry as _telem
+from ..base import MXNetError, get_env
 
 __all__ = ["Executor"]
 
@@ -22,7 +31,7 @@ class Executor:
     """reference: python/mxnet/executor.py (Executor)."""
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, compile_graph=None):
         self._symbol = symbol
         self._ctx = ctx
         self._arg_names = symbol.list_arguments()
@@ -64,6 +73,113 @@ class Executor:
         self._output_names = symbol.list_outputs()
         self._recorded_heads = None
 
+        # whole-graph compiler state: None = not yet tried, a GraphProgram
+        # once built, and `_wg_failed` carries the counted fallback reason
+        # that pins this executor to the op-by-op path
+        self._compile_graph = compile_graph
+        self._wg_program = None
+        self._wg_failed = None
+        self._wg_grads = None       # name -> raw cotangent, set by fwdbwd
+        self._wg_raws = None        # inputs of the last wg training forward
+
+    # ------------------------------------------------------------------
+    # whole-graph fast path (mx.compiler)
+    # ------------------------------------------------------------------
+    def _wg_enabled(self):
+        if self._compile_graph is not None:
+            return bool(self._compile_graph)
+        return bool(get_env("MXNET_TPU_WHOLE_GRAPH"))
+
+    def _wg_fallback(self, reason):
+        """Pin this executor to op-by-op dispatch, with the reason counted
+        (`compiler.fallback.<reason>`) — the never-erroring contract."""
+        self._wg_failed = reason
+        self._wg_program = None
+        _telem.inc("compiler.fallback")
+        _telem.inc("compiler.fallback.%s" % reason)
+
+    def _wg_inputs(self):
+        """Flat raw inputs in the program's positional order (args then
+        aux), read at call time so `forward(**kwargs)` updates and
+        `copy_params_from` are visible."""
+        raws = [self.arg_dict[n]._read() for n in self._arg_names]
+        raws += [self.aux_dict[n]._read() for n in self._aux_names]
+        return tuple(raws)
+
+    def _wg_wanted(self):
+        """(names, flat-input indices) of arguments whose gradient the
+        bound grad_req asks for — the same condition the op-by-op path
+        uses to mark variables."""
+        names, idx = [], []
+        for i, n in enumerate(self._arg_names):
+            if self._grad_req.get(n, "null") != "null" and \
+                    self.grad_dict.get(n) is not None:
+                names.append(n)
+                idx.append(i)
+        return names, idx
+
+    def _wg_forward(self, is_train):
+        from .. import compiler as _compiler
+        from ..ndarray.ndarray import _AMP_WRAP
+        if _AMP_WRAP is not None:
+            # AMP wraps op fns per-dispatch; the emitted program would
+            # bypass the casts — stay op-by-op while AMP is active
+            raise _compiler.UnsupportedGraphError("amp_active")
+        for arr in list(self.arg_dict.values()) + \
+                list(self.aux_dict.values()) + list(self.grad_dict.values()):
+            if arr is not None and \
+                    getattr(arr, "_stype", "default") != "default":
+                # row-sparse grads (Embedding sparse_grad) and sparse
+                # inputs keep their storage-aware op-by-op path
+                raise _compiler.UnsupportedGraphError("sparse_storage")
+        if self._wg_program is None:
+            self._wg_program = _compiler.GraphProgram(
+                self._symbol,
+                on_tpu=self._ctx.device_type in ("gpu", "tpu"),
+                label=self._symbol.name)
+        prog = self._wg_program
+        raws = self._wg_inputs()
+        names, idx = self._wg_wanted() if is_train else ([], [])
+        if is_train and names:
+            outs, grads = prog.run_fwd_bwd(raws, idx)
+            self._wg_grads = dict(zip(names, grads))
+            self._wg_raws = raws
+        else:
+            outs = prog.run_forward(raws)
+            self._wg_grads = None
+            self._wg_raws = None
+        self.outputs = [nd.from_jax(o, ctx=self._ctx) for o in outs]
+        self._recorded_heads = self.outputs if is_train else None
+        return self.outputs
+
+    def _wg_backward(self, out_grads):
+        """Write the program-computed gradients into the bound grad
+        arrays, honoring grad_req write vs add — the same application
+        `autograd.backward` performs for the op-by-op tape."""
+        grads = self._wg_grads
+        if out_grads is not None:
+            # rare path: user-supplied head cotangents — rerun as ONE
+            # combined program with the cotangents as inputs
+            if isinstance(out_grads, nd.NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._read() if isinstance(g, nd.NDArray) else g
+                         for g in out_grads)
+            names, idx = self._wg_wanted()
+            _, flat = self._wg_program.run_fwd_bwd(self._wg_raws, idx,
+                                                   head_cots=cots)
+            grads = dict(zip(names, flat))
+        for name, cot in grads.items():
+            buf = self.grad_dict.get(name)
+            if buf is None:
+                continue
+            cot = cot.astype(buf.dtype)
+            if self._grad_req.get(name) == "add":
+                buf._write(buf._read() + cot)
+            else:
+                buf._write(cot)
+        self._wg_grads = None
+        self._wg_raws = None
+
     def forward(self, is_train=False, **kwargs):
         """reference: Executor.forward — kwargs update bound args first."""
         for name, val in kwargs.items():
@@ -74,6 +190,15 @@ class Executor:
                 val.copyto(dst)
             else:
                 dst[:] = val
+
+        if self._wg_enabled() and self._wg_failed is None:
+            from ..compiler import UnsupportedGraphError
+            try:
+                return self._wg_forward(is_train)
+            except UnsupportedGraphError as e:
+                self._wg_fallback(e.reason)
+            except Exception as e:  # noqa: BLE001 — counted, never raised
+                self._wg_fallback("error:%s" % type(e).__name__)
 
         feed = dict(self.arg_dict)
         feed.update(self.aux_dict)
@@ -98,6 +223,8 @@ class Executor:
         """reference: Executor.backward."""
         if self._recorded_heads is None:
             raise MXNetError("backward called before forward(is_train=True)")
+        if self._wg_grads is not None:
+            return self._wg_backward(out_grads)
         if out_grads is None:
             head_grads = None
         else:
@@ -152,7 +279,8 @@ class Executor:
             new_aux[name] = old if tuple(old.shape) == tuple(sh) else \
                 nd.zeros(sh, ctx=self._ctx, dtype=old.dtype)
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self._grad_req, new_aux)
+                        self._grad_req, new_aux,
+                        compile_graph=self._compile_graph)
 
     @property
     def output_dict(self):

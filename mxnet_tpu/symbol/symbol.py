@@ -472,14 +472,19 @@ class Symbol:
         return out if isinstance(out, list) else [out]
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, shared_exec=None):
-        """reference: Symbol.bind → Executor."""
+             aux_states=None, group2ctx=None, shared_exec=None,
+             compile_graph=None):
+        """reference: Symbol.bind → Executor. `compile_graph` pins the
+        whole-graph compiler on/off for this executor (None = the
+        MXNET_TPU_WHOLE_GRAPH gate)."""
         from .executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        compile_graph=compile_graph)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
-                    shared_exec=None, shared_buffer=None, **kwargs):
+                    shared_exec=None, shared_buffer=None, compile_graph=None,
+                    **kwargs):
         """Allocate arrays from inferred shapes and bind.
         reference: Symbol.simple_bind → MXExecutorSimpleBindEx."""
         from .executor import Executor
@@ -511,7 +516,8 @@ class Symbol:
         if grad_req != "null":
             args_grad = {name: nd.zeros(a.shape, ctx=ctx, dtype=a.dtype)
                          for name, a in args.items()}
-        return Executor(self, ctx, args, args_grad, grad_req, aux)
+        return Executor(self, ctx, args, args_grad, grad_req, aux,
+                        compile_graph=compile_graph)
 
     # ------------------------------------------------------------------
     # serialization (reference: nnvm src/pass/saveload_json.cc)

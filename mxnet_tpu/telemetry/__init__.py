@@ -72,7 +72,8 @@ from . import memory as _memory
 
 __all__ = ["enabled", "enable", "disable", "registry", "counter", "gauge",
            "histogram", "inc", "set_gauge", "observe", "span", "record_span",
-           "snapshot", "reset", "dumps", "dump", "dump_trace", "span_events",
+           "snapshot", "compile_report", "reset", "dumps", "dump",
+           "dump_trace", "span_events",
            "aggregate_snapshot", "merge_snapshots", "aggregate_trace",
            "sample_memory", "maybe_sample_memory",
            "note_compile", "recent_compiles", "device_report",
@@ -299,6 +300,17 @@ def maybe_sample_memory():
 # ---------------------------------------------------------------- export
 def snapshot():
     return registry.snapshot()
+
+
+def compile_report():
+    """Metric snapshot + the recent-compiles ring as ONE json-able dict —
+    the input `tools/parse_log.py --compile` tabulates (compiler/cache
+    counters, lower/compile latency, fallbacks by reason, and WHICH
+    executables were built, tagged [cached] vs fresh)."""
+    report = snapshot()
+    report["recent_compiles"] = [[name, round(ts, 6)]
+                                 for name, ts in recent_compiles()]
+    return report
 
 
 def reset():

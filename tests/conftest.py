@@ -98,6 +98,7 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "obs: observability endpoint tests (live /metrics HTTP server on localhost)")
     config.addinivalue_line("markers", "serve: serving-engine tests (continuous batching, paged KV cache, replica supervision)")
     config.addinivalue_line("markers", "pallas: Pallas kernel parity tests (CPU backend runs the real kernels through the interpreter — parity evidence only, never perf evidence)")
+    config.addinivalue_line("markers", "compiler: whole-graph symbolic compiler + AOT executable cache tests (run alone with -m compiler)")
 
 
 @pytest.fixture(autouse=True)

@@ -446,6 +446,81 @@ def _print_kernels(rows, fmt):
         print(line % r)
 
 
+def parse_compile(obj):
+    """Extract the whole-graph-compiler / AOT-cache story (ISSUE 11):
+    how many graphs lowered and compiled, what the graph passes removed,
+    cache hits/misses/writes/corruption, which executors fell back to
+    op-by-op dispatch and WHY, plus per-site compile counters and the
+    lower/compile latency histograms. Accepts a telemetry JSON dump, a
+    `telemetry.compile_report()` dict (adds the recent-compiles ring
+    rows), or a `BENCH=startup` row. Returns [(kind, name, value)]."""
+    rows = []
+    if "startup_cold_s" in obj or obj.get("metric") == "startup_warm_s":
+        for k in ("metric", "value", "startup_cold_s", "startup_warm_s",
+                  "compile_count_cold", "compile_count_warm",
+                  "cache_hits_warm", "vs_baseline"):
+            if k in obj:
+                rows.append(("bench", k, obj[k]))
+        return rows
+    ring = obj.get("recent_compiles")
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    counters = obj.get("counters", {})
+    for name in ("compiler.lower", "compiler.compile",
+                 "compiler.program_runs"):
+        if name in counters:
+            rows.append(("compiler", name.split(".", 1)[1], counters[name]))
+    for name in sorted(counters):
+        if name.startswith("compiler.pass."):
+            rows.append(("pass", name[len("compiler.pass."):],
+                         counters[name]))
+    for name in ("hits", "misses", "writes", "corrupt", "evictions",
+                 "serialize_error", "write_error", "unusable",
+                 "skipped_donated"):
+        full = "compiler.cache." + name
+        if full in counters:
+            rows.append(("cache", name, counters[full]))
+    if "compiler.fallback" in counters:
+        rows.append(("fallback", "total", counters["compiler.fallback"]))
+    for name in sorted(counters):
+        if name.startswith("compiler.fallback."):
+            rows.append(("fallback", name[len("compiler.fallback."):],
+                         counters[name]))
+    for site in ("cachedop.compile", "fused_step.compile",
+                 "train_step.compile", "serve.compile", "cachedop.retrace",
+                 "fused_step.retrace", "train_step.retrace", "serve.retrace",
+                 "train_step.aot_restored", "fused_step.aot_restored"):
+        if site in counters:
+            rows.append(("site", site, counters[site]))
+    for hname in ("compiler.lower_ms", "compiler.compile_ms",
+                  "compiler.cache.load_ms", "compiler.cache.store_ms"):
+        h = obj.get("histograms", {}).get(hname)
+        if isinstance(h, dict) and h.get("count"):
+            rows.append(("latency", hname + "_avg",
+                         round(h.get("sum", 0.0) / h["count"], 3)))
+            rows.append(("latency", hname + "_max", h.get("max")))
+    if ring:
+        for name, ts in ring:
+            rows.append(("ring", name, ts))
+    return rows
+
+
+def _print_compile(rows, fmt):
+    if not rows:
+        print("no compiler.* counters in this dump (whole-graph compiler "
+              "never ran, or telemetry disabled)", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| kind | name | value |")
+        print("| --- | --- | --- |")
+        line = "| %s | %s | %s |"
+    else:
+        print("kind,name,value")
+        line = "%s,%s,%s"
+    for r in rows:
+        print(line % r)
+
+
 # severity ordering for the lint table: errors first, then by location
 _LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
 
@@ -547,6 +622,15 @@ def main():
                              "counts by kernel/reason, per-program fused-"
                              "kernel gauges, fused-update latency, and "
                              "bytes ratios from BENCH=fused_* rows")
+    parser.add_argument("--compile", dest="compile_mode",
+                        action="store_true",
+                        help="compiler mode: whole-graph lower/compile "
+                             "counters, graph-pass stats, AOT-cache "
+                             "hits/misses/corruption, op-by-op fallbacks "
+                             "by reason, and the recent-compiles ring "
+                             "from a telemetry JSON dump / "
+                             "telemetry.compile_report() / BENCH=startup "
+                             "row")
     parser.add_argument("--anomalies", action="store_true",
                         help="anomaly mode: telemetry.anomaly.* counters + "
                              "step-time histograms from a telemetry JSON "
@@ -554,6 +638,12 @@ def main():
                              "or SLO?")
     args = parser.parse_args()
     obj = _load_json(args.logfile)
+    if args.compile_mode:
+        if obj is None:
+            sys.exit("--compile input is not a JSON object: %s"
+                     % args.logfile)
+        _print_compile(parse_compile(obj), args.format)
+        return
     if args.serve:
         if obj is None:
             sys.exit("--serve input is not a JSON object: %s" % args.logfile)
