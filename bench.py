@@ -595,14 +595,25 @@ def bench_comm(on_accel):
             telemetry.reset()
             t0 = time.perf_counter()
             for _ in range(steps):
+                # one cat-`step` span per sync: the window the overlap
+                # profiler (telemetry.attribution) decomposes
+                ts = telemetry.span_clock()
+                s0 = time.perf_counter()
                 kv.pushpull(keys, grads, out=outs)
+                telemetry.record_span("comm.step", "step", ts,
+                                      time.perf_counter() - s0)
             _sync(outs[0][0].data_jax)
             dt = (time.perf_counter() - t0) / steps
             snap = telemetry.snapshot()["counters"]
-            return dt, snap
+            ovl = telemetry.overlap_report(site="comm.step")["summary"]
+            return dt, snap, ovl
 
-    dt_bucket, snap = run(None)       # env/default cap
-    dt_flat, _ = run(0)               # per-param escape hatch
+    dt_bucket, snap, ovl = run(None)  # env/default cap
+    dt_flat, _, ovl_flat = run(0)     # per-param escape hatch
+    # the decomposition is a partition: it must sum to step time (the
+    # acceptance's 5% bound holds by construction; report the residue)
+    parts = (ovl["compute_ms"] + ovl["collective_ms"] + ovl["host_ms"]
+             + ovl["idle_ms"])
     payload = {
         "metric": ("comm_grad_sync_mb_per_sec" if on_accel
                    else "comm_grad_sync_cpu_mb_per_sec"),
@@ -613,6 +624,16 @@ def bench_comm(on_accel):
         "collectives_per_step": snap.get("comm.collectives", 0) // steps,
         "comm_bucket_bytes": snap.get("comm.bucket.bytes", 0) // steps,
         "comm_bucket_count": snap.get("comm.bucket.count", 0) // steps,
+        # measured comm-overlap attribution (ROADMAP #4's autotuner input):
+        # bucketed vs per-param overlap fraction + exposed collective ms
+        "overlap_frac": ovl["overlap_frac"],
+        "overlap_frac_flat": ovl_flat["overlap_frac"],
+        "collective_ms_per_step": round(ovl["collective_ms"] / steps, 3),
+        "collective_ms_per_step_flat":
+            round(ovl_flat["collective_ms"] / steps, 3),
+        "decomp_residue_pct": round(
+            100.0 * abs(ovl["step_ms"] - parts) / max(ovl["step_ms"],
+                                                      1e-9), 4),
     }
     return payload
 
@@ -920,6 +941,16 @@ def bench_obs(on_accel):
         p99_us = lat_us[min(len(lat_us) - 1, int(0.99 * len(lat_us)))]
         q = telemetry.step_quantiles("fused_step") or {}
         step_p50_ms = q.get("p50") or float("nan")
+        # federation scrape overhead: /fleet/snapshot with no peers is the
+        # local-only fleet view — the fixed cost of the proxy path itself
+        # (collect + merge + serialize), before any network fan-out
+        fleet_url = "http://127.0.0.1:%d/fleet/snapshot" % server.port
+        fleet_us = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            urllib.request.urlopen(fleet_url, timeout=5).read()
+            fleet_us.append((time.perf_counter() - t0) * 1e6)
+        fleet_us.sort()
         return {
             "metric": ("obs_scrape_p50_us" if on_accel
                        else "obs_cpu_scrape_p50_us"),
@@ -932,9 +963,79 @@ def bench_obs(on_accel):
             "step_ms_p50": round(q.get("p50", 0.0), 3),
             "step_ms_p99": round(q.get("p99", 0.0), 3),
             "scrapes": len(lat_us),
+            "fleet_scrape_p50_us": round(fleet_us[len(fleet_us) // 2], 1),
+            **_bench_request_trace_overhead(),
         }
     finally:
         export.stop_http_server()
+
+
+def _bench_request_trace_overhead():
+    """Per-request tracing overhead (the ISSUE 12 acceptance ceiling:
+    <= 2% of serve tokens/s): the same tiny-llama traffic served with
+    request tracing ON (default) and OFF (MXNET_TPU_SERVE_TRACE=0);
+    reports both rates and the relative cost."""
+    import dataclasses
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models.llama import CONFIGS, llama_init
+
+    cfg = dataclasses.replace(CONFIGS["llama_tiny"], dtype=jnp.float32,
+                              max_seq_len=64)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+
+    def run(trace_on):
+        prev = os.environ.get("MXNET_TPU_SERVE_TRACE")
+        os.environ["MXNET_TPU_SERVE_TRACE"] = "1" if trace_on else "0"
+        try:
+            telemetry.reset()
+            server = mx.serve.InferenceServer(
+                params, cfg, max_batch=4, kv_blocks=64, block_size=8,
+                max_context=48, queue_cap=32)
+            server.warmup()
+            rng = np.random.RandomState(0)
+            prompts = [rng.randint(1, cfg.vocab_size - 1,
+                                   size=rng.randint(4, 12)).tolist()
+                       for _ in range(10)]
+            handles = [server.submit(mx.serve.Request(p, max_new_tokens=16))
+                       for p in prompts]
+            t0 = time.perf_counter()
+            server.run()
+            dt = time.perf_counter() - t0
+            toks = sum(len(h.result(timeout=60)) for h in handles)
+            return toks / dt
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_TPU_SERVE_TRACE", None)
+            else:
+                os.environ["MXNET_TPU_SERVE_TRACE"] = prev
+
+    # cold-start and scheduling noise on the CPU smoke row dwarfs the
+    # per-token mark cost: warm both modes once, then interleave pairs
+    # and compare MEDIANS (the first measured attempt was order-biased
+    # by a cold first run)
+    import statistics
+    run(True)
+    run(False)
+    traced_runs, untraced_runs = [], []
+    for i in range(3):
+        if i % 2 == 0:
+            traced_runs.append(run(True))
+            untraced_runs.append(run(False))
+        else:
+            untraced_runs.append(run(False))
+            traced_runs.append(run(True))
+    traced = statistics.median(traced_runs)
+    untraced = statistics.median(untraced_runs)
+    return {
+        "serve_tok_s_traced": round(traced, 2),
+        "serve_tok_s_untraced": round(untraced, 2),
+        "request_trace_overhead_pct": round(
+            max(0.0, (untraced - traced) / untraced * 100.0), 3),
+    }
 
 
 def _probe_backend(timeout=240):

@@ -58,9 +58,21 @@ __all__ = ["bulk", "set_bulk_size", "DEFAULT_BUCKET_MB", "bucket_bytes",
            "set_bucket_mb", "bucket_mb_scope", "Bucket", "GradBucketer",
            "bucketize", "fused_bucket_fn", "pack_bucket", "unpack_bucket",
            "reassociate_bucketed", "BucketSpec", "BucketLayout",
-           "pack_flat", "unpack_flat"]
+           "pack_flat", "unpack_flat", "SPAN_CAT_COMM", "comm_span_name"]
 
 _BULK_SIZE = 15  # the reference default (MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN)
+
+# the comm trace-span vocabulary: every launched comm program records ONE
+# span under cat `comm` with one of these name shapes, which is the whole
+# contract telemetry.attribution's overlap profiler needs (no per-site
+# instrumentation beyond the span itself)
+SPAN_CAT_COMM = "comm"
+
+
+def comm_span_name(key_range, kind="bucket"):
+    """`comm.<kind>[<key-range>]` — bucket launches use kind="bucket",
+    the per-key escape hatch "key", ZeRO's scatter/gather legs "rs"/"ag"."""
+    return "comm.%s[%s]" % (kind, key_range)
 
 
 def set_bulk_size(size):
@@ -153,6 +165,13 @@ class Bucket:
         if len(self.keys) == 1:
             return str(self.keys[0])
         return "%s..%s" % (self.keys[0], self.keys[-1])
+
+    def span_name(self):
+        """The canonical trace-span name every comm call site records for
+        this bucket's launch (`comm.bucket[k0..kN]`, cat ``comm``) — ONE
+        spelling, so `telemetry.attribution` and `parse_log --overlap`
+        match launches without per-call-site knowledge."""
+        return comm_span_name(self.key_range())
 
     def __repr__(self):
         return ("Bucket(keys=[%s], %d arrays, %d bytes, %s, reason=%s)"
@@ -346,6 +365,11 @@ class BucketSpec:
         if len(self.keys) == 1:
             return str(self.keys[0])
         return "%s..%s" % (self.keys[0], self.keys[-1])
+
+    def span_name(self, kind="bucket"):
+        """Canonical comm span name for this bucket's launches (ZeRO's
+        reduce-scatter / all-gather legs pass kind="rs"/"ag")."""
+        return comm_span_name(self.key_range(), kind)
 
     def nbytes(self):
         return self.size * self.dtype.itemsize

@@ -254,7 +254,15 @@ class KVStoreLocal(KVStore):
                 return
         inject = _faults.active_plan() is not None
         for k, v in zip(keys, values):
+            # per-key comm span (the escape-hatch analog of the per-bucket
+            # span): with bucketing off, N of these per step are the
+            # serialized launches attribution's overlap profiler indicts
+            ts = _telem.span_clock()
+            t0 = time.perf_counter()
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
+            _telem.record_span(_engine.comm_span_name(str(k), "key"),
+                               _engine.SPAN_CAT_COMM, ts,
+                               time.perf_counter() - t0)
             k = str(k)
             stored = self._store[k]
             _telem.inc("comm.collectives")
@@ -344,7 +352,7 @@ class KVStoreLocal(KVStore):
         ts = _telem.span_clock()
         t0 = time.perf_counter()
         parts = fn(*raws)
-        _telem.record_span("comm.bucket[%s]" % bucket.key_range(), "comm",
+        _telem.record_span(bucket.span_name(), _engine.SPAN_CAT_COMM,
                            ts, time.perf_counter() - t0)
         return parts
 

@@ -337,7 +337,15 @@ class KVStoreDist(KVStoreLocal):
             k = str(k)
             stored = self._store[k]
             try:
+                # one comm span per key: flat (unbucketed) dist sync is
+                # exactly the serialized-launch case overlap attribution
+                # must be able to indict
+                ts = _telem.span_clock()
+                t0 = time.perf_counter()
                 self._push_one(k, merged, stored)
+                _telem.record_span(_engine.comm_span_name(k, "key"),
+                                   _engine.SPAN_CAT_COMM, ts,
+                                   time.perf_counter() - t0)
             except ResilienceError:
                 raise  # already carries key/shard/attempt context
             except Exception as exc:
@@ -437,8 +445,8 @@ class KVStoreDist(KVStoreLocal):
             t0 = time.perf_counter()
             summed = self._allreduce_compressed(
                 flat, "__bucket__%d" % spec.index)
-            _telem.record_span("comm.bucket[%s]" % spec.key_range(),
-                               "comm", ts, time.perf_counter() - t0)
+            _telem.record_span(spec.span_name(), _engine.SPAN_CAT_COMM,
+                               ts, time.perf_counter() - t0)
             for k, part in zip(spec.keys, _engine.unpack_flat(spec, summed)):
                 stored = self._store[k]
                 val = nd.from_jax(part, ctx=stored.context)
@@ -474,8 +482,8 @@ class KVStoreDist(KVStoreLocal):
             ts = _telem.span_clock()
             t0 = time.perf_counter()
             summed = self._allreduce(flat, context=context)
-            _telem.record_span("comm.bucket[%s]" % bucket.key_range(),
-                               "comm", ts, time.perf_counter() - t0)
+            _telem.record_span(bucket.span_name(), _engine.SPAN_CAT_COMM,
+                               ts, time.perf_counter() - t0)
             parts = _engine.unpack_bucket(bucket, summed)
             for k, part in zip(bucket.keys, parts):
                 stored = self._store[k]

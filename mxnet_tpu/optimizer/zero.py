@@ -294,7 +294,7 @@ class ZeroUpdater:
             t0 = time.perf_counter()
             g_shard = call_with_retry(
                 scatter, site="collective.reduce_scatter", context=context)
-            _telem.record_span("comm.rs[%s]" % spec.key_range(), "comm",
+            _telem.record_span(spec.span_name("rs"), _engine.SPAN_CAT_COMM,
                                ts, time.perf_counter() - t0)
             new_w = self._fused_shard_update(spec, g_shard, clip)
 
@@ -308,7 +308,7 @@ class ZeroUpdater:
             t0 = time.perf_counter()
             full = call_with_retry(
                 gather, site="collective.all_gather", context=context)
-            _telem.record_span("comm.ag[%s]" % spec.key_range(), "comm",
+            _telem.record_span(spec.span_name("ag"), _engine.SPAN_CAT_COMM,
                                ts, time.perf_counter() - t0)
             for k, part in zip(spec.keys,
                                _engine.unpack_flat(spec, full)):

@@ -213,7 +213,7 @@ def all_reduce_multi(arrays, mesh=None, axis=None, bucket_mb=None):
         t0 = time.perf_counter()
         parts = call_with_retry(dispatch, site="collective.all_reduce",
                                 context=context)
-        _telem.record_span("comm.bucket[%s]" % bucket.key_range(), "comm",
+        _telem.record_span(bucket.span_name(), _engine.SPAN_CAT_COMM,
                            ts, time.perf_counter() - t0)
         for idx, part in zip(bucket.keys, parts):
             out[idx] = part

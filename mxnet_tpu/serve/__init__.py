@@ -24,7 +24,12 @@ The robustness contract, end to end:
   already-emitted tokens — byte-identical output, no token lost or
   duplicated;
 * telemetry throughout: tokens/s, TTFT/TPOT histograms, queue depth and
-  KV occupancy gauges, flight-recorder ``step_event`` records.
+  KV occupancy gauges, flight-recorder ``step_event`` records (with the
+  active/completed request ids per step), and a `RequestTrace` per
+  request — queue-wait / prefill / per-token decode / recovery spans
+  tiling its wall-clock, queryable via the exporter's ``/requests``
+  endpoint (`mx.telemetry.request_traces()`), embedded in
+  ``DeadlineExceeded.request_trace``, one chrome-trace row per request.
 
 Quickstart::
 

@@ -45,8 +45,14 @@ class Overloaded(ServeError):
 
 class DeadlineExceeded(ServeError):
     """The request's deadline passed — in the queue (no tokens) or
-    mid-stream (`tokens` carries the partial output)."""
+    mid-stream (`tokens` carries the partial output).
 
-    def __init__(self, message, tokens=None):
+    `request_trace` carries the request's own timeline (the
+    `RequestTrace` payload: queue-wait vs prefill vs decode vs recovery,
+    across every replica that held it) — a shed request arrives at the
+    client with its post-mortem attached."""
+
+    def __init__(self, message, tokens=None, request_trace=None):
         super().__init__(message)
         self.tokens = list(tokens or [])
+        self.request_trace = request_trace

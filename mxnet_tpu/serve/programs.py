@@ -205,9 +205,16 @@ class ServePrograms:
         padded = np.zeros(bucket, np.int32)
         padded[:n] = tokens
         tbl = np.asarray(table, np.int32)[:bucket // self.pool.block_size]
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
         tok, pools = ex(self.params, self.pool.pools, padded,
                         np.int32(n), tbl)
         self.pool.update(pools)
+        # one span per prefill dispatch (cat `serve`): in the chrome dump
+        # the bucketed prefills line up under the serve.step row, and the
+        # attribution pass sees the serving host timeline
+        _telem.record_span("serve.prefill[S=%d]" % bucket, "serve", ts,
+                           time.perf_counter() - t0)
         return int(tok)
 
     def decode(self, tokens, positions, tables):
@@ -219,9 +226,13 @@ class ServePrograms:
         if ex is None:
             self._on_miss("decode", "decode executable missing at dispatch")
             ex = self._compile_decode()
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
         out, pools = ex(self.params, self.pool.pools,
                         np.asarray(tokens, np.int32),
                         np.asarray(positions, np.int32),
                         np.asarray(tables, np.int32))
         self.pool.update(pools)
+        _telem.record_span("serve.decode", "serve", ts,
+                           time.perf_counter() - t0)
         return np.asarray(out)

@@ -19,6 +19,7 @@ import logging
 import threading
 
 from .. import telemetry as _telem
+from ..telemetry import flight as _flight
 from .errors import ServeError
 from .scheduler import InferenceServer, RequestQueue
 
@@ -65,6 +66,12 @@ class ReplicaGroup:
             _LOG.warning("serve: %s died (%s: %s); %d replica(s) remain",
                          server.name, type(exc).__name__, exc,
                          self.alive_replicas)
+            # flight-ring event next to the serve_recover that drained the
+            # streams: the post-mortem reads death + survivor count in one
+            # place
+            _flight.note_event(
+                "serve_replica_death", "%s: %s (%d alive)"
+                % (server.name, type(exc).__name__, self.alive_replicas))
         finally:
             _telem.set_gauge("serve.replicas_alive", self.alive_replicas)
 

@@ -36,9 +36,21 @@ through the existing planes —
 Telemetry: ``serve.requests/admitted/completed/shed[.reason]/tokens/
 prefills/decode_steps/recoveries/requeued_streams/failed`` counters,
 ``serve.queue_depth`` / ``serve.batch_occupancy`` / ``serve.kv.*`` gauges,
-``serve.ttft_ms`` / ``serve.tpot_ms`` / ``serve.step_ms`` histograms, and
-``telemetry.step_event("serve.step", ms)`` per step — anomaly detection
-and the crash flight recorder cover the serving path for free.
+``serve.ttft_ms`` / ``serve.tpot_ms`` / ``serve.step_ms`` histograms, a
+``serve.step`` span per step (cat ``step`` — the attribution profiler's
+serving window), and ``telemetry.step_event("serve.step", ms)`` per step
+with the active/completed request ids — anomaly detection and the crash
+flight recorder cover the serving path for free.
+
+Per-request tracing (`telemetry.request_trace`): a `RequestTrace` is
+created at enqueue and rides the `StreamHandle` through admit → prefill →
+every decode step → completion/shed/recovery — across replica boundaries,
+since a drained stream keeps its handle. Its spans TILE the request's
+wall-clock (queue / prefill / decode / recovery.drain / recovery.queue);
+completed timelines land in the last-N ring (``/requests`` endpoint,
+``parse_log --requests``), ride ``DeadlineExceeded.request_trace``, and
+replay into chrome dumps as one row per request. Inert under
+``MXNET_TPU_TELEMETRY=0`` / ``MXNET_TPU_SERVE_TRACE=0``.
 """
 from __future__ import annotations
 
@@ -56,6 +68,7 @@ from ..resilience import watchdog as _watchdog
 from ..resilience.errors import RetriableError, RetryExhausted
 from ..resilience.retry import RetryPolicy
 from ..telemetry import flight as _flight
+from ..telemetry import request_trace as _reqtrace
 from .errors import DeadlineExceeded, Overloaded
 from .kv_cache import KVBlockPool
 from .programs import ServePrograms
@@ -104,7 +117,10 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         if self.max_new_tokens < 1:
             raise ValueError("serve: max_new_tokens must be >= 1")
-        self.request_id = request_id or uuid.uuid4().hex[:12]
+        # str-coerced: the id is joined into log lines, flight records,
+        # and recovery post-mortems, which assume string ids
+        self.request_id = (str(request_id) if request_id
+                           else uuid.uuid4().hex[:12])
         self.deadline_s = deadline_s
         self.eos_id = eos_id
         self.retries = retries
@@ -124,6 +140,11 @@ class StreamHandle:
         self.ttft_ms = None
         self.tpot_ms = []         # per-output-token latencies after the 1st
         self.requeues = 0
+        # the request's own timeline (telemetry.request_trace), created at
+        # enqueue; it lives on the HANDLE so it crosses replica boundaries
+        # with the stream — a drained request resumed on a survivor keeps
+        # ONE trace. NULL_TRACE (no-op) until submit attaches a live one.
+        self.trace = _reqtrace.NULL_TRACE
         self._done = threading.Event()
 
     def done(self):
@@ -302,6 +323,10 @@ class InferenceServer:
         # bytecodes of the prefill — must find it here, or recovery would
         # drain only _slots and silently lose the stream
         self._admitting = None
+        # request ids retired during the CURRENT step — reset at step
+        # start, embedded (with the active set) in the step's flight
+        # record so a stall post-mortem names the in-flight requests
+        self._step_completed = []
         self._default_retries = RetryPolicy().max_attempts
 
     # ------------------------------------------------------------ admission
@@ -331,6 +356,9 @@ class InferenceServer:
         _faults.check("serve.admit", context="request=%s"
                       % request.request_id)
         _telem.inc("serve.requests")
+        # the request's trace starts at enqueue: even a shed request
+        # leaves a timeline in the last-N ring (/requests)
+        trace = _reqtrace.start(request.request_id)
         # the longest context this request can ever re-prefill (a resumed
         # stream prefills prompt + all-but-one emitted budget)
         max_prefill = len(request.prompt) + request.max_new_tokens - 1
@@ -340,6 +368,7 @@ class InferenceServer:
         if (self._worst_blocks(request) > self.pool.num_blocks
                 or self.programs.bucket_for(max_prefill) is None
                 or max_prefill > self.programs.max_context):
+            trace.finish("shed.too_large", tokens=0)
             self._shed(Overloaded(
                 "request %s can never fit: prompt %d + budget %d tokens "
                 "vs pool of %d blocks x %d (max context %d)"
@@ -350,12 +379,14 @@ class InferenceServer:
                 kv_needed_blocks=self._worst_blocks(request),
                 kv_free_blocks=self.pool.free_blocks), "too_large")
         handle = StreamHandle(request)
+        handle.trace = trace
         retries = (request.retries if request.retries is not None
                    else self._default_retries)
         stream = _Stream(handle, retries_left=retries)
         try:
             self.queue.push(stream)
         except Overloaded:
+            trace.finish("shed.queue_full", tokens=0)
             self._note_shed("queue_full")
             raise
         return handle
@@ -371,16 +402,30 @@ class InferenceServer:
                 return i
         return None
 
+    def _finish_trace(self, handle, outcome):
+        """Snapshot the request's trace into the last-N ring (idempotent:
+        an earlier, more specific finish wins)."""
+        ttft = (round(handle.ttft_ms, 3) if handle.ttft_ms is not None
+                else None)
+        return handle.trace.finish(outcome, tokens=len(handle.tokens),
+                                   ttft_ms=ttft,
+                                   requeues=handle.requeues)
+
     def _retire(self, slot, stream, error=None):
         # terminal event FIRST: if an async fault lands mid-retire, the
         # stream is done-marked while still findable in its slot, and
         # _drain_stream's done() branch finishes the cleanup — the other
         # order would strand a finished stream in neither place
         if error is not None:
+            self._finish_trace(stream.handle,
+                               "deadline" if isinstance(
+                                   error, DeadlineExceeded) else "failed")
             stream.handle._fail(error)
         else:
+            self._finish_trace(stream.handle, "completed")
             _telem.inc("serve.completed")
             stream.handle._complete()
+        self._step_completed.append(stream.handle.id)
         self.pool.free(stream.kv_id)
         self._slots[slot] = None
 
@@ -389,10 +434,12 @@ class InferenceServer:
         request = stream.request
         if stream.expired(now):
             self._note_shed("deadline", stream.handle.id)
+            payload = self._finish_trace(handle, "deadline")
             self._retire(slot, stream, DeadlineExceeded(
                 "request %s missed its %.3gs deadline after %d token(s)"
                 % (request.request_id, request.deadline_s,
-                   len(handle.tokens)), tokens=handle.tokens))
+                   len(handle.tokens)), tokens=handle.tokens,
+                request_trace=payload))
             return True
         if (len(handle.tokens) >= request.max_new_tokens
                 or (request.eos_id is not None
@@ -418,10 +465,18 @@ class InferenceServer:
             self._admitting = stream = self.queue.pop(self)
             if stream is None:
                 break
+            # the wait just ended: close it on the request's timeline
+            # ("queue", or "recovery.queue" after a drain) and record
+            # which replica now holds the stream — the cross-replica hop
+            # list of a recovered request
+            trace = stream.handle.trace
+            trace.mark("queue", replica=self.name).note_replica(self.name)
             if stream.finished():
                 # a fault landed between the stream's last token and its
                 # _finish_check: it came back complete — retire it here
                 # instead of re-prefilling one token too many
+                self._finish_trace(stream.handle, "completed")
+                self._step_completed.append(stream.handle.id)
                 _telem.inc("serve.completed")
                 stream.handle._complete()
                 self._admitting = None
@@ -429,10 +484,12 @@ class InferenceServer:
             now = time.monotonic()
             if stream.expired(now):
                 self._note_shed("deadline", stream.handle.id)
+                payload = self._finish_trace(stream.handle, "deadline")
+                self._step_completed.append(stream.handle.id)
                 stream.handle._fail(DeadlineExceeded(
                     "request %s missed its %.3gs deadline in the queue"
                     % (stream.handle.id, stream.request.deadline_s),
-                    tokens=stream.handle.tokens))
+                    tokens=stream.handle.tokens, request_trace=payload))
                 self._admitting = None
                 continue
             try:
@@ -460,6 +517,7 @@ class InferenceServer:
             now = time.monotonic()
             stream.handle.tokens.append(token)
             stream.last_token_t = now
+            trace.mark("prefill", tokens=len(context), bucket=width)
             _telem.inc("serve.tokens")
             if stream.handle.ttft_ms is None:
                 # time-to-first-token counts the queue wait, not just the
@@ -500,6 +558,9 @@ class InferenceServer:
                 s.handle.tpot_ms.append(tpot)
                 _telem.observe("serve.tpot_ms", tpot)
             s.last_token_t = now
+            # one decode span per emitted token: the inter-token interval,
+            # so slot residency tiles the request's timeline completely
+            s.handle.trace.mark("decode", token=len(s.handle.tokens))
             self._finish_check(i, s, token, now)
         return len(active)
 
@@ -510,16 +571,29 @@ class InferenceServer:
         if not self.programs._warm:
             self.warmup()
         t0 = time.perf_counter()
+        ts = _telem.span_clock()
+        self._step_completed = []
         with _watchdog.guard("serve.step", deadline_s=self.step_deadline_s):
             _faults.check("serve.step", context="replica=%s" % self.name)
-            self._admit()
+            admitted = self._admit()
             decoded = self._decode()
         occupancy = sum(1 for s in self._slots if s is not None)
         _telem.set_gauge("serve.batch_occupancy", occupancy)
-        if decoded:
-            dur_ms = (time.perf_counter() - t0) * 1e3
-            _telem.observe("serve.step_ms", dur_ms)
-            _telem.step_event("serve.step", dur_ms)
+        # admission-only steps (e.g. a max_new_tokens=1 request retired at
+        # prefill) must still land in the step plane, or their completed
+        # ids never reach a flight post-mortem
+        if decoded or admitted or self._step_completed:
+            dur = time.perf_counter() - t0
+            _telem.observe("serve.step_ms", dur * 1e3)
+            # the serving cadence joins the step-span plane: attribution
+            # decomposes these windows exactly like training steps
+            _telem.record_span("serve.step", "step", ts, dur)
+            info = {"active_requests":
+                    [s.handle.id for s in self._slots
+                     if s is not None][:16]}
+            if self._step_completed:
+                info["completed_requests"] = self._step_completed[:16]
+            _telem.step_event("serve.step", dur * 1e3, info=info)
         return occupancy > 0 or len(self.queue) > 0
 
     # ------------------------------------------------------------- recovery
@@ -537,6 +611,9 @@ class InferenceServer:
         stream.retries_left -= 1
         if stream.retries_left < 0:
             _telem.inc("serve.failed")
+            stream.handle.trace.mark("recovery.drain",
+                                     error=type(exc).__name__)
+            self._finish_trace(stream.handle, "failed")
             stream.handle._fail(RetryExhausted(
                 "stream %s: replica-fault retry budget spent; last "
                 "error: %s: %s" % (stream.handle.id,
@@ -544,6 +621,10 @@ class InferenceServer:
                 site="serve.step", last_error=exc))
             return 0
         stream.handle.requeues += 1
+        # timeline: activity → fault is "recovery.drain"; the wait until
+        # re-admission (here or on a surviving replica) will close as
+        # "recovery.queue" — the recovery cost is fully attributed
+        stream.handle.trace.note_drain(exc)
         self.queue.requeue(stream)
         _telem.inc("serve.requeued_streams")
         return 1
@@ -555,6 +636,16 @@ class InferenceServer:
         surviving replica, by re-prefill. Budget-exhausted streams fail
         with `RetryExhausted` instead of looping forever."""
         drained = 0
+        requeued_ids, lost_ids = [], []
+
+        def drain(stream):
+            nonlocal drained
+            n = self._drain_stream(stream, exc)
+            drained += n
+            # n=0 means the stream did NOT resume (retry budget spent, or
+            # already done) — the post-mortem must not claim it was
+            (requeued_ids if n else lost_ids).append(stream.handle.id)
+
         admitting, self._admitting = self._admitting, None
         if admitting is not None and not admitting.handle.done() \
                 and self.queue.owned_by(admitting, self):
@@ -565,7 +656,7 @@ class InferenceServer:
             # admit one stream into two slots. The owner field is written
             # and read under the queue lock, so this cannot race a
             # sibling's pop the way a membership check would.
-            drained += self._drain_stream(admitting, exc)
+            drain(admitting)
         for i, stream in enumerate(self._slots):
             if stream is None:
                 continue
@@ -576,7 +667,7 @@ class InferenceServer:
                 # it once, or two admissions would share one handle and
                 # one block table (duplicated, corrupted output)
                 continue
-            drained += self._drain_stream(stream, exc)
+            drain(stream)
         # a fault between a donating program call and pool.update leaves
         # deleted pool buffers; every stream re-prefills anyway, so just
         # re-materialize the storage
@@ -586,8 +677,15 @@ class InferenceServer:
         # complement of the surviving tables
         self.pool.reconcile()
         _telem.inc("serve.recoveries")
-        _flight.note_event("serve_recover", "%s: %s (requeued %d)"
-                           % (self.name, type(exc).__name__, drained))
+        # the drain post-mortem names the requests it touched, not just a
+        # count — the flight ring's serve_recover event IS the answer to
+        # "whose streams did that dead replica hold?"
+        msg = ("%s: %s (requeued %d: %s)"
+               % (self.name, type(exc).__name__, drained,
+                  ",".join(requeued_ids[:8]) if requeued_ids else "-"))
+        if lost_ids:
+            msg += " (not requeued: %s)" % ",".join(lost_ids[:8])
+        _flight.note_event("serve_recover", msg)
         return drained
 
     def run(self, max_steps=None, stop=None):

@@ -55,6 +55,24 @@ Telemetry v2 — the LIVE observability plane on top of the registry:
   rolling p50/p99 step-latency quantiles the exporter and bench rows
   report. `step_event(site, ms)` is the one call the instrumented step
   paths make to feed both.
+
+Observability v3 — the per-request / per-step / per-fleet evidence layer:
+
+* `telemetry.request_trace` — a `RequestTrace` travels with every
+  `mx.serve` request (enqueue → admit → prefill → each decode step →
+  completion/shed/recovery), its spans tiling the request's wall clock;
+  completed traces land in a bounded ring (`/requests` endpoint,
+  `request_traces()`, `parse_log --requests`) and replay into the chrome
+  dump as one row per request;
+* `telemetry.attribution` — per-step compute/collective/host/idle
+  decomposition + comm overlap fraction from the spans the runtime
+  already records (`overlap_report()`, `parse_log --overlap`,
+  per-step `attrib` flight records, `attrib.<site>.*` gauges) — the
+  measured-evidence input of ROADMAP item #4's schedule autotuner;
+* `telemetry.federation` — rank 0's exporter proxies the WHOLE fleet
+  (`/fleet/metrics`, `/fleet/snapshot`): out-of-band per-peer scrapes
+  merged with the same host-side merge `aggregate_snapshot` uses,
+  stale-rank tolerant (`telemetry.federation.stale_ranks`).
 """
 from __future__ import annotations
 
@@ -79,6 +97,7 @@ __all__ = ["enabled", "enable", "disable", "registry", "counter", "gauge",
            "note_compile", "recent_compiles", "device_report",
            "trace_id", "set_trace_id", "safe_rank", "local_trace_dump",
            "step_event", "step_quantiles", "flight_records",
+           "request_traces", "overlap_report",
            "Counter", "Gauge", "Histogram", "Registry"]
 
 # the ONLY state instrumented code reads on the disabled fast path
@@ -179,12 +198,14 @@ def span(name, cat="host"):
     return _Span(name, cat)
 
 
-def record_span(name, cat, start_s, dur_s):
+def record_span(name, cat, start_s, dur_s, tid=None):
     """Record an already-timed range. start_s is on the buffer's own
-    perf_counter epoch — pair with `span_clock()`."""
+    perf_counter epoch — pair with `span_clock()`. `tid` overrides the
+    chrome row (default: the recording thread) — per-request trace rows
+    use it."""
     if not ENABLED:
         return
-    _trace.add(name, cat, start_s, dur_s)
+    _trace.add(name, cat, start_s, dur_s, tid=tid)
 
 
 def span_clock():
@@ -315,14 +336,17 @@ def compile_report():
 
 def reset():
     """Drop all metrics, recorded spans, the compile ring, the flight
-    recorder, and the anomaly windows (does not change ENABLED)."""
+    recorder, the request-trace ring, and the anomaly windows (does not
+    change ENABLED)."""
     registry.reset()
     _trace.clear()
     with _compiles_lock:
         del _compiles[:]
     from . import anomaly as _anomaly, flight as _flight
+    from . import request_trace as _reqtrace
     _anomaly.reset()
     _flight.reset()
+    _reqtrace.reset()
 
 
 def dumps(format="table"):
@@ -375,16 +399,26 @@ def aggregate_trace(dump=None):
 
 
 # ---------------------------------------------------------------- step plane
-def step_event(site, dur_ms):
+def step_event(site, dur_ms, info=None):
     """One call per training/serving step from the instrumented step paths
-    (`trainer` / `fused_step` / `train_step`): runs anomaly detection over
-    the duration and appends a flight-recorder record with this step's
-    counter deltas. No-op when disabled."""
+    (`trainer` / `fused_step` / `train_step` / `serve.step`): runs anomaly
+    detection over the duration, attributes the step window
+    (compute/collective/host/idle + overlap — telemetry.attribution), and
+    appends a flight-recorder record with this step's counter deltas.
+    `info` (a small JSON-able dict — e.g. the serving scheduler's
+    active/completed request ids) rides into the flight record verbatim.
+    No-op when disabled."""
     if not ENABLED:
         return
-    from . import anomaly as _anomaly, flight as _flight
+    from . import anomaly as _anomaly, attribution as _attrib
+    from . import flight as _flight
     fired = _anomaly.observe(site, dur_ms)
-    _flight.record_step(site, dur_ms, anomalies=fired)
+    extras = dict(info) if info else {}
+    attrib = _attrib.step_attribution(site, dur_ms, _trace)
+    if attrib is not None:
+        extras["attrib"] = attrib
+    _flight.record_step(site, dur_ms, anomalies=fired,
+                        extras=extras or None)
 
 
 def step_quantiles(site=None):
@@ -401,6 +435,23 @@ def flight_records(limit=None):
     telemetry/flight.py); the watchdog embeds the tail in `StallError`."""
     from . import flight as _flight
     return _flight.records(limit=limit)
+
+
+def request_traces(limit=None):
+    """Completed per-request trace payloads, oldest first — the last-N
+    ring `mx.serve` feeds and the `/requests` endpoint serves (see
+    telemetry/request_trace.py)."""
+    from . import request_trace as _reqtrace
+    return _reqtrace.records(limit=limit)
+
+
+def overlap_report(events=None, site=None, limit=None):
+    """Per-step compute/collective/host/idle decomposition + comm overlap
+    fraction from recorded spans (see telemetry/attribution.py) — the
+    measured evidence the comm-schedule autotuner consumes and
+    `parse_log --overlap` tabulates."""
+    from . import attribution as _attrib
+    return _attrib.overlap_report(events=events, site=site, limit=limit)
 
 
 def aggregate_snapshot(snapshot=None):

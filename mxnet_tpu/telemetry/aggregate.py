@@ -20,6 +20,12 @@ decoded snapshots identically:
 Key sets may differ per worker (e.g. only rank 0 ran a compile) — the
 merge is over the union. Single-process: returns the local snapshot merged
 with nothing, same shape, so dashboards need no special case.
+
+Both entry points here are COLLECTIVE (lockstep) on multi-worker
+runtimes. The scrape-driven sibling is `telemetry.federation`: rank 0's
+``/fleet/*`` endpoints collect every peer's ``/snapshot`` out-of-band
+over HTTP and run the SAME `merge_snapshots` — one fleet view a
+Prometheus scraper can pull at any moment, no barrier required.
 """
 from __future__ import annotations
 

@@ -13,6 +13,11 @@ registry observable WHILE the run is alive, with two transports:
   - ``/snapshot`` — the raw `telemetry.snapshot()` dict plus rolling
     step-latency quantiles, rank, and the run trace id (what
     `tools/mxtop.py` polls);
+  - ``/requests`` — the completed per-request trace ring
+    (`telemetry.request_trace`): one timeline per served request;
+  - ``/fleet/metrics`` / ``/fleet/snapshot`` — the WHOLE fleet through
+    one scrape (`telemetry.federation`): rank-labeled series /
+    merged+per-rank payloads, stale-rank tolerant;
   - ``/healthz`` — liveness.
 * **JSONL stream** — ``MXNET_TPU_METRICS_STREAM=<path>`` appends one
   `/snapshot`-shaped JSON line every ``MXNET_TPU_METRICS_STREAM_S``
@@ -34,7 +39,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-__all__ = ["prometheus_text", "snapshot_payload", "parse_prometheus_text",
+__all__ = ["prometheus_text", "snapshot_payload", "requests_payload",
+           "parse_prometheus_text",
            "histogram_quantiles", "start_http_server", "stop_http_server",
            "start_stream", "stop_stream", "maybe_start_from_env",
            "MetricsServer", "SnapshotStreamer",
@@ -251,6 +257,19 @@ def snapshot_payload():
     }
 
 
+def requests_payload():
+    """The `/requests` body: this rank's completed `RequestTrace` ring
+    (identity-stamped so a dump from any rank names its run)."""
+    telem = _telem()
+    from . import request_trace
+    return {
+        "ts": time.time(),
+        "rank": telem.safe_rank(),
+        "trace_id": telem.trace_id(),
+        "requests": request_trace.records(),
+    }
+
+
 def _flight_recorder():
     from . import flight
     return flight._RECORDER
@@ -268,6 +287,19 @@ class _Handler(BaseHTTPRequestHandler):
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path in ("/", "/snapshot"):
                 body = json.dumps(snapshot_payload()).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/requests":
+                body = json.dumps(requests_payload()).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/fleet/metrics":
+                from . import federation
+                body = (federation.fleet_metrics_text() or "").encode(
+                    "utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/fleet/snapshot":
+                from . import federation
+                body = json.dumps(federation.fleet_snapshot()).encode(
+                    "utf-8")
                 ctype = "application/json"
             elif path == "/healthz":
                 body = b"ok\n"

@@ -1,0 +1,210 @@
+"""Fleet scrape federation: ONE endpoint proxying every rank's telemetry.
+
+`aggregate_snapshot()`/`aggregate_trace()` give the fleet view over DCN
+collectives — correct, but **lockstep**: every rank must call them at the
+same point, which a Prometheus scraper cannot arrange. Observing a pod
+today therefore means scraping N ports. This module closes the carried
+ROADMAP follow-on ("one endpoint proxying the fleet via
+aggregate_snapshot"): rank 0's exporter grows ``/fleet/metrics`` and
+``/fleet/snapshot``, which fan out OUT-OF-BAND — plain HTTP to each
+peer's existing ``/snapshot`` endpoint — and merge with
+`aggregate.merge_snapshots`, the very host-side half `aggregate_snapshot`
+runs after its collective exchange. Same merge semantics, no lockstep, no
+interference with training/serving collectives.
+
+* ``/fleet/metrics``  — every rank's Prometheus series in one scrape,
+  rank-labeled (the label the per-rank exporter already stamps), HELP/
+  TYPE headers deduplicated so the blob stays parseable;
+* ``/fleet/snapshot`` — ``{ranks: {r: payload}, merged: <fleet-summed
+  snapshot>, stale_ranks, workers, ...}`` — what ``mxtop --serve
+  --url .../fleet/snapshot`` renders.
+
+Peers come from ``MXNET_TPU_FLEET_PEERS`` (comma-separated ``host:port``
+of the OTHER ranks' exporters; the launcher knows every rank's metrics
+port) or `configure([...])`. **Stale-rank tolerance**: a peer that fails
+the ``MXNET_TPU_FLEET_TIMEOUT_S`` (default 2 s) fetch is served from its
+last good payload, marked ``stale: true``, and counted under
+``telemetry.federation.stale_ranks`` — one dead host must not blind the
+fleet view. A peer that never answered is listed in ``missing``.
+
+Fully inert under ``MXNET_TPU_TELEMETRY=0``: the endpoints live on the
+exporter's HTTP server, which never starts disabled, and `fleet_snapshot`
+itself answers None without touching the network.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+__all__ = ["configure", "peers", "fleet_snapshot", "fleet_metrics_text",
+           "default_timeout_s", "reset"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+# peer override (configure()) + per-peer last-good payload cache; scrapes
+# run on the exporter's handler threads, so every access takes the lock
+_STATE = {"peers": None}
+_CACHE = {}
+_LOCK = threading.Lock()
+
+
+def default_timeout_s():
+    try:
+        return max(0.1, float(os.environ.get("MXNET_TPU_FLEET_TIMEOUT_S",
+                                             "2")))
+    except (TypeError, ValueError):
+        return 2.0
+
+
+def _normalize(peer):
+    peer = peer.strip()
+    if not peer:
+        return None
+    if "://" not in peer:
+        peer = "http://" + peer
+    return peer.rstrip("/")
+
+
+def configure(peer_list):
+    """Set the peer exporters programmatically (rank 0's launcher/test
+    hook); None returns control to MXNET_TPU_FLEET_PEERS."""
+    with _LOCK:
+        if peer_list is None:
+            _STATE["peers"] = None
+        else:
+            _STATE["peers"] = [p for p in (_normalize(p)
+                                           for p in peer_list) if p]
+        _CACHE.clear()
+
+
+def peers():
+    """Effective peer URL list (without the /snapshot suffix)."""
+    with _LOCK:
+        if _STATE["peers"] is not None:
+            return list(_STATE["peers"])
+    raw = os.environ.get("MXNET_TPU_FLEET_PEERS", "")
+    return [p for p in (_normalize(p) for p in raw.split(",")) if p]
+
+
+def reset():
+    configure(None)
+
+
+def _fetch(url, timeout):
+    with urllib.request.urlopen(url + "/snapshot", timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _fetch_all(urls, timeout):
+    """[(url, payload-or-None)] in `urls` order, fetched concurrently: a
+    scrape pays ~one timeout regardless of how many peers are down, not
+    len(peers) x timeout serially on the exporter's handler thread."""
+    if not urls:
+        return []
+    if len(urls) == 1:
+        urls0 = urls[0]
+        try:
+            return [(urls0, _fetch(urls0, timeout))]
+        except Exception:  # noqa: BLE001 — tolerated, handled by caller
+            return [(urls0, None)]
+    with ThreadPoolExecutor(max_workers=min(8, len(urls))) as pool:
+        def one(url):
+            try:
+                return url, _fetch(url, timeout)
+            except Exception:  # noqa: BLE001 — tolerated stale/dead peer
+                return url, None
+        return list(pool.map(one, urls))
+
+
+def _insert_rank(by_rank, rank, payload):
+    """Self-reported ranks can collide (serving replicas launched without
+    distributed init all report 0): bump to the next free slot rather
+    than silently dropping a peer from the fleet view — the payload
+    itself still carries the rank it claimed."""
+    while rank in by_rank:
+        rank += 1
+    by_rank[rank] = payload
+
+
+def fleet_snapshot():
+    """The fleet view, out-of-band: local payload + every peer's
+    ``/snapshot``, merged. None when telemetry is disabled."""
+    from .. import telemetry as _telem
+    from . import export as _export
+    from .aggregate import merge_snapshots
+    if not _telem.ENABLED:
+        return None
+    timeout = default_timeout_s()
+    resolved = []
+    stale, missing = [], []
+    for url, payload in _fetch_all(peers(), timeout):
+        if payload is not None:
+            with _LOCK:
+                _CACHE[url] = payload
+        else:
+            # a dead peer is the tolerated case, not an error: serve its
+            # last good payload
+            _telem.inc("telemetry.federation.stale_ranks")
+            with _LOCK:
+                payload = _CACHE.get(url)
+            if payload is None:
+                _LOG.debug("federation: peer %s unreachable, no cached "
+                           "payload", url)
+                missing.append(url)
+                continue
+            payload = dict(payload, stale=True)
+            stale.append(url)
+        resolved.append(payload)
+    # the local payload is built LAST (so this scrape's own federation
+    # counters are in) but inserted FIRST: on a self-reported-rank
+    # collision the local exporter keeps its own identity and the peer is
+    # the one bumped — the rank label must agree with /metrics
+    by_rank = {}
+    local = _export.snapshot_payload()
+    _insert_rank(by_rank, int(local.get("rank", 0)), local)
+    for payload in resolved:
+        _insert_rank(by_rank, int(payload.get("rank", len(by_rank))),
+                     payload)
+    merged = merge_snapshots([p.get("snapshot", {})
+                              for _r, p in sorted(by_rank.items())])
+    return {
+        "ts": time.time(),
+        "trace_id": _telem.trace_id(),
+        "rank": _telem.safe_rank(),
+        "workers": len(by_rank),
+        "stale_ranks": stale,
+        "missing": missing,
+        "ranks": {str(r): p for r, p in sorted(by_rank.items())},
+        "merged": merged,
+    }
+
+
+def fleet_metrics_text():
+    """Every rank's Prometheus text in one body: per-rank series keep
+    their rank label; duplicate HELP/TYPE header lines (same metric on
+    several ranks) are emitted once. None when telemetry is disabled."""
+    from . import export as _export
+    fleet = fleet_snapshot()
+    if fleet is None:
+        return None
+    seen = set()
+    lines = []
+    for rank, payload in sorted((int(r), p)
+                                for r, p in fleet["ranks"].items()):
+        text = _export.prometheus_text(payload.get("snapshot", {}),
+                                       rank=rank)
+        for line in text.splitlines():
+            if line.startswith("#"):
+                if line in seen:
+                    continue
+                seen.add(line)
+            lines.append(line)
+    lines.append("# HELP mxnet_tpu_fleet_workers ranks in this scrape")
+    lines.append("# TYPE mxnet_tpu_fleet_workers gauge")
+    lines.append("mxnet_tpu_fleet_workers %d" % fleet["workers"])
+    return "\n".join(lines) + "\n"

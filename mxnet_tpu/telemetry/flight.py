@@ -105,9 +105,14 @@ class FlightRecorder:
         return self._mem_gauge
 
     # ------------------------------------------------------------------
-    def record_step(self, site, dur_ms, anomalies=None):
+    def record_step(self, site, dur_ms, anomalies=None, extras=None):
         """Append one step record; deltas are computed against the previous
-        record, so the ring reads as a per-step ledger."""
+        record, so the ring reads as a per-step ledger. `extras` is a
+        small caller-supplied dict merged into the record verbatim — the
+        serving scheduler passes the active/completed request ids per
+        step (so a stall post-mortem names the in-flight requests, not
+        just counters) and step_event passes the per-step
+        compute/collective/host/idle attribution."""
         from .. import telemetry as _telem
         if not _telem.ENABLED:
             return None
@@ -118,6 +123,9 @@ class FlightRecorder:
         }
         if anomalies:
             record["anomalies"] = list(anomalies)
+        if extras:
+            for key, value in extras.items():
+                record.setdefault(str(key), value)
         with self._lock:
             # counters and the compile ring are snapshotted UNDER the
             # recorder lock: two step sites recording concurrently must
@@ -257,6 +265,12 @@ def format_records(recs, limit=10):
             parts.append("ANOMALY=%s" % ",".join(r["anomalies"]))
         if r.get("events"):
             parts.append("events=[%s]" % "; ".join(r["events"]))
+        if r.get("active_requests"):
+            # the serving post-mortem headline: WHICH requests were in
+            # flight when the step stalled, not just how many
+            parts.append("active=[%s]" % ",".join(r["active_requests"]))
+        if r.get("completed_requests"):
+            parts.append("done=[%s]" % ",".join(r["completed_requests"]))
         lines.append(" ".join(parts))
     return "\n".join(lines)
 
@@ -267,8 +281,9 @@ _HOOK_LOCK = threading.Lock()
 _HOOK = {"installed": False, "prev": None}
 
 
-def record_step(site, dur_ms, anomalies=None):
-    return _RECORDER.record_step(site, dur_ms, anomalies=anomalies)
+def record_step(site, dur_ms, anomalies=None, extras=None):
+    return _RECORDER.record_step(site, dur_ms, anomalies=anomalies,
+                                 extras=extras)
 
 
 def note_event(kind, detail=""):

@@ -1,0 +1,274 @@
+"""Per-request distributed tracing for the serving plane.
+
+`mx.serve`'s aggregate histograms answer "how is the fleet doing"; they
+cannot answer "why did THIS request take 900 ms to first token". A
+`RequestTrace` is created at enqueue and travels with the request — inside
+its `StreamHandle`, so it crosses replica boundaries for free when a
+drained stream resumes on a survivor — through admit → KV alloc → bucketed
+prefill → every decode step → completion (or shed / deadline / recovery
+requeue), recording a span timeline on the shared telemetry trace clock.
+
+The timeline TILES the request's wall-clock by construction: every span
+starts where the previous one ended (`mark()` closes the open interval and
+advances the cursor), so queue-wait + prefill + decode + recovery account
+for the request's entire life — the property the acceptance test asserts
+(>= 95 %; the only loss is the final cursor→finish tail, one `mark` wide).
+Span names:
+
+* ``queue``           enqueue (or backpressure re-entry) → admission pop
+* ``prefill``         pop → first emitted token (KV alloc + bucketed
+                      prefill; the TTFT tail the client felt)
+* ``decode``          one span per emitted token (inter-token interval —
+                      time IN the batch, not just inside the decode
+                      program, so slot residency is fully accounted)
+* ``recovery.drain``  last activity → the replica fault that drained it
+* ``recovery.queue``  requeue → re-admission on this or another replica
+
+On completion the trace `finish()`es: a JSON-able payload snapshot joins
+the bounded last-N ring (``MXNET_TPU_SERVE_TRACE_RING``, default 128) the
+``/requests`` endpoint serves and `DeadlineExceeded` embeds, and the spans
+are replayed into the chrome trace buffer under a per-request `tid` — each
+request renders as its OWN row (`req[<id>]`, cat ``request``) next to the
+steps and comm buckets that explain it, across every rank of a merged
+dump.
+
+Gating: fully inert under ``MXNET_TPU_TELEMETRY=0`` (and under
+``MXNET_TPU_SERVE_TRACE=0``, the bench's A/B knob): `start()` returns the
+no-op `NULL_TRACE`, the ring stays empty, no spans are recorded —
+`tests/test_observability.py` asserts it from a subprocess.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from collections import deque
+
+__all__ = ["RequestTrace", "NullRequestTrace", "NULL_TRACE", "start",
+           "records", "reset", "default_ring_size",
+           "default_span_cap", "tracing_enabled"]
+
+
+def default_ring_size():
+    try:
+        return max(8, int(os.environ.get("MXNET_TPU_SERVE_TRACE_RING",
+                                         "128")))
+    except (TypeError, ValueError):
+        return 128
+
+
+def default_span_cap():
+    """Spans kept per trace; past the cap, marks coalesce into the last
+    span (counted) so a 10k-token stream cannot balloon the ring."""
+    try:
+        return max(16, int(os.environ.get("MXNET_TPU_SERVE_TRACE_SPANS",
+                                          "512")))
+    except (TypeError, ValueError):
+        return 512
+
+
+def tracing_enabled():
+    """Telemetry master switch AND the request-trace knob (the bench's
+    overhead A/B lever)."""
+    from .. import telemetry as _telem
+    if not _telem.ENABLED:
+        return False
+    return os.environ.get("MXNET_TPU_SERVE_TRACE", "1").lower() not in (
+        "0", "false", "off")
+
+
+class NullRequestTrace:
+    """The disabled-path trace: every method a no-op, so scheduler call
+    sites never branch on the telemetry gate."""
+
+    __slots__ = ()
+    null = True
+
+    def mark(self, name, **meta):
+        return self
+
+    def note_replica(self, name):
+        return self
+
+    def note_drain(self, error=None):
+        return self
+
+    def finish(self, outcome, **meta):
+        return None
+
+    def to_payload(self):
+        return None
+
+
+NULL_TRACE = NullRequestTrace()
+
+
+class RequestTrace:
+    """One request's span timeline on the shared telemetry span clock.
+
+    A trace is owned by exactly one scheduler thread at a time (the
+    replica that holds the stream — ownership transfers through the
+    RequestQueue exactly like the stream itself), so `mark`/`note_*` are
+    single-writer; the ring stores the immutable `to_payload()` snapshot
+    taken at `finish()`, which is what concurrent scrapes read.
+    """
+
+    __slots__ = ("request_id", "trace_id", "rank", "t_enqueue",
+                 "enqueued_unix", "spans", "replicas", "outcome",
+                 "requeues", "_cursor", "_recovering", "_dropped_spans",
+                 "_finished", "_cap", "_t_finish")
+    null = False
+
+    def __init__(self, request_id):
+        from .. import telemetry as _telem
+        self.request_id = str(request_id)
+        self.trace_id = _telem.trace_id()
+        self.rank = _telem.safe_rank()
+        self.t_enqueue = _telem.span_clock()
+        self.enqueued_unix = time.time()
+        self.spans = []            # [name, start_s, dur_s, meta-dict]
+        self.replicas = []         # replica names that held the stream
+        self.outcome = None
+        self.requeues = 0
+        self._cursor = self.t_enqueue
+        self._recovering = False
+        self._dropped_spans = 0
+        self._finished = False
+        self._cap = default_span_cap()
+        self._t_finish = None
+
+    # ------------------------------------------------------------- marks
+    def mark(self, name, **meta):
+        """Close the open interval [cursor, now] as span `name` and
+        advance the cursor — consecutive marks tile the timeline."""
+        from .. import telemetry as _telem
+        now = _telem.span_clock()
+        dur = max(0.0, now - self._cursor)
+        if self._recovering and name == "queue":
+            # the wait after a drain is recovery time, not admission load
+            name = "recovery.queue"
+            self._recovering = False
+        if len(self.spans) >= self._cap:
+            # coalesce into the newest span (decode tails of huge streams)
+            last = self.spans[-1]
+            last[2] += dur
+            last[3]["coalesced"] = last[3].get("coalesced", 0) + 1
+            self._dropped_spans += 1
+        else:
+            self.spans.append([name, self._cursor, dur, dict(meta)])
+        self._cursor = now
+        return self
+
+    def note_replica(self, name):
+        """Record which replica holds the stream (admission time) — the
+        cross-replica hop list a recovered request's post-mortem needs."""
+        name = str(name)
+        if not self.replicas or self.replicas[-1] != name:
+            self.replicas.append(name)
+        return self
+
+    def note_drain(self, error=None):
+        """A replica fault drained this stream: close the open interval as
+        ``recovery.drain`` and flag the next queue wait as recovery."""
+        self.mark("recovery.drain",
+                  error=type(error).__name__ if error is not None else None)
+        self._recovering = True
+        self.requeues += 1
+        return self
+
+    # ------------------------------------------------------------ finish
+    def finish(self, outcome, **meta):
+        """Terminal event: close the tail, snapshot the payload into the
+        ring, and replay the spans into the chrome buffer as this
+        request's own row. Returns the payload (embedded by
+        `DeadlineExceeded` and drain post-mortems). Idempotent."""
+        from .. import telemetry as _telem
+        if self._finished:
+            return self.to_payload(**meta)
+        self._finished = True
+        self._t_finish = _telem.span_clock()
+        self.outcome = str(outcome)
+        payload = self.to_payload(**meta)
+        if _telem.ENABLED:
+            _record(payload)
+            # chrome row per request: stable small tid from the id, spans
+            # named req[<id>].<phase> so a merged multi-rank dump shows
+            # the request's hops next to each rank's steps
+            tid = zlib.crc32(self.request_id.encode()) & 0x3fffffff
+            for name, start, dur, _meta in self.spans:
+                _telem.record_span(
+                    "req[%s].%s" % (self.request_id, name), "request",
+                    start, dur, tid=tid)
+        return payload
+
+    # ----------------------------------------------------------- export
+    def _phase_ms(self):
+        out = {}
+        for name, _start, dur, _meta in self.spans:
+            key = name.split(".", 1)[0]  # recovery.* folds into recovery
+            out[key] = out.get(key, 0.0) + dur * 1e3
+        return {k: round(v, 3) for k, v in out.items()}
+
+    def to_payload(self, **meta):
+        """JSON-able snapshot: identity, outcome, per-phase rollup, and
+        the span timeline (starts relative to enqueue, ms)."""
+        # wall runs to the finish clock, NOT the last mark's cursor —
+        # otherwise accounted == wall tautologically and the >=95% bound
+        # could never catch a lost tail (last token -> deadline detection)
+        end = self._t_finish if self._t_finish is not None else self._cursor
+        wall_ms = (end - self.t_enqueue) * 1e3
+        accounted_ms = sum(dur for _n, _s, dur, _m in self.spans) * 1e3
+        payload = {
+            "request_id": self.request_id,
+            "trace_id": self.trace_id,
+            "rank": self.rank,
+            "enqueued_unix": self.enqueued_unix,
+            "outcome": self.outcome,
+            "replicas": list(self.replicas),
+            "requeues": self.requeues,
+            "wall_ms": round(wall_ms, 3),
+            "accounted_ms": round(accounted_ms, 3),
+            "phases_ms": self._phase_ms(),
+            "spans": [{"name": n, "start_ms": round((s - self.t_enqueue)
+                                                    * 1e3, 3),
+                       "dur_ms": round(d * 1e3, 3), **m}
+                      for n, s, d, m in self.spans],
+        }
+        if self._dropped_spans:
+            payload["coalesced_spans"] = self._dropped_spans
+        payload.update(meta)
+        return payload
+
+
+# --------------------------------------------------------------- the ring
+_RING = deque(maxlen=default_ring_size())
+_RING_LOCK = threading.Lock()
+
+
+def _record(payload):
+    with _RING_LOCK:
+        _RING.append(payload)
+
+
+def start(request_id):
+    """Factory the scheduler calls at enqueue: a live trace, or the
+    NULL_TRACE no-op when telemetry / request tracing is off."""
+    if not tracing_enabled():
+        return NULL_TRACE
+    return RequestTrace(request_id)
+
+
+def records(limit=None):
+    """Completed-request payloads, oldest first (the `/requests` body)."""
+    with _RING_LOCK:
+        out = list(_RING)
+    if limit is not None and len(out) > limit:
+        out = out[-limit:]
+    return out
+
+
+def reset():
+    global _RING
+    with _RING_LOCK:
+        _RING = deque(maxlen=default_ring_size())

@@ -38,14 +38,30 @@ class TraceBuffer:
     def now(self):
         return time.perf_counter() - self._epoch
 
-    def add(self, name, cat, ts_s, dur_s):
+    def add(self, name, cat, ts_s, dur_s, tid=None):
+        """Append one span. `tid` defaults to the recording thread's ident
+        (chrome renders one row per tid); callers with their own row
+        semantics — per-request trace rows — pass an explicit id."""
+        if tid is None:
+            tid = threading.get_ident()
         with self._lock:
-            self._events.append(
-                (name, cat, ts_s, dur_s, threading.get_ident()))
+            self._events.append((name, cat, ts_s, dur_s, tid))
 
     def events(self):
         with self._lock:
             return list(self._events)
+
+    def tail(self, n):
+        """The newest `n` spans (oldest first) without copying the whole
+        ring — the per-step attribution pass runs on every step_event and
+        must not pay O(ring) on a 100k-event buffer."""
+        with self._lock:
+            if n >= len(self._events):
+                return list(self._events)
+            it = reversed(self._events)
+            out = [next(it) for _ in range(n)]
+        out.reverse()
+        return out
 
     def clear(self):
         with self._lock:

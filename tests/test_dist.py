@@ -365,6 +365,94 @@ def test_dist_merged_trace_two_workers(tmp_path):
             assert ("rank_marker_%d" % r) in by_rank[r]
 
 
+FLEET_WORKER = r"""
+import json, os, sys, urllib.request
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.parallel.dist import coordinator_client
+from mxnet_tpu.telemetry import export, federation
+
+kv = mx.kv.create("dist_sync")   # rendezvous only — federation is the
+rank, nw = kv.rank, kv.num_workers   # out-of-band path, no collectives
+port = int(os.environ["FLEET_PORT%d" % rank])
+server = export.start_http_server(port, host="127.0.0.1")
+telemetry.inc("fleet.probe", rank + 1)       # rank-distinct values
+
+# coordination-service barrier (no XLA collective): both endpoints up +
+# counters set before rank 0 scrapes
+client = coordinator_client()
+client.wait_at_barrier("fleet_up", 60000)
+
+out = {"rank": rank, "nw": nw}
+if rank == 0:
+    federation.configure(["127.0.0.1:%s" % os.environ["FLEET_PORT1"]])
+    fleet = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:%d/fleet/snapshot" % port, timeout=15).read())
+    text = urllib.request.urlopen(
+        "http://127.0.0.1:%d/fleet/metrics" % port,
+        timeout=15).read().decode()
+    out["workers"] = fleet["workers"]
+    out["stale"] = fleet["stale_ranks"] + fleet["missing"]
+    out["ranks"] = sorted(fleet["ranks"])
+    out["merged_probe"] = fleet["merged"]["counters"].get("fleet.probe")
+    out["probe_r0"] = fleet["ranks"]["0"]["snapshot"]["counters"].get(
+        "fleet.probe")
+    out["probe_r1"] = fleet["ranks"]["1"]["snapshot"]["counters"].get(
+        "fleet.probe")
+    out["rank0_series"] = 'mxnet_tpu_fleet_probe{rank="0"} 1' in text
+    out["rank1_series"] = 'mxnet_tpu_fleet_probe{rank="1"} 2' in text
+
+# second barrier: rank 1's endpoint must outlive rank 0's scrape
+client.wait_at_barrier("fleet_done", 60000)
+with open(os.environ["RESULT_FILE_PREFIX"] + str(rank) + ".json", "w") as f:
+    json.dump(out, f)
+"""
+
+
+@pytest.mark.slow
+def test_dist_fleet_scrape_federation_two_workers(tmp_path):
+    """ISSUE 12 acceptance: /fleet/metrics on rank 0 of a real 2-process
+    run serves BOTH ranks' rank-labeled series in one scrape, and
+    /fleet/snapshot merges both ranks' counters with no stale ranks."""
+    n = 2
+    script = tmp_path / "fleet_worker.py"
+    script.write_text(FLEET_WORKER)
+    env = dict(os.environ)
+    env.update({
+        "RESULT_FILE_PREFIX": str(tmp_path / "result_"),
+        "FLEET_PORT0": str(_free_port()),
+        "FLEET_PORT1": str(_free_port()),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_TELEMETRY", None)
+    env.pop("MXNET_TPU_FLEET_PEERS", None)
+    env.pop("MXNET_TPU_METRICS_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "--root-port", str(_free_port()),
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    with open(str(tmp_path / "result_0.json")) as f:
+        res = json.load(f)
+    assert res["nw"] == n
+    assert res["workers"] == 2
+    assert res["stale"] == []
+    assert res["ranks"] == ["0", "1"]
+    # counters merged fleet-wide (1 + 2) AND preserved per rank
+    assert res["merged_probe"] == 3
+    assert res["probe_r0"] == 1 and res["probe_r1"] == 2
+    # ONE scrape carries both ranks' rank-labeled Prometheus series
+    assert res["rank0_series"] and res["rank1_series"]
+
+
 ZERO_WORKER = r"""
 import json, os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"

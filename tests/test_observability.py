@@ -252,6 +252,19 @@ finally:
 telemetry.step_event("fused_step", 5.0)
 from mxnet_tpu.telemetry import flight
 assert flight.records() == []
+# ISSUE 12: the RequestTrace ring and the fleet federation are fully
+# inert — NULL traces, empty ring, no network touched even with peers
+# configured in the env
+from mxnet_tpu.telemetry import federation, request_trace
+os.environ["MXNET_TPU_FLEET_PEERS"] = "127.0.0.1:9"
+assert federation.fleet_snapshot() is None
+assert federation.fleet_metrics_text() is None
+tr = request_trace.start("r1")
+assert tr is request_trace.NULL_TRACE
+tr.mark("queue")
+tr.note_drain(RuntimeError("x"))
+assert tr.finish("completed") is None
+assert request_trace.records() == []
 assert telemetry.snapshot() == {"counters": {}, "gauges": {},
                                 "histograms": {}}
 print("DISABLED_OK")
@@ -450,6 +463,194 @@ def test_aggregate_trace_local():
     assert len(dumps) == 1
     assert dumps[0]["rank"] == 0
     assert any(e[0] == "agg_span" for e in dumps[0]["events"])
+
+
+# ===========================================================================
+# comm-overlap attribution (ISSUE 12 tentpole)
+# ===========================================================================
+def test_attribution_partition_sums_to_step():
+    """The compute/collective/host/idle decomposition is a partition of
+    the step window — it sums to step time exactly (the acceptance's 5%
+    bound holds by construction), with overlapping comm spans unioned and
+    host spans deduplicated against comm."""
+    from mxnet_tpu.telemetry import attribution
+    events = [
+        ("fused_step", "step", 0.0, 1.0, 1),
+        ("comm.bucket[a]", "comm", 0.1, 0.2, 1),     # [0.10, 0.30]
+        ("comm.bucket[b]", "comm", 0.25, 0.1, 1),    # [0.25, 0.35] overlap
+        ("checkpoint", "resilience", 0.5, 0.1, 1),   # host
+        ("checkpoint", "resilience", 0.3, 0.1, 1),   # half under comm
+    ]
+    row = attribution.attribute_window(events, 0.0, 1.0)
+    assert row["collective_ms"] == pytest.approx(250.0)   # union, not sum
+    assert row["comm_busy_ms"] == pytest.approx(300.0)
+    assert row["host_ms"] == pytest.approx(150.0)         # comm part cut
+    assert row["idle_ms"] == 0.0
+    assert row["compute_ms"] == pytest.approx(1000 - 250 - 150)
+    total = (row["compute_ms"] + row["collective_ms"] + row["host_ms"]
+             + row["idle_ms"])
+    assert total == pytest.approx(row["step_ms"])
+    assert row["comm_launches"] == 2
+    # overlap: comm phase = [0.1, 1.0]; host was off the comm path for
+    # 0.9 - 0.25 of it
+    assert row["overlap_frac"] == pytest.approx((0.9 - 0.25) / 0.9,
+                                                abs=1e-3)
+
+
+def test_overlap_report_on_live_spans_and_gauges():
+    """overlap_report() reads the live span buffer; step_event publishes
+    the same decomposition as attrib.* gauges and a flight record."""
+    # a step that JUST ended: step_event's live window is [now-dur, now],
+    # exactly how the real step sites call it
+    ts = telemetry.span_clock() - 0.02
+    telemetry.record_span("comm.bucket[0..5]", "comm", ts + 0.001, 0.004)
+    telemetry.record_span("train_step", "step", ts, 0.02)
+    rep = telemetry.overlap_report(site="train_step")
+    assert rep["summary"]["steps"] == 1
+    row = rep["steps"][0]
+    assert row["collective_ms"] == pytest.approx(4.0, rel=0.01)
+    assert row["comm_launches"] == 1
+    assert 0.0 < row["overlap_frac"] < 1.0
+    # the live per-step pass: gauges + flight "attrib" record
+    telemetry.step_event("train_step", 20.0)
+    gauges = telemetry.snapshot()["gauges"]
+    assert "attrib.train_step.collective_ms" in gauges
+    rec = telemetry.flight_records()[-1]
+    assert "attrib" in rec and rec["attrib"]["comm_launches"] >= 1
+
+
+def test_overlap_report_no_comm_steps():
+    ts = telemetry.span_clock()
+    telemetry.record_span("fused_step", "step", ts, 0.01)
+    rep = telemetry.overlap_report(site="fused_step")
+    row = rep["steps"][0]
+    assert row["overlap_frac"] is None
+    assert row["compute_ms"] == pytest.approx(row["step_ms"])
+    assert rep["summary"]["overlap_frac"] is None
+
+
+# ===========================================================================
+# /requests endpoint + fleet federation (ISSUE 12 tentpole)
+# ===========================================================================
+@pytest.mark.obs
+def test_requests_endpoint_serves_trace_ring():
+    from mxnet_tpu.telemetry import request_trace
+    tr = request_trace.start("req-endpoint-1")
+    tr.mark("queue").mark("prefill")
+    tr.finish("completed", tokens=3)
+    server = export.start_http_server(0)
+    payload = json.loads(_scrape(server.port, "/requests"))
+    assert payload["rank"] == 0
+    assert payload["trace_id"] == telemetry.trace_id()
+    reqs = {r["request_id"]: r for r in payload["requests"]}
+    assert reqs["req-endpoint-1"]["outcome"] == "completed"
+    assert reqs["req-endpoint-1"]["tokens"] == 3
+
+
+@pytest.mark.obs
+def test_fleet_endpoints_local_only():
+    """With no peers configured the fleet view degrades to this rank —
+    same payload shape, workers=1 — so dashboards need no special case."""
+    _seed_metrics()
+    server = export.start_http_server(0)
+    fleet = json.loads(_scrape(server.port, "/fleet/snapshot"))
+    assert fleet["workers"] == 1
+    assert fleet["stale_ranks"] == [] and fleet["missing"] == []
+    assert fleet["merged"]["counters"]["t.calls"] == 5
+    assert set(fleet["ranks"]) == {"0"}
+    text = _scrape(server.port, "/fleet/metrics")
+    assert 'mxnet_tpu_t_calls{rank="0"} 5' in text
+    assert "mxnet_tpu_fleet_workers 1" in text
+
+
+@pytest.mark.obs
+def test_fleet_snapshot_merges_peer_and_tolerates_death():
+    """A (stub) peer's /snapshot merges into the fleet view rank-labeled;
+    when the peer dies its last good payload is served stale-marked and
+    telemetry.federation.stale_ranks counts it."""
+    import http.server
+    from mxnet_tpu.telemetry import federation
+    peer_payload = {
+        "rank": 1, "trace_id": "t", "hist_quantiles": {},
+        "snapshot": {"counters": {"t.calls": 7, "peer.only": 2},
+                     "gauges": {}, "histograms": {}},
+    }
+
+    class _Peer(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib contract
+            body = json.dumps(peer_payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: A002
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Peer)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    _seed_metrics()
+    federation.configure(["127.0.0.1:%d" % httpd.server_address[1]])
+    try:
+        fleet = federation.fleet_snapshot()
+        assert fleet["workers"] == 2
+        assert set(fleet["ranks"]) == {"0", "1"}
+        assert fleet["merged"]["counters"]["t.calls"] == 12   # 5 + 7
+        assert fleet["merged"]["counters"]["peer.only"] == 2
+        assert fleet["stale_ranks"] == []
+        text = federation.fleet_metrics_text()
+        assert 'mxnet_tpu_t_calls{rank="0"} 5' in text
+        assert 'mxnet_tpu_t_calls{rank="1"} 7' in text
+        # one HELP/TYPE header despite two ranks: the blob stays parseable
+        assert text.count("# TYPE mxnet_tpu_t_calls counter") == 1
+        # kill the peer: stale cache serves, stale_ranks counts
+        httpd.shutdown()
+        httpd.server_close()
+        fleet = federation.fleet_snapshot()
+        assert len(fleet["stale_ranks"]) == 1
+        assert fleet["ranks"]["1"]["stale"] is True
+        assert fleet["workers"] == 2                          # still both
+        assert fleet["merged"]["counters"][
+            "telemetry.federation.stale_ranks"] == 1
+    finally:
+        federation.reset()
+        try:
+            httpd.server_close()
+        except OSError:
+            pass
+
+
+def test_fleet_missing_peer_without_cache(monkeypatch):
+    """A peer that NEVER answered is reported missing (not fabricated),
+    and each failed scrape ticks the stale counter."""
+    from mxnet_tpu.telemetry import federation
+    monkeypatch.setenv("MXNET_TPU_FLEET_TIMEOUT_S", "0.2")
+    federation.configure(["127.0.0.1:9"])       # nothing listens there
+    try:
+        fleet = federation.fleet_snapshot()
+        assert fleet["missing"] == ["http://127.0.0.1:9"]
+        assert fleet["workers"] == 1
+        assert fleet["merged"]["counters"][
+            "telemetry.federation.stale_ranks"] == 1
+        fleet = federation.fleet_snapshot()
+        assert fleet["merged"]["counters"][
+            "telemetry.federation.stale_ranks"] == 2
+    finally:
+        federation.reset()
+
+
+def test_fleet_peers_env_parsing(monkeypatch):
+    from mxnet_tpu.telemetry import federation
+    monkeypatch.setenv("MXNET_TPU_FLEET_PEERS",
+                       "10.0.0.2:9100, http://10.0.0.3:9100/,")
+    assert federation.peers() == ["http://10.0.0.2:9100",
+                                  "http://10.0.0.3:9100"]
+    federation.configure(["a:1"])
+    assert federation.peers() == ["http://a:1"]
+    federation.reset()
+    assert federation.peers() == ["http://10.0.0.2:9100",
+                                  "http://10.0.0.3:9100"]
 
 
 # ===========================================================================
@@ -915,6 +1116,45 @@ def test_mxtop_once_from_endpoint():
     assert "trainer" in r.stdout
 
 
+def test_mxtop_serve_view_single_and_fleet(tmp_path):
+    """`mxtop --serve` renders tokens/s, queue/batch pressure, shed
+    counts and TTFT/TPOT quantiles from a single /snapshot payload AND
+    from a /fleet/snapshot payload (one row per rank + fleet totals)."""
+    from mxnet_tpu.telemetry import federation
+    telemetry.inc("serve.requests", 10)
+    telemetry.inc("serve.completed", 8)
+    telemetry.inc("serve.shed", 2)
+    telemetry.inc("serve.shed.queue_full", 2)
+    telemetry.set_gauge("serve.tokens_per_s", 123.4)
+    telemetry.set_gauge("serve.queue_depth", 3)
+    telemetry.set_gauge("serve.batch_occupancy", 4)
+    for ms in (5.0, 6.0, 50.0):
+        telemetry.observe("serve.ttft_ms", ms)
+        telemetry.observe("serve.tpot_ms", ms / 10)
+    single = str(tmp_path / "single.jsonl")
+    with open(single, "w") as f:
+        f.write(json.dumps(export.snapshot_payload()) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxtop.py"),
+         "--stream", single, "--serve", "--once"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "123.40" in r.stdout          # tokens/s
+    assert "queue_full=2" in r.stdout    # shed by reason
+    assert "ttft p50/p99" in r.stdout
+    fleet = str(tmp_path / "fleet.jsonl")
+    with open(fleet, "w") as f:
+        f.write(json.dumps(federation.fleet_snapshot()) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxtop.py"),
+         "--stream", fleet, "--serve", "--once"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "fleet: 1 rank(s)" in r.stdout
+    assert any(line.startswith("  fleet ")      # fleet totals row present
+               for line in r.stdout.splitlines())
+
+
 def test_mxtop_once_fails_cleanly_without_target(tmp_path):
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "mxtop.py"),
@@ -931,7 +1171,9 @@ def test_mxtop_once_fails_cleanly_without_target(tmp_path):
 def test_new_observability_modules_tpu006_clean():
     from mxnet_tpu import analysis
     paths = [os.path.join(REPO, "mxnet_tpu", "telemetry", m)
-             for m in ("export.py", "flight.py", "anomaly.py")]
+             for m in ("export.py", "flight.py", "anomaly.py",
+                       "federation.py", "request_trace.py",
+                       "attribution.py")]
     findings = [f for p in paths
                 for f in analysis.lint_file(p, rules=["TPU006"])]
     assert not findings, "\n".join(f.format() for f in findings)

@@ -465,6 +465,160 @@ def test_recovery_async_windows():
 
 
 # ---------------------------------------------------------------------------
+# per-request tracing (ISSUE 12 tentpole)
+# ---------------------------------------------------------------------------
+def test_request_trace_accounts_wall_clock_single_replica():
+    """Every served request leaves a timeline in the last-N ring whose
+    spans tile its wall clock (queue + prefill + per-token decode)."""
+    server = make_server(max_batch=2).warmup()
+    prompts = prompts_for(3, seed=9)
+    handles = [server.submit(Request(p, max_new_tokens=4))
+               for p in prompts]
+    server.run()
+    for h in handles:
+        h.result(timeout=10)
+    traces = {t["request_id"]: t for t in telemetry.request_traces()}
+    assert set(traces) == {h.id for h in handles}
+    for h in handles:
+        t = traces[h.id]
+        assert t["outcome"] == "completed"
+        assert t["tokens"] == 4
+        # payload ttft is rounded to 3 decimals for the JSON dump
+        assert t["ttft_ms"] == pytest.approx(h.ttft_ms, abs=5e-4)
+        assert t["accounted_ms"] >= 0.95 * t["wall_ms"]
+        assert set(t["phases_ms"]) == {"queue", "prefill", "decode"}
+        # decode emitted one span per token after the prefill's first
+        decodes = [s for s in t["spans"] if s["name"] == "decode"]
+        assert len(decodes) == 3
+        assert t["replicas"] == ["replica0"]
+
+
+def test_request_trace_survives_replica_kill_95pct_accounted():
+    """THE ISSUE 12 acceptance test: a deliberately delayed request whose
+    replica is killed mid-stream still yields ONE RequestTrace — continued
+    on the surviving replica — whose spans (queue-wait + prefill + decode
+    + recovery) account for >= 95% of its wall clock."""
+    group = ReplicaGroup(PARAMS, CFG, replicas=2, kv_blocks=48,
+                         block_size=8, max_batch=4, max_context=32,
+                         max_restarts=0).warmup()
+    prompts = prompts_for(6, seed=10)
+    # every step delayed (the "deliberately delayed request"), and the 4th
+    # step check is a kill — preempt FIRST: the plan fires the first
+    # matching entry, so the wildcard latency must come after it
+    with faults.inject("serve.step:preempt:4;serve.step:latency:*:0.01"):
+        group.start()
+        handles = [group.submit(Request(p, max_new_tokens=6))
+                   for p in prompts]
+        for h in handles:
+            h.result(timeout=30)
+        assert group.drain(timeout=10)
+    group.stop()
+    assert group.alive_replicas == 1
+    traces = {t["request_id"]: t for t in telemetry.request_traces()}
+    for h in handles:
+        t = traces[h.id]
+        assert t["outcome"] == "completed"
+        assert t["accounted_ms"] >= 0.95 * t["wall_ms"], t
+    recovered = [traces[h.id] for h in handles if h.requeues > 0]
+    assert recovered, "the kill drained no in-flight stream"
+    # the killed replica's streams resumed elsewhere: recovery spans are
+    # on the timeline and the trace names BOTH replicas it crossed
+    assert any("recovery" in t["phases_ms"] for t in recovered)
+    assert any(len(set(t["replicas"])) == 2 for t in recovered)
+
+
+def test_deadline_exceeded_embeds_request_trace():
+    """A shed request carries its own timeline: DeadlineExceeded's
+    request_trace names where the time went."""
+    server = make_server().warmup()
+    h = server.submit(Request([1, 2, 3], max_new_tokens=24,
+                              deadline_s=0.08))
+    with faults.inject("serve.step:latency:*:0.02"):
+        server.run()
+    with pytest.raises(DeadlineExceeded) as ei:
+        h.result(timeout=10)
+    tr = ei.value.request_trace
+    assert tr is not None and tr["outcome"] == "deadline"
+    assert tr["request_id"] == h.id
+    assert tr["tokens"] == len(ei.value.tokens)
+    assert tr["accounted_ms"] >= 0.95 * tr["wall_ms"]
+    # the same payload is queryable from the ring (the /requests body)
+    ring = {t["request_id"]: t for t in telemetry.request_traces()}
+    assert ring[h.id]["outcome"] == "deadline"
+
+
+def test_shed_requests_land_in_ring():
+    server = make_server(queue_cap=1).warmup()
+    with pytest.raises(Overloaded):
+        server.submit(Request([1] * 8, max_new_tokens=1000))  # too_large
+    server.submit(Request([1, 2], max_new_tokens=2))
+    with pytest.raises(Overloaded):
+        server.submit(Request([3, 4], max_new_tokens=2))      # queue_full
+    outcomes = [t["outcome"] for t in telemetry.request_traces()]
+    assert "shed.too_large" in outcomes
+    assert "shed.queue_full" in outcomes
+    server.run()
+
+
+def test_request_rows_in_chrome_dump(tmp_path):
+    """Completed requests replay into the chrome dump as their own rows:
+    spans named req[<id>].<phase> under a per-request tid."""
+    import json
+    server = make_server(max_batch=2).warmup()
+    handles = [server.submit(Request(p, max_new_tokens=3))
+               for p in prompts_for(2, seed=12)]
+    server.run()
+    path = telemetry.dump_trace(str(tmp_path / "serve_trace.json"))
+    obj = json.load(open(path))
+    rows = [e for e in obj["traceEvents"]
+            if e.get("ph") == "X" and e.get("cat") == "request"]
+    assert rows
+    assert {e["tid"] for e in rows} == {
+        __import__("zlib").crc32(h.id.encode()) & 0x3fffffff
+        for h in handles}
+    names = {e["name"] for e in rows}
+    for h in handles:
+        assert "req[%s].prefill" % h.id in names
+        assert "req[%s].decode" % h.id in names
+
+
+def test_flight_records_name_in_flight_requests():
+    """ISSUE 12 satellite: every serve.step flight record carries the
+    active/completed request ids, so a stall post-mortem names the
+    in-flight requests instead of just counters."""
+    from mxnet_tpu.telemetry import flight as _flight
+    server = make_server(max_batch=2).warmup()
+    handles = [server.submit(Request(p, max_new_tokens=4))
+               for p in prompts_for(2, seed=13)]
+    server.run()
+    recs = [r for r in telemetry.flight_records()
+            if r["site"] == "serve.step"]
+    assert recs and all("active_requests" in r for r in recs)
+    seen = {i for r in recs for i in (r["active_requests"]
+                                      + r.get("completed_requests", []))}
+    assert {h.id for h in handles} <= seen
+    rendered = _flight.format_records(recs)
+    assert any(h.id in rendered for h in handles)
+
+
+def test_request_tracing_knob_inert(monkeypatch):
+    """MXNET_TPU_SERVE_TRACE=0 (the bench's A/B lever): NULL traces, an
+    empty ring, no request spans — while the rest of telemetry stays on."""
+    from mxnet_tpu.telemetry import request_trace as _reqtrace
+    monkeypatch.setenv("MXNET_TPU_SERVE_TRACE", "0")
+    server = make_server().warmup()
+    h = server.submit(Request([1, 2, 3], max_new_tokens=3))
+    assert h.trace is _reqtrace.NULL_TRACE
+    server.run()
+    h.result(timeout=10)
+    assert telemetry.request_traces() == []
+    assert not any(n.startswith("req[")
+                   for n, *_ in telemetry.span_events())
+    # aggregate serving telemetry is unaffected
+    assert telemetry.snapshot()["counters"]["serve.completed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # telemetry / no-retrace plumbing
 # ---------------------------------------------------------------------------
 def test_serving_telemetry_and_flight_records():

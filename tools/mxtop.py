@@ -15,6 +15,10 @@ Sources (pick one):
                         MXNET_TPU_METRICS_STREAM (no network needed)
 
 Options:
+  --serve               serving view: tokens/s, queue depth, batch
+                        occupancy, shed counts, TTFT/TPOT p50/p99 — from a
+                        single replica's /snapshot OR rank 0's
+                        /fleet/snapshot (one row per rank + fleet totals)
   --interval S          refresh period (default 2 s)
   --once                render a single frame and exit (scripting / tests)
 
@@ -201,6 +205,102 @@ def render(payload, prev_payload=None, dt=None, source=""):
     return "\n".join(lines)
 
 
+# the sparse-bucket quantile math lives in parse_log (same directory, so
+# it resolves both run-as-script and with tools/ on sys.path): ONE stdlib
+# re-derivation of telemetry.export.histogram_quantiles, not two copies
+# that drift
+from parse_log import _hist_quantile  # noqa: E402
+
+
+def _serve_row(label, snap, quants):
+    """One serving table row from a snapshot dict + hist_quantiles."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def g(name):
+        v = gauges.get(name) or {}
+        return v.get("value"), v.get("max")
+
+    def qfmt(name):
+        q = quants.get(name)
+        if not q:
+            h = snap.get("histograms", {}).get(name)
+            if h:
+                q = {"p50": _hist_quantile(h, 0.5),
+                     "p99": _hist_quantile(h, 0.99)}
+        if not q:
+            return "-"
+        return "%s/%s" % (_fmt_num(q.get("p50")), _fmt_num(q.get("p99")))
+
+    tok_s, _ = g("serve.tokens_per_s")
+    qd, qd_peak = g("serve.queue_depth")
+    occ, occ_peak = g("serve.batch_occupancy")
+    return "  %-6s %9s %7s %7s %6s %6s %6s %6s %15s %15s" % (
+        label, _fmt_num(tok_s),
+        "%s/%s" % (_fmt_num(qd), _fmt_num(qd_peak)),
+        "%s/%s" % (_fmt_num(occ), _fmt_num(occ_peak)),
+        counters.get("serve.requests", 0),
+        counters.get("serve.completed", 0),
+        counters.get("serve.shed", 0),
+        counters.get("serve.requeued_streams", 0),
+        qfmt("serve.ttft_ms"), qfmt("serve.tpot_ms"))
+
+
+def render_serve(payload, prev_payload=None, dt=None, source=""):
+    """The --serve frame: one row per rank (fleet payloads) or one row
+    (single endpoint), plus shed-reason and replica-health detail."""
+    fleet = "ranks" in payload and "merged" in payload
+    lines = ["%smxtop --serve%s  %s  %s" % (
+        BOLD, RESET,
+        time.strftime("%H:%M:%S", time.localtime(payload.get("ts",
+                                                             time.time()))),
+        DIM + source + RESET)]
+    if fleet:
+        stale = payload.get("stale_ranks") or []
+        missing = payload.get("missing") or []
+        health = "%d rank(s)" % payload.get("workers", 0)
+        if stale:
+            health += ", %s%d stale%s" % (RED, len(stale), RESET)
+        if missing:
+            health += ", %s%d missing%s" % (RED, len(missing), RESET)
+        lines.append("  fleet: " + health)
+    lines.append("")
+    header = "  %-6s %9s %7s %7s %6s %6s %6s %6s %15s %15s" % (
+        "rank", "tok/s", "queue", "batch", "reqs", "done", "shed",
+        "requeue", "ttft p50/p99", "tpot p50/p99")
+    lines.append(BOLD + header + RESET)
+    if fleet:
+        merged_counters = payload["merged"].get("counters", {})
+        for rank, p in sorted(payload["ranks"].items(),
+                              key=lambda kv: int(kv[0])):
+            label = str(rank) + ("*" if p.get("stale") else "")
+            lines.append(_serve_row(label, p.get("snapshot", {}),
+                                    p.get("hist_quantiles", {}) or {}))
+        lines.append(_serve_row("fleet", payload["merged"], {}))
+        counters = merged_counters
+    else:
+        snap = payload.get("snapshot", {})
+        counters = snap.get("counters", {})
+        lines.append(_serve_row(str(payload.get("rank", 0)), snap,
+                                payload.get("hist_quantiles", {}) or {}))
+    sheds = {n: v for n, v in sorted(counters.items())
+             if n.startswith("serve.shed.") and v}
+    if sheds:
+        lines.append("")
+        lines.append(BOLD + "shed by reason" + RESET)
+        lines.append("  " + "  ".join(
+            "%s=%d" % (n[len("serve.shed."):], v)
+            for n, v in sheds.items()))
+    deaths = counters.get("serve.replica_deaths")
+    if deaths:
+        lines.append("")
+        lines.append("%sreplica deaths: %d%s" % (RED, deaths, RESET))
+    if not fleet and not any(n.startswith("serve.")
+                             for n in counters):
+        lines.append(DIM + "  (no serve.* metrics yet)" + RESET)
+    return "\n".join(lines)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description=__doc__,
@@ -210,6 +310,10 @@ def main(argv=None):
     src.add_argument("--url", help="full /snapshot URL")
     src.add_argument("--stream", help="tail a MXNET_TPU_METRICS_STREAM file")
     parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving view (tokens/s, queue, batch, shed, "
+                             "TTFT/TPOT); understands both /snapshot and "
+                             "/fleet/snapshot payloads")
     parser.add_argument("--interval", type=float, default=2.0)
     parser.add_argument("--once", action="store_true",
                         help="render one frame and exit")
@@ -239,7 +343,8 @@ def main(argv=None):
             continue
         now = time.monotonic()
         dt = (now - prev_t) if prev_t is not None else None
-        frame = render(payload, prev, dt, source=source)
+        renderer = render_serve if args.serve else render
+        frame = renderer(payload, prev, dt, source=source)
         if args.once:
             print(frame)
             return 0

@@ -521,6 +521,189 @@ def _print_compile(rows, fmt):
         print(line % r)
 
 
+def parse_requests(obj):
+    """Flatten a per-request trace dump — the `/requests` endpoint body
+    ({"requests": [...]}) or a bare `telemetry.request_traces()` list —
+    into [(request, outcome, wall_ms, queue_ms, prefill_ms, decode_ms,
+    recovery_ms, ttft_ms, tokens, requeues, acct_pct)] rows."""
+    if isinstance(obj, dict):
+        reqs = obj.get("requests", [])
+    else:
+        reqs = obj or []
+    rows = []
+    for r in reqs:
+        phases = r.get("phases_ms", {})
+        wall = r.get("wall_ms") or 0.0
+        acct = r.get("accounted_ms")
+        acct_pct = (round(100.0 * acct / wall, 1)
+                    if acct is not None and wall else "")
+        rows.append((r.get("request_id", "?"), r.get("outcome", "?"),
+                     wall, phases.get("queue", 0.0),
+                     phases.get("prefill", 0.0), phases.get("decode", 0.0),
+                     phases.get("recovery", 0.0),
+                     r.get("ttft_ms") if r.get("ttft_ms") is not None
+                     else "",
+                     r.get("tokens", ""), r.get("requeues", 0), acct_pct))
+    return rows
+
+
+def _print_requests(rows, fmt):
+    if not rows:
+        print("no request traces in this dump (nothing served, or "
+              "telemetry disabled)", file=sys.stderr)
+        return
+    header = ("request", "outcome", "wall_ms", "queue_ms", "prefill_ms",
+              "decode_ms", "recovery_ms", "ttft_ms", "tokens", "requeues",
+              "acct_pct")
+    if fmt == "markdown":
+        print("| " + " | ".join(header) + " |")
+        print("|" + " --- |" * len(header))
+        line = "| " + " | ".join(["%s"] * len(header)) + " |"
+    else:
+        print(",".join(header))
+        line = ",".join(["%s"] * len(header))
+    for r in rows:
+        print(line % r)
+
+
+# span categories for the --overlap decomposition (stdlib re-derivation of
+# mxnet_tpu.telemetry.attribution — this tool must run without mxnet_tpu
+# importable; keep the category sets in sync)
+_OVL_COMM = ("comm",)
+_OVL_HOST = ("host", "resilience", "fault", "user")
+_OVL_IDLE = ("idle",)
+
+
+def _ovl_union(iv):
+    if not iv:
+        return 0.0, []
+    iv = sorted(iv)
+    merged = [list(iv[0])]
+    for s, e in iv[1:]:
+        if s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return sum(e - s for s, e in merged), [(s, e) for s, e in merged]
+
+
+def _ovl_subtract(iv, cover):
+    out = []
+    for s, e in iv:
+        cur = s
+        for cs, ce in cover:
+            if ce <= cur:
+                continue
+            if cs >= e:
+                break
+            if cs > cur:
+                out.append((cur, cs))
+            cur = max(cur, ce)
+            if cur >= e:
+                break
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+def _trace_events(obj):
+    """Span events as (name, cat, ts_s, dur_s) from either a chrome trace
+    dump (`telemetry.dump_trace()`: traceEvents, µs) or a raw
+    `local_trace_dump()` object (events, s)."""
+    if "traceEvents" in obj:
+        out = []
+        for e in obj["traceEvents"]:
+            if e.get("ph") != "X":
+                continue
+            out.append((e.get("name", "?"), e.get("cat", ""),
+                        e.get("ts", 0.0) / 1e6, e.get("dur", 0.0) / 1e6))
+        return out
+    return [(n, c, ts, dur)
+            for n, c, ts, dur, *_ in obj.get("events", [])]
+
+
+def parse_overlap(obj, site=None):
+    """Per-step compute/collective/host/idle decomposition + comm overlap
+    fraction from a trace dump: one row per cat-``step`` span, plus a
+    TOTAL row. Returns [(step, site, step_ms, compute_ms, collective_ms,
+    host_ms, idle_ms, comm_n, overlap_frac)]."""
+    events = _trace_events(obj)
+    steps = [(n, ts, dur) for n, c, ts, dur in events
+             if c == "step" and (site is None or n == site)]
+    rows = []
+    totals = {"step": 0.0, "compute": 0.0, "coll": 0.0, "host": 0.0,
+              "idle": 0.0, "n_comm": 0}
+    phase_total = overlap_weighted = 0.0
+    for i, (name, t0, dur) in enumerate(steps):
+        t1 = t0 + dur
+
+        def clip(cats):
+            out = []
+            for _n, c, ts, d in events:
+                if c not in cats:
+                    continue
+                s, e = max(ts, t0), min(ts + d, t1)
+                if e > s:
+                    out.append((s, e))
+            return out
+
+        comm_iv = clip(_OVL_COMM)
+        coll, comm_cover = _ovl_union(comm_iv)
+        host, host_cover = _ovl_union(
+            _ovl_subtract(clip(_OVL_HOST), comm_cover))
+        idle, _ = _ovl_union(_ovl_subtract(
+            _ovl_subtract(clip(_OVL_IDLE), comm_cover), host_cover))
+        compute = max(0.0, (t1 - t0) - coll - host - idle)
+        ovl = ""
+        if comm_iv:
+            phase0 = min(s for s, _e in comm_iv)
+            phase = t1 - phase0
+            in_phase, _ = _ovl_union([(max(s, phase0), e)
+                                      for s, e in comm_iv])
+            if phase > 0:
+                ovl = round(max(0.0, phase - in_phase) / phase, 4)
+                phase_total += phase
+                overlap_weighted += ovl * phase
+        rows.append((i, name, round(dur * 1e3, 3),
+                     round(compute * 1e3, 3), round(coll * 1e3, 3),
+                     round(host * 1e3, 3), round(idle * 1e3, 3),
+                     len(comm_iv), ovl))
+        totals["step"] += dur
+        totals["compute"] += compute
+        totals["coll"] += coll
+        totals["host"] += host
+        totals["idle"] += idle
+        totals["n_comm"] += len(comm_iv)
+    if rows:
+        rows.append(("TOTAL", site or "*", round(totals["step"] * 1e3, 3),
+                     round(totals["compute"] * 1e3, 3),
+                     round(totals["coll"] * 1e3, 3),
+                     round(totals["host"] * 1e3, 3),
+                     round(totals["idle"] * 1e3, 3), totals["n_comm"],
+                     round(overlap_weighted / phase_total, 4)
+                     if phase_total else ""))
+    return rows
+
+
+def _print_overlap(rows, fmt):
+    if not rows:
+        print("no step spans in this trace dump (record steps — trainer/"
+              "fused_step/serve.step — or pass a merged dump)",
+              file=sys.stderr)
+        return
+    header = ("step", "site", "step_ms", "compute_ms", "collective_ms",
+              "host_ms", "idle_ms", "comm_n", "overlap_frac")
+    if fmt == "markdown":
+        print("| " + " | ".join(header) + " |")
+        print("|" + " --- |" * len(header))
+        line = "| " + " | ".join(["%s"] * len(header)) + " |"
+    else:
+        print(",".join(header))
+        line = ",".join(["%s"] * len(header))
+    for r in rows:
+        print(line % r)
+
+
 # severity ordering for the lint table: errors first, then by location
 _LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
 
@@ -631,6 +814,22 @@ def main():
                              "from a telemetry JSON dump / "
                              "telemetry.compile_report() / BENCH=startup "
                              "row")
+    parser.add_argument("--requests", dest="requests_mode",
+                        action="store_true",
+                        help="per-request trace mode: one row per served "
+                             "request (ttft/queue-wait/prefill/decode/"
+                             "recovery, outcome, requeues) from a "
+                             "/requests endpoint dump or a "
+                             "telemetry.request_traces() JSON list")
+    parser.add_argument("--overlap", action="store_true",
+                        help="comm-overlap attribution mode: per-step "
+                             "compute/collective/host/idle decomposition "
+                             "and the comm overlap fraction from a chrome "
+                             "trace dump (telemetry.dump_trace output) — "
+                             "the schedule autotuner's evidence table")
+    parser.add_argument("--site", default=None,
+                        help="with --overlap: only decompose step spans "
+                             "with this name (e.g. serve.step)")
     parser.add_argument("--anomalies", action="store_true",
                         help="anomaly mode: telemetry.anomaly.* counters + "
                              "step-time histograms from a telemetry JSON "
@@ -638,6 +837,25 @@ def main():
                              "or SLO?")
     args = parser.parse_args()
     obj = _load_json(args.logfile)
+    if args.requests_mode:
+        # a bare telemetry.request_traces() list is a valid input here,
+        # which _load_json (dict-only) rejects — load it directly
+        raw = None
+        try:
+            with open(args.logfile) as f:
+                raw = json.load(f)
+        except (ValueError, OSError):
+            pass
+        if not isinstance(raw, (dict, list)):
+            sys.exit("--requests input is not JSON: %s" % args.logfile)
+        _print_requests(parse_requests(raw), args.format)
+        return
+    if args.overlap:
+        if obj is None:
+            sys.exit("--overlap input is not a JSON object: %s"
+                     % args.logfile)
+        _print_overlap(parse_overlap(obj, site=args.site), args.format)
+        return
     if args.compile_mode:
         if obj is None:
             sys.exit("--compile input is not a JSON object: %s"
