@@ -29,12 +29,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..parallel.flash_attention import flash_attention, paged_attention
+from ..parallel.flash_attention import (flash_attention, paged_attention,
+                                        paged_attention_chunk)
 from ..parallel.ring_attention import ring_attention
 
 __all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss",
            "init_kv_cache", "llama_decode_step", "init_kv_pools",
-           "llama_prefill_paged", "llama_decode_paged", "CONFIGS"]
+           "llama_prefill_paged", "llama_decode_paged", "llama_chunk_paged",
+           "llama_draft_loop", "CONFIGS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -330,7 +332,11 @@ def llama_decode_paged(params, pools, tokens, positions, block_tables,
     cos, sin = rope_freqs(pos[:, None], cfg.head_dim, cfg.rope_theta)
     blk = jnp.take_along_axis(block_tables, (pos // block_size)[:, None],
                               axis=1)[:, 0]
-    blk = jnp.where(active, blk, num_blocks)
+    # inactive slots AND positions past the table drop their writes (an
+    # out-of-range gather index would clamp onto the last real block —
+    # the speculative draft loop can run past the reserved range)
+    in_range = pos // block_size < block_tables.shape[1]
+    blk = jnp.where(active & in_range, blk, num_blocks)
     off = pos % block_size
     lengths = pos + 1          # inactive slots read one masked garbage row
     new_pools = {}
@@ -357,6 +363,100 @@ def llama_decode_paged(params, pools, tokens, positions, block_tables,
     head = params["tok_embeddings"] if cfg.tie_embeddings else params["lm_head"]
     logits = (x[:, 0] @ head.T.astype(x.dtype)).astype(jnp.float32)
     return logits, new_pools
+
+
+def llama_chunk_paged(params, pools, tokens, positions, block_tables,
+                      cfg: LlamaConfig, block_size, logits_at="last"):
+    """Multi-row chunk forward over the paged pool — the one program shape
+    behind BOTH chunked prefill and speculative verify.
+
+    Each row b carries a window of C consecutive context tokens for one
+    stream: tokens[b, c] sits at absolute position positions[b, c]
+    (position -1 = padding — its KV write drops and its output is
+    garbage the caller ignores). The chunk's KV is scattered into the
+    pool layer by layer BEFORE that layer's attention gathers, so queries
+    see the whole causal context: earlier chunks of the same stream, a
+    shared prompt prefix, and earlier tokens of this very chunk —
+    processing a prompt chunk-by-chunk is bit-for-bit the same math as
+    one monolithic prefill, and several rows may even be consecutive
+    chunks of ONE stream (each row's queries mask by absolute position).
+
+    tokens (B, C) int32; positions (B, C) int32; block_tables (B, nb)
+    int32. Returns (logits, new_pools): logits_at="last" projects only
+    each row's LAST valid position ((B, vocab) — the chunked-prefill
+    next-token read, one vocab row per stream, never C); "all" projects
+    every position ((B, C, vocab) — speculative verify needs the greedy
+    token at each drafted position).
+    """
+    B, C = tokens.shape
+    num_blocks = pools["0"]["k"].shape[0]
+    active = positions >= 0
+    pos = jnp.maximum(positions, 0)
+    x = params["tok_embeddings"][tokens]                     # (B,C,D)
+    cos, sin = rope_freqs(pos, cfg.head_dim, cfg.rope_theta)
+    blk = jnp.take_along_axis(block_tables, pos // block_size, axis=1)
+    # pads drop; so do positions past the table — the gather would CLAMP
+    # an out-of-range index onto the last real block and overwrite live
+    # KV rows, so out-of-range writes must vanish, not wrap
+    in_range = pos // block_size < block_tables.shape[1]
+    blk = jnp.where(active & in_range, blk, num_blocks)
+    off = pos % block_size
+    lengths = pos + 1          # per-query causal horizon (pads read row 0)
+    new_pools = {}
+    for i in range(cfg.n_layers):
+        lp = params["layers"][str(i)]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["attn"]["wq"]).reshape(B, C, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["attn"]["wk"]).reshape(B, C, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        v = (h @ lp["attn"]["wv"]).reshape(B, C, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+        k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+        v = v.transpose(0, 2, 1, 3)
+        # scatter the chunk's KV, THEN gather: each query's mask stops at
+        # its own position, so the later rows of the window read the
+        # earlier rows' keys through the pool
+        pk = pools[str(i)]["k"].at[blk, :, off].set(
+            k.transpose(0, 2, 1, 3), mode="drop")
+        pv = pools[str(i)]["v"].at[blk, :, off].set(
+            v.transpose(0, 2, 1, 3), mode="drop")
+        new_pools[str(i)] = {"k": pk, "v": pv}
+        o = paged_attention_chunk(q, pk, pv, block_tables, lengths)
+        x = x + o.transpose(0, 2, 1, 3).reshape(B, C, -1) @ lp["attn"]["wo"]
+        x = _mlp(lp, x, cfg)
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    head = params["tok_embeddings"] if cfg.tie_embeddings else params["lm_head"]
+    if logits_at == "last":
+        # last valid column per row (fully-padded rows read column 0 —
+        # garbage the scheduler never looks at)
+        last = jnp.maximum(jnp.sum(active.astype(jnp.int32), axis=1) - 1, 0)
+        x = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    return (x @ head.T.astype(x.dtype)).astype(jnp.float32), new_pools
+
+
+def llama_draft_loop(params, pools, tokens, positions, block_tables,
+                     cfg: LlamaConfig, block_size, k):
+    """k greedy decode steps in ONE program — the speculative-decoding
+    draft. Statically unrolled: step i feeds step i-1's argmax, writes the
+    draft model's KV as it goes (position -1 = inactive slot throughout).
+
+    tokens/positions (B,) int32, block_tables (B, nb) int32. Returns
+    (draft tokens (B, k) int32, new pools)."""
+    drafted = []
+    tok, pos = tokens, positions
+    for _ in range(int(k)):
+        logits, pools = llama_decode_paged(params, pools, tok, pos,
+                                           block_tables, cfg, block_size)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drafted.append(tok)
+        pos = jnp.where(positions >= 0, pos + 1, positions)
+    # one extra write-only pass: the LAST draft's KV must land too, or a
+    # fully-accepted round leaves a hole the NEXT round's draft attends
+    # through (stale row -> dropped accept rate, never wrong output)
+    _, pools = llama_decode_paged(params, pools, tok, pos, block_tables,
+                                  cfg, block_size)
+    return jnp.stack(drafted, axis=1), pools
 
 
 def llama_decode_step(params, cache, token, pos, cfg: LlamaConfig):

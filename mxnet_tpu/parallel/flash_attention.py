@@ -32,7 +32,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "paged_attention"]
+__all__ = ["flash_attention", "paged_attention", "paged_attention_chunk"]
 
 _NEG_INF = -1e30
 
@@ -483,3 +483,47 @@ def paged_attention(q, k_pool, v_pool, block_tables, lengths, sm_scale=None):
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v)
     return out.reshape(B, H, 1, D).astype(q.dtype)
+
+
+def paged_attention_chunk(q, k_pool, v_pool, block_tables, q_lengths,
+                          sm_scale=None):
+    """Multi-query attention over a paged KV pool with PER-QUERY lengths —
+    the chunked-prefill / speculative-verify generalization of
+    `paged_attention` (which is the C=1 special case).
+
+    A chunk of C tokens from one stream occupies consecutive positions
+    whose KV has just been scattered into the pool; query c may only see
+    positions < q_lengths[b, c] (its own position + 1 — causality ACROSS
+    the pool, not just within the chunk, so a chunk attends to every
+    earlier chunk and to a shared prefix for free).
+
+    q:            (B, H, C, D) — C new query tokens per stream.
+    k_pool/v_pool:(N, Hkv, bs, D) — the shared physical pool.
+    block_tables: (B, nb) int32 — per-stream block ids (entries >= N are
+                  unallocated; the length mask discards their rows).
+    q_lengths:    (B, C) int32 — valid context length per query (the
+                  query's own KV already written). Rows for padded /
+                  inactive queries pass 1 and ignore the output.
+
+    Returns (B, H, C, D) in q's dtype — the same grouped-einsum fp32
+    softmax as `paged_attention`, so a C=1 call and a decode call agree
+    on the positions the masks keep."""
+    B, H, C, D = q.shape
+    Hkv, bs = k_pool.shape[1], k_pool.shape[2]
+    nb = block_tables.shape[1]
+    g = H // Hkv
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+    k = k_pool[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, Hkv, nb * bs, D)
+    v = v_pool[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, Hkv, nb * bs, D)
+    qg = q.reshape(B, Hkv, g, C, D)
+    logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    mask = lax.broadcasted_iota(jnp.int32, (B, 1, 1, C, nb * bs), 4) \
+        < q_lengths[:, None, None, :, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v)
+    return out.reshape(B, H, C, D).astype(q.dtype)

@@ -3,13 +3,25 @@
 Training got the first seven PRs; this subsystem spends that
 infrastructure on the north star's other half: serving. One replica is an
 `InferenceServer` — a continuous-batching scheduler (requests join/leave
-the running batch between decode steps) over a **paged KV-cache allocator**
-(`KVBlockPool`: fixed-size blocks + free-list, sized by
-``MXNET_TPU_SERVE_KV_BLOCKS`` × ``MXNET_TPU_SERVE_KV_BLOCK``) and
-**AOT-compiled prefill/decode programs** per bucketed context length
-(`ServePrograms`: every signature compiled at warm-up, so admission never
-retraces mid-traffic). `ReplicaGroup` supervises N replicas over one
-shared queue.
+the running batch between decode steps) over a **refcounted paged
+KV-cache allocator** (`KVBlockPool`: fixed-size blocks + free-list +
+hash-consed shared-prefix index, sized by ``MXNET_TPU_SERVE_KV_BLOCKS``
+× ``MXNET_TPU_SERVE_KV_BLOCK``) and **fixed-shape AOT programs**
+(`ServePrograms`: ONE multi-stream chunk-prefill window, ONE decode
+executable, a CoW block copy, and the draft/verify pair when speculative
+decoding is configured — every signature compiled at warm-up, so
+admission never retraces mid-traffic). `ReplicaGroup` supervises N
+replicas over one shared queue.
+
+Serving v2 throughput layers, all attributable in telemetry and
+`BENCH=serve`: burst arrivals prefill TOGETHER in chunk windows
+interleaved with decode (``serve.prefill_chunks``); N users of one
+system prompt share its KV blocks by refcount with copy-on-write at the
+divergence block (``serve.prefix.*``); a small draft model multiplies
+greedy tokens/s where decode is HBM-bound (``serve.spec.*``,
+byte-identical output by construction); and temperature/top-k/top-p
+sampling draws are keyed on (stream seed, position) so kill-recovery
+replays them exactly.
 
 The robustness contract, end to end:
 
@@ -48,11 +60,14 @@ from __future__ import annotations
 
 from .errors import DeadlineExceeded, Overloaded, ServeError
 from .kv_cache import KVBlockPool
-from .programs import ServePrograms, default_buckets
+from .programs import (ServePrograms, default_chunk_size,
+                       default_prefill_rows, default_spec_k)
 from .replica import ReplicaGroup
+from .sampling import sample_tokens
 from .scheduler import (InferenceServer, Request, RequestQueue,
                         StreamHandle)
 
 __all__ = ["ServeError", "Overloaded", "DeadlineExceeded", "KVBlockPool",
-           "ServePrograms", "default_buckets", "InferenceServer",
+           "ServePrograms", "default_chunk_size", "default_prefill_rows",
+           "default_spec_k", "sample_tokens", "InferenceServer",
            "Request", "RequestQueue", "StreamHandle", "ReplicaGroup"]

@@ -1,4 +1,4 @@
-"""Paged KV-cache allocator: fixed-size blocks with a free-list.
+"""Paged KV-cache allocator: refcounted fixed-size blocks + prefix sharing.
 
 The naive decode cache (`models.llama.init_kv_cache`) is a
 (batch, max_seq_len) rectangle per stream — a 64-token chat in an 8k-context
@@ -12,16 +12,40 @@ context fills, via a block table the jitted programs use to gather/scatter
 their blocks to a free-list for immediate reuse — fragmentation is
 impossible by construction because every block is interchangeable.
 
-Exhaustion is a *verdict*, not a crash: `alloc` either reserves every block
-the caller asked for or raises a structured `Overloaded` having reserved
-nothing, so admission control can shed the request (or leave it queued)
-while the streams already running keep their memory. Freed blocks are not
-zeroed — a reused block is fully overwritten up to its new owner's length,
-and positions past that length are masked out of every gather.
+Blocks are **refcounted**, because a block can now have several owners:
+KV at position p depends only on the token sequence 0..p, so a FULL block
+of a prompt whose tokens (and whole preceding context) match another
+stream's is the *same* block — N concurrent users of one system prompt
+share its blocks instead of each prefilling their own copy. The pool
+hash-conses full prompt-prefix blocks in a chain-keyed index (node key =
+(parent, block tokens) — the chain IS the hash, so equal-token blocks
+under different prefixes never unify) and `admit` finds the block-aligned
+longest-common-prefix at admission: matched full blocks join the new
+stream's table with a refcount bump and prefill SKIPS their positions;
+when the match ends mid-block, the divergence block is **copied-on-write**
+(one fresh private block + a device block-copy of the partially-matched
+source, counted ``serve.prefix.cow``) so the stream recomputes only from
+its true divergence point. Shared blocks are never written after their
+prefill (decode appends strictly past the prompt), so sharing needs no
+write barriers — only exact refcounts. The index holds its own reference
+per cached block (a finished stream's prefix stays warm for the next
+user) and evicts least-recently-matched leaf entries when allocation
+would otherwise fail.
+
+Exhaustion is a *verdict*, not a crash: `alloc`/`admit` either reserve
+every block the caller asked for or raise a structured `Overloaded` having
+reserved nothing, so admission control can shed the request (or leave it
+queued) while the streams already running keep their memory. Freed blocks
+are not zeroed — a reused block is fully overwritten up to its new owner's
+length, and positions past that length are masked out of every gather.
 
 Telemetry: ``serve.kv.blocks_in_use`` gauge (watermark = peak pool
 pressure), ``serve.kv.allocs`` / ``serve.kv.freed_blocks`` /
-``serve.kv.exhausted`` counters.
+``serve.kv.exhausted`` counters, and the prefix-sharing story:
+``serve.prefix.lookups`` / ``hits`` / ``blocks_shared`` (each one a
+whole block of prefill skipped AND a block of HBM saved while shared) /
+``cow`` / ``inserted`` / ``evictions``, plus the ``serve.prefix.blocks``
+gauge (blocks currently pinned by the index).
 """
 from __future__ import annotations
 
@@ -33,7 +57,8 @@ import numpy as np
 from .. import telemetry as _telem
 from .errors import Overloaded
 
-__all__ = ["KVBlockPool", "default_num_blocks", "default_block_size"]
+__all__ = ["KVBlockPool", "default_num_blocks", "default_block_size",
+           "prefix_sharing_enabled"]
 
 
 def default_num_blocks():
@@ -50,16 +75,38 @@ def default_block_size():
         return 16
 
 
-class KVBlockPool:
-    """Physical paged KV pool + block accounting for one serving replica.
+def prefix_sharing_enabled():
+    return os.environ.get("MXNET_TPU_SERVE_PREFIX", "1").lower() not in (
+        "0", "false", "off")
 
-    Owns the per-layer pool arrays (`models.llama.init_kv_pools` layout)
-    and the stream → block-table map. The jitted programs treat the arrays
-    functionally; `update()` swaps in each program's returned pools (the
-    programs donate the inputs, so the swap is also the memory's lifetime).
+
+class _PrefixNode:
+    """One hash-consed full block of cached prompt prefix."""
+
+    __slots__ = ("key", "parent", "tokens", "block", "children", "lru")
+
+    def __init__(self, key, parent, tokens, block, lru):
+        self.key = key
+        self.parent = parent
+        self.tokens = tokens
+        self.block = block
+        self.children = set()       # child node keys
+        self.lru = lru
+
+
+class KVBlockPool:
+    """Physical paged KV pool + refcounted block accounting for one
+    serving replica.
+
+    Owns the per-layer pool arrays (`models.llama.init_kv_pools` layout),
+    the stream → block-table map, per-block refcounts, and the prefix
+    index. The jitted programs treat the arrays functionally; `update()`
+    swaps in each program's returned pools (the programs donate the
+    inputs, so the swap is also the memory's lifetime).
     """
 
-    def __init__(self, cfg, num_blocks=None, block_size=None, dtype=None):
+    def __init__(self, cfg, num_blocks=None, block_size=None, dtype=None,
+                 prefix_sharing=None):
         from ..models.llama import init_kv_pools
         self.cfg = cfg
         self.num_blocks = int(num_blocks or default_num_blocks())
@@ -70,6 +117,13 @@ class KVBlockPool:
         # LIFO free-list: a just-freed (cache-warm) block is reused first
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._tables = {}           # stream_id -> [block ids]
+        self._refs = {}             # block id -> owner count (tables+index)
+        self.prefix_sharing = (prefix_sharing_enabled()
+                               if prefix_sharing is None
+                               else bool(prefix_sharing))
+        self._nodes = {}            # node key -> _PrefixNode
+        self._roots = set()         # node keys with parent None
+        self._lru_clock = 0
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- geometry
@@ -87,6 +141,106 @@ class KVBlockPool:
         with self._lock:
             return self.num_blocks - len(self._free)
 
+    @property
+    def prefix_blocks(self):
+        """Blocks currently pinned by the prefix index."""
+        with self._lock:
+            return len(self._nodes)
+
+    def _gauge_locked(self):
+        return self.num_blocks - len(self._free)
+
+    # -------------------------------------------------------- prefix match
+    def _children_of(self, parent_key):
+        if parent_key is None:
+            return self._roots
+        node = self._nodes.get(parent_key)
+        return node.children if node is not None else ()
+
+    def _match_locked(self, context, limit):
+        """Longest cached prefix of `context`, capped at `limit` tokens.
+        Returns (shared block ids, fill_start, cow source block or None).
+        Only reads + LRU touches — no refcount changes (commit happens in
+        `admit` after the fresh allocation is known to fit)."""
+        bs = self.block_size
+        matched = []                # fully matched nodes, chain order
+        parent = None
+        i = 0
+        while (i + 1) * bs <= len(context):
+            key = (parent, tuple(context[i * bs:(i + 1) * bs]))
+            node = self._nodes.get(key)
+            if node is None:
+                break
+            matched.append(node)
+            parent = key
+            i += 1
+        raw = len(matched) * bs
+        # sub-block tail: the child whose tokens share the longest prefix
+        # with the remainder — its block is the copy-on-write source
+        partial_node, partial_len = None, 0
+        rest = context[raw:raw + bs]
+        if rest:
+            for key in self._children_of(parent):
+                node = self._nodes[key]
+                n = 0
+                for a, b in zip(node.tokens, rest):
+                    if a != b:
+                        break
+                    n += 1
+                if n > partial_len:
+                    partial_node, partial_len = node, n
+        fill_start = min(raw + partial_len, limit)
+        shared = [n.block for n in matched[:fill_start // bs]]
+        cow_src = None
+        if fill_start % bs:
+            idx = fill_start // bs
+            src = matched[idx] if idx < len(matched) else partial_node
+            cow_src = src.block
+            src.lru = self._lru_clock = self._lru_clock + 1
+        for node in matched[:fill_start // bs]:
+            node.lru = self._lru_clock = self._lru_clock + 1
+        return shared, fill_start, cow_src
+
+    def _evict_locked(self, need, protect=()):
+        """Reclaim up to `need` blocks from the prefix index: drop
+        least-recently-matched LEAF entries whose block has no other
+        owner. An entry some live stream still shares is skipped —
+        evicting it would lose the cache without freeing anything — and
+        so are `protect`ed blocks (the CURRENT admission's matched
+        prefix/CoW source: evicting those would recycle a block into the
+        same table twice, the stream clobbering its own shared KV)."""
+        protect = set(protect)
+        freed = 0
+        while freed < need:
+            best = None
+            for node in self._nodes.values():
+                if (node.children or node.block in protect
+                        or self._refs.get(node.block, 0) != 1):
+                    continue
+                if best is None or node.lru < best.lru:
+                    best = node
+            if best is None:
+                break
+            self._drop_node_locked(best)
+            freed += 1
+            _telem.inc("serve.prefix.evictions")
+        return freed
+
+    def _drop_node_locked(self, node):
+        del self._nodes[node.key]
+        (self._roots if node.parent is None
+         else self._nodes[node.parent].children).discard(node.key)
+        self._unref_locked(node.block)
+
+    def _unref_locked(self, block):
+        n = self._refs.get(block, 0) - 1
+        if n > 0:
+            self._refs[block] = n
+            return 0
+        self._refs.pop(block, None)
+        self._free.append(block)
+        return 1
+
     # ----------------------------------------------------------- allocation
     def alloc(self, stream_id, n_tokens):
         """Grow `stream_id`'s block table to cover `n_tokens` positions.
@@ -94,13 +248,48 @@ class KVBlockPool:
         All-or-nothing: raises `Overloaded(reason="kv_exhausted")` — having
         reserved NOTHING — when the free-list is short, so a rejected
         admission never strands half a context in the pool."""
+        table, _, _ = self.admit(stream_id, n_tokens, context=None)
+        return table
+
+    def admit(self, stream_id, n_tokens, context=None):
+        """Admission-time reservation: grow the stream's table to cover
+        `n_tokens` positions, sharing the longest cached block-aligned
+        prefix of `context` (a token list) when prefix sharing is on.
+
+        Returns (table, fill_start, cow): prefill may skip positions
+        below `fill_start`; when `cow` is a (src, dst) pair the caller
+        must device-copy block src onto the freshly-allocated block dst
+        (the divergence block) before relying on positions below
+        fill_start in it. All-or-nothing like `alloc` — on `Overloaded`
+        nothing is reserved, no refcount moved."""
         need_total = self.blocks_for(n_tokens)
+        shared_n = 0
         with self._lock:
             table = self._tables.get(stream_id, [])
-            grow = need_total - len(table)
-            if grow <= 0:
-                return list(table)
+            if table:
+                # growth of an existing stream never re-matches: its
+                # prefix blocks were fixed at first admission
+                shared, fill_start, cow_src = [], 0, None
+            elif context is not None and self.prefix_sharing:
+                _telem.inc("serve.prefix.lookups")
+                shared, fill_start, cow_src = self._match_locked(
+                    [int(t) for t in context], max(0, len(context) - 1))
+            else:
+                shared, fill_start, cow_src = [], 0, None
+            grow = need_total - len(table) - len(shared)
+            if cow_src is not None and grow <= 0:
+                cow_src = None      # nothing allocated to copy onto
+            protect = set(shared)
+            if cow_src is not None:
+                protect.add(cow_src)
             if grow > len(self._free):
+                # protecting the match never costs capacity: sharing s
+                # blocks shrinks the demand by exactly the s blocks an
+                # unshared admission would have had to evict, so if this
+                # still comes up short the pool is GENUINELY full and
+                # Overloaded (backpressure) is the right verdict
+                self._evict_locked(grow - len(self._free), protect=protect)
+            if max(grow, 0) > len(self._free):
                 # reserve NOTHING on failure — not even an empty table
                 # entry: rejected stream ids are uuids that never return,
                 # so a leftover entry would leak one dict slot per shed
@@ -112,29 +301,102 @@ class KVBlockPool:
                     % (stream_id, grow, n_tokens, free, self.num_blocks),
                     reason="kv_exhausted", kv_free_blocks=free,
                     kv_needed_blocks=grow)
-            table = table + [self._free.pop() for _ in range(grow)]
+            if grow <= 0 and not shared:
+                return list(table), 0, None
+            for b in shared:
+                self._refs[b] = self._refs.get(b, 0) + 1
+            fresh = [self._free.pop() for _ in range(max(grow, 0))]
+            for b in fresh:
+                self._refs[b] = 1
+            table = table + shared + fresh
             self._tables[stream_id] = table
-            in_use = self.num_blocks - len(self._free)
+            cow = (cow_src, table[len(shared)]) if cow_src is not None \
+                else None
+            shared_n = len(shared)
+            in_use = self._gauge_locked()
         _telem.inc("serve.kv.allocs")
+        if shared_n or cow is not None:
+            # a CoW-only match (divergence inside the first block) still
+            # reused cached KV — it is a hit, not a miss
+            _telem.inc("serve.prefix.hits")
+        if shared_n:
+            _telem.inc("serve.prefix.blocks_shared", shared_n)
+        if cow is not None:
+            _telem.inc("serve.prefix.cow")
         _telem.set_gauge("serve.kv.blocks_in_use", in_use)
-        return list(table)
+        return list(table), fill_start, cow
 
     def free(self, stream_id):
-        """Return the stream's blocks to the free-list (idempotent)."""
+        """Drop the stream's references; blocks with no other owner (a
+        sharing sibling or the prefix index) return to the free-list
+        (idempotent). Returns the number of blocks actually freed."""
         with self._lock:
             table = self._tables.pop(stream_id, None)
             if not table:
                 return 0
-            self._free.extend(reversed(table))
-            in_use = self.num_blocks - len(self._free)
-        _telem.inc("serve.kv.freed_blocks", len(table))
+            freed = sum(self._unref_locked(b) for b in table)
+            in_use = self._gauge_locked()
+        if freed:
+            _telem.inc("serve.kv.freed_blocks", freed)
         _telem.set_gauge("serve.kv.blocks_in_use", in_use)
-        return len(table)
+        return freed
+
+    # -------------------------------------------------------- prefix index
+    def register_prefix(self, stream_id, tokens):
+        """Hash-cons the stream's FULL blocks covering `tokens` (its
+        prompt) into the prefix index, once its prefill has written them.
+        Already-cached chains are left alone (the stream either shared
+        them at admission or raced a twin — either way the index keeps
+        ONE block per distinct chain); new entries pin the stream's own
+        block with an index reference so the prefix outlives the
+        stream."""
+        if not self.prefix_sharing:
+            return 0
+        bs = self.block_size
+        tokens = [int(t) for t in tokens]
+        inserted = 0
+        with self._lock:
+            table = self._tables.get(stream_id, ())
+            parent = None
+            for i in range(min(len(tokens) // bs, len(table))):
+                key = (parent, tuple(tokens[i * bs:(i + 1) * bs]))
+                node = self._nodes.get(key)
+                if node is None:
+                    node = _PrefixNode(key, parent, key[1], table[i],
+                                       self._lru_clock)
+                    self._nodes[key] = node
+                    (self._roots if parent is None
+                     else self._nodes[parent].children).add(key)
+                    self._refs[table[i]] = self._refs.get(table[i], 0) + 1
+                    inserted += 1
+                parent = key
+            n_blocks = len(self._nodes)
+        if inserted:
+            _telem.inc("serve.prefix.inserted", inserted)
+        _telem.set_gauge("serve.prefix.blocks", n_blocks)
+        return inserted
+
+    def clear_prefix_cache(self):
+        """Drop every cached prefix (and its index references). Recovery
+        calls this after the pool storage was re-materialized: the arrays
+        are fresh zeros, so every cached block's CONTENT is gone and a
+        future match would serve garbage KV."""
+        with self._lock:
+            freed = sum(self._unref_locked(n.block)
+                        for n in self._nodes.values())
+            self._nodes.clear()
+            self._roots.clear()
+            in_use = self._gauge_locked()
+        if freed:
+            _telem.inc("serve.kv.freed_blocks", freed)
+        _telem.set_gauge("serve.prefix.blocks", 0)
+        _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+        return freed
 
     def table(self, stream_id, width):
         """The stream's block table as a width-`width` int32 array, padded
         with the `num_blocks` sentinel (dropped writes / masked reads).
-        Truncates past `width`: a prefill bucket's table only names the
+        Truncates past `width`: a prefill window's table only names the
         blocks its positions can touch, even when the stream reserved its
         worst-case context up front."""
         with self._lock:
@@ -154,21 +416,29 @@ class KVBlockPool:
         self.pools = new_pools
 
     def reconcile(self):
-        """Rebuild the free-list as the exact complement of every live
-        table. Recovery calls this because an async fault (the watchdog's
-        StallError lands at any bytecode) can tear alloc/free mid-flight:
-        blocks popped from the free-list but not yet committed to a
-        table — or popped from a table but not yet returned — are in
-        NEITHER structure and would otherwise leak forever, shrinking
-        effective pool capacity with every stall. Returns the number of
+        """Rebuild refcounts and the free-list as the exact complement of
+        every live owner (stream tables + prefix index). Recovery calls
+        this because an async fault (the watchdog's StallError lands at
+        any bytecode) can tear alloc/free mid-flight: blocks popped from
+        the free-list but not yet committed to a table — or dropped from
+        a table but not yet returned — are in NEITHER structure and would
+        otherwise leak forever, shrinking effective pool capacity with
+        every stall; likewise a torn refcount would double-free a shared
+        prefix block under a live sibling. Returns the net number of
         blocks recovered (0 when nothing was torn)."""
         with self._lock:
-            owned = {b for table in self._tables.values() for b in table}
+            refs = {}
+            for table in self._tables.values():
+                for b in table:
+                    refs[b] = refs.get(b, 0) + 1
+            for node in self._nodes.values():
+                refs[node.block] = refs.get(node.block, 0) + 1
             before = len(self._free)
+            self._refs = refs
             self._free = [b for b in range(self.num_blocks - 1, -1, -1)
-                          if b not in owned]
+                          if b not in refs]
             recovered = len(self._free) - before
-            in_use = self.num_blocks - len(self._free)
+            in_use = self._gauge_locked()
         if recovered:
             _telem.inc("serve.kv.reconciled_blocks", recovered)
             _telem.set_gauge("serve.kv.blocks_in_use", in_use)
@@ -180,7 +450,8 @@ class KVBlockPool:
         `pools` pointing at deleted buffers. Recovery requeues every
         stream for re-prefill, so the CONTENT is worthless anyway — the
         arrays just have to be alive again. Returns True when the pools
-        were re-materialized."""
+        were re-materialized (the caller must then `clear_prefix_cache`:
+        cached prefixes point into the zeroed arrays)."""
         import jax
         from ..models.llama import init_kv_pools
         leaves = jax.tree_util.tree_leaves(self.pools)

@@ -1,49 +1,81 @@
-"""AOT-compiled prefill/decode executables per bucketed signature.
+"""AOT-compiled serving executables: chunk prefill, decode, draft, verify.
 
 The reference's answer to varying sequence lengths was BucketingModule —
-one symbolic executor per bucket, picked at dispatch time. Relay (PAPERS.md)
-sharpened that into ahead-of-time compilation per input signature. This
-module is the serving version of both: every program a request could need
-is lowered and compiled at **warm-up** — one prefill executable per
-bucketed context length (right-padded, length-masked) and ONE decode
-executable for the whole replica (batch and block-table dims fixed at
-``max_batch`` × ``blocks_per_stream``; streams join/leave between steps by
-flipping slots active/inactive, never by changing a shape) — so admission
-can never trigger a mid-traffic retrace. Compiles route through
+one symbolic executor per bucket, picked at dispatch time. The first cut of
+this module kept that shape (one prefill executable per power-of-two
+context bucket, batch-1); serving v2 replaces it with a **fixed-shape
+multi-stream chunk program**: every prefill is a (rows × chunk) window of
+prompt tokens scattered into the paged pool at their absolute positions,
+so one executable serves every context length, burst arrivals prefill
+TOGETHER instead of serializing TTFT behind batch-1 programs, and prompt
+work interleaves with decode steps under a per-step token budget (the
+scheduler's job). The same chunk math, at shape (max_batch × spec_k),
+is the speculative-decoding **verify** program; a small draft model rides
+identical plumbing (chunk prefill to mirror the prompt, an unrolled
+greedy draft-k program). Every executable is lowered and compiled at
+**warm-up** and restored from the persistent AOT cache
+(``MXNET_TPU_AOT_CACHE``) when a previous process already built it — so
+admission can never trigger a mid-traffic retrace and a warmed fleet
+cold-starts at zero fresh compiles. Compiles route through
 ``telemetry.note_compile`` (the acceptance evidence: the compile ring must
 not grow after warm-up), and a post-warm-up signature miss is treated
 exactly like a CachedOp retrace: counted (``serve.retrace``), explained,
-and routed through ``analysis.guard.on_retrace`` so the trace guard's
-retrace limit covers the serving path too.
+and routed through ``analysis.guard.on_retrace``.
 
-Sampling is greedy (argmax inside the program — one int32 per stream
-crosses the device boundary, not a vocab row). Greedy is also what makes
-kill-mid-stream recovery *byte-identical*: re-prefilling an interrupted
-stream's prompt + already-emitted tokens rebuilds the same KV state, so the
-resumed decode continues the exact token trajectory.
+Sampling happens inside the chunk/decode programs (`serve.sampling`):
+per-slot temperature/top-k/top-p vectors and a per-stream seed keyed by
+position, so greedy streams stay exactly argmax (one int32 per stream
+crosses the device boundary, not a vocab row) and sampled streams replay
+the same draws after kill-recovery. Speculative decoding stays
+greedy-verify: the draft-k / verify-k pair multiplies tokens/s exactly
+where decode is HBM-bandwidth-bound, with byte-identical output to the
+non-speculative greedy path as the correctness bar.
+
+The executable inventory per replica (all fixed-shape):
+
+* ``chunk``        (P, C) multi-stream prefill window + sampled next token
+* ``decode``       (B,) one token per active slot + sampling
+* ``copy``         one-block device copy (the prefix-sharing CoW)
+* ``draft_chunk``  (P, C) draft-model prompt mirror          [spec only]
+* ``draft_k``      (B, k) unrolled greedy draft              [spec only]
+* ``verify``       (B, k+1) target greedy over drafted tokens [spec only]
+* ``draft_copy``   CoW for the draft pool                    [spec only]
 """
 from __future__ import annotations
 
-import functools
+import os
 import time
 
 import jax
 import numpy as np
 
 from .. import telemetry as _telem
+from .sampling import sample_tokens
 
-__all__ = ["ServePrograms", "default_buckets"]
+__all__ = ["ServePrograms", "default_chunk_size", "default_prefill_rows",
+           "default_spec_k"]
 
 
-def default_buckets(block_size, max_context):
-    """Power-of-two context buckets, block-aligned, covering max_context."""
-    out = []
-    b = max(int(block_size), 8)
-    while b < max_context:
-        out.append(b)
-        b *= 2
-    out.append(-(-int(max_context) // block_size) * block_size)
-    return tuple(sorted(set(out)))
+def default_chunk_size():
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_SERVE_CHUNK", "16")))
+    except (TypeError, ValueError):
+        return 16
+
+
+def default_prefill_rows():
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_SERVE_PREFILL_ROWS",
+                                         "4")))
+    except (TypeError, ValueError):
+        return 4
+
+
+def default_spec_k():
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_SERVE_SPEC_K", "4")))
+    except (TypeError, ValueError):
+        return 4
 
 
 class ServePrograms:
@@ -52,8 +84,10 @@ class ServePrograms:
     executables and the no-retrace contract."""
 
     def __init__(self, params, cfg, pool, max_batch, max_context,
-                 buckets=None):
-        from ..models.llama import llama_decode_paged, llama_prefill_paged
+                 chunk_size=None, prefill_rows=None, draft_params=None,
+                 draft_cfg=None, draft_pool=None, spec_k=None):
+        from ..models.llama import (llama_chunk_paged, llama_decode_paged,
+                                    llama_draft_loop)
         self.params = params
         self.cfg = cfg
         self.pool = pool
@@ -61,63 +95,105 @@ class ServePrograms:
         bs = pool.block_size
         self.max_context = min(int(max_context), cfg.max_seq_len)
         self.blocks_per_stream = -(-self.max_context // bs)
-        self.buckets = tuple(b for b in (buckets
-                                         or default_buckets(
-                                             bs, self.max_context))
-                             if b % bs == 0)
-        if not self.buckets:
-            raise ValueError(
-                "serve: no valid prefill buckets (buckets must be "
-                "multiples of the KV block size %d)" % bs)
+        self.chunk_size = int(chunk_size or default_chunk_size())
+        self.prefill_rows = int(prefill_rows or default_prefill_rows())
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.draft_pool = draft_pool
+        self.spec = draft_params is not None
+        self.spec_k = int(spec_k or default_spec_k()) if self.spec else 0
+        if self.spec and (draft_cfg is None or draft_pool is None):
+            raise ValueError("serve: a draft model needs draft_cfg and a "
+                             "mirrored draft KV pool")
+        jnp = jax.numpy
 
-        def _prefill(params, pools, tokens, length, table):
-            logits, pools = llama_prefill_paged(
-                params, pools, tokens, length, table, cfg, bs)
-            return jax.numpy.argmax(logits).astype(jax.numpy.int32), pools
+        def _chunk(params, pools, tokens, positions, tables, seeds,
+                   sample_pos, temps, top_k, top_p):
+            logits, pools = llama_chunk_paged(
+                params, pools, tokens, positions, tables, cfg, bs,
+                logits_at="last")
+            tok = sample_tokens(logits, seeds, sample_pos, temps,
+                                top_k, top_p)
+            return tok, pools
 
-        def _decode(params, pools, tokens, positions, tables):
+        def _decode(params, pools, tokens, positions, tables, seeds,
+                    temps, top_k, top_p):
             logits, pools = llama_decode_paged(
                 params, pools, tokens, positions, tables, cfg, bs)
-            return (jax.numpy.argmax(logits, axis=-1).astype(
-                jax.numpy.int32), pools)
+            tok = sample_tokens(logits, seeds, positions + 1, temps,
+                                top_k, top_p)
+            return tok, pools
 
-        self._prefill_jit = jax.jit(_prefill, donate_argnums=(1,))
+        def _copy(pools, src, dst):
+            # the CoW primitive: block dst becomes a copy of block src in
+            # every layer's k and v pool
+            return jax.tree_util.tree_map(
+                lambda a: a.at[dst].set(a[src]), pools)
+
+        self._chunk_jit = jax.jit(_chunk, donate_argnums=(1,))
         self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
-        self._prefill_exec = {}
-        self._decode_exec = None
+        self._copy_jit = jax.jit(_copy, donate_argnums=(0,))
+        self._exec = {}
         self._warm = False
 
-    # ------------------------------------------------------------- buckets
-    def bucket_for(self, n_tokens):
-        """Smallest warmed bucket holding n_tokens, or None (too large)."""
-        for b in self.buckets:
-            if n_tokens <= b:
-                return b
-        return None
+        if self.spec:
+            dcfg = draft_cfg
+
+            def _draft_chunk(dparams, dpools, tokens, positions, tables):
+                _, dpools = llama_chunk_paged(
+                    dparams, dpools, tokens, positions, tables, dcfg, bs,
+                    logits_at="last")
+                return dpools
+
+            def _draft_k(dparams, dpools, tokens, positions, tables):
+                return llama_draft_loop(dparams, dpools, tokens, positions,
+                                        tables, dcfg, bs, self.spec_k)
+
+            def _verify(params, pools, tokens, positions, tables):
+                logits, pools = llama_chunk_paged(
+                    params, pools, tokens, positions, tables, cfg, bs,
+                    logits_at="all")
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        pools)
+
+            self._draft_chunk_jit = jax.jit(_draft_chunk,
+                                            donate_argnums=(1,))
+            self._draft_k_jit = jax.jit(_draft_k, donate_argnums=(1,))
+            self._verify_jit = jax.jit(_verify, donate_argnums=(1,))
 
     # -------------------------------------------------------------- warmup
-    def _pool_avals(self):
+    def _pool_avals(self, pool):
         return jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-            self.pool.pools)
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), pool.pools)
 
-    def _cache_key(self, kind, **extra):
+    def _cache_key(self, kind, params, pool, **extra):
         """AOT-cache signature for one serve executable: model geometry +
         pool geometry + param avals (+ versions, folded in by cache_key).
         Param VALUES stay out — executables are value-independent."""
         import dataclasses
 
         from ..compiler.cache import avals_sig, cache_key
-        cfg = (dataclasses.asdict(self.cfg)
-               if dataclasses.is_dataclass(self.cfg) else repr(self.cfg))
+        cfg = self.draft_cfg if kind.startswith("draft") else self.cfg
+        cfg = (dataclasses.asdict(cfg)
+               if dataclasses.is_dataclass(cfg) else repr(cfg))
+        if isinstance(cfg, dict) and "dtype" in cfg:
+            # canonicalize semantically: jnp.float32 and np.float32 repr
+            # differently but compile the same executable — a manifest
+            # pre-bake and a live replica must land on ONE key
+            try:
+                cfg["dtype"] = str(jax.numpy.dtype(cfg["dtype"]))
+            except TypeError:
+                cfg["dtype"] = repr(cfg["dtype"])
         return cache_key(
             kind="serve.%s" % kind, cfg=cfg,
             block_size=self.pool.block_size, max_batch=self.max_batch,
             blocks_per_stream=self.blocks_per_stream,
-            params=avals_sig(self.params), pools=avals_sig(self.pool.pools),
+            chunk=self.chunk_size, rows=self.prefill_rows,
+            spec_k=self.spec_k,
+            params=avals_sig(params), pools=avals_sig(pool.pools),
             **extra)
 
-    def _compile_or_restore(self, jitted, avals, kind, key, name):
+    def _compile_or_restore(self, name, jitted, args):
         """One serve executable: AOT-cache hit restores the serialized
         binary (zero fresh compiles — the fleet cold-start win); miss
         lowers+compiles and stores it for the next replica. Either way the
@@ -126,50 +202,81 @@ class ServePrograms:
         label = "serve.%s" % name
         t0 = time.perf_counter()
         ex, restored = load_or_compile(
-            key, lambda: jitted.lower(self.params, self._pool_avals(),
-                                      *avals),
-            label, meta={"kind": kind})
+            self._keys[name], lambda: jitted.lower(*args), label,
+            meta={"kind": name})
         if not restored:
             _telem.inc("serve.compile")
             _telem.observe("serve.compile_ms",
                            (time.perf_counter() - t0) * 1e3)
             _telem.note_compile(label)
+        self._exec[name] = ex
         return ex
 
-    def _compile_prefill(self, bucket):
-        i32 = jax.numpy.int32
-        ex = self._compile_or_restore(
-            self._prefill_jit,
-            (jax.ShapeDtypeStruct((bucket,), i32),
-             jax.ShapeDtypeStruct((), i32),
-             jax.ShapeDtypeStruct((bucket // self.pool.block_size,), i32)),
-            "prefill", self._cache_key("prefill", bucket=bucket),
-            "prefill[S=%d]" % bucket)
-        self._prefill_exec[bucket] = ex
-        return ex
+    def _program_args(self, name):
+        """(jitted fn, lowering avals) per executable name."""
+        i32, f32, u32 = (jax.numpy.int32, jax.numpy.float32,
+                         jax.numpy.uint32)
 
-    def _compile_decode(self):
-        i32 = jax.numpy.int32
-        ex = self._compile_or_restore(
-            self._decode_jit,
-            (jax.ShapeDtypeStruct((self.max_batch,), i32),
-             jax.ShapeDtypeStruct((self.max_batch,), i32),
-             jax.ShapeDtypeStruct((self.max_batch, self.blocks_per_stream),
-                                  i32)),
-            "decode", self._cache_key("decode"),
-            "decode[B=%d]" % self.max_batch)
-        self._decode_exec = ex
-        return ex
+        def s(shape, dt=i32):
+            return jax.ShapeDtypeStruct(shape, dt)
+
+        P, C = self.prefill_rows, self.chunk_size
+        B, k, nb = self.max_batch, self.spec_k, self.blocks_per_stream
+        pool_av = self._pool_avals(self.pool)
+        if name == "chunk":
+            return self._chunk_jit, (
+                self.params, pool_av, s((P, C)), s((P, C)), s((P, nb)),
+                s((P,), u32), s((P,)), s((P,), f32), s((P,)), s((P,), f32))
+        if name == "decode":
+            return self._decode_jit, (
+                self.params, pool_av, s((B,)), s((B,)), s((B, nb)),
+                s((B,), u32), s((B,), f32), s((B,)), s((B,), f32))
+        if name == "copy":
+            return self._copy_jit, (pool_av, s(()), s(()))
+        draft_av = self._pool_avals(self.draft_pool)
+        if name == "draft_chunk":
+            return self._draft_chunk_jit, (
+                self.draft_params, draft_av, s((P, C)), s((P, C)),
+                s((P, nb)))
+        if name == "draft_k":
+            return self._draft_k_jit, (
+                self.draft_params, draft_av, s((B,)), s((B,)), s((B, nb)))
+        if name == "verify":
+            # width k+1: [last accepted token, d1..dk] — verifying all k
+            # drafts needs the target's answer AFTER each of them
+            return self._verify_jit, (
+                self.params, pool_av, s((B, k + 1)), s((B, k + 1)),
+                s((B, nb)))
+        if name == "draft_copy":
+            return self._copy_jit, (draft_av, s(()), s(()))
+        raise KeyError(name)
+
+    @property
+    def program_names(self):
+        names = ["chunk", "decode", "copy"]
+        if self.spec:
+            names += ["draft_chunk", "draft_k", "verify", "draft_copy"]
+        return names
+
+    def _key_for(self, name):
+        """One AOT-cache key per executable name (draft programs key on
+        the draft model/pool, everything else on the target's)."""
+        params = (self.draft_params if name.startswith("draft")
+                  else self.params)
+        pool = (self.draft_pool if name.startswith("draft")
+                else self.pool)
+        return self._cache_key(name, params, pool)
 
     def warmup(self):
         """Compile every executable a request could route to. After this,
         steady-state traffic never compiles (the acceptance bar)."""
+        self._keys = {name: self._key_for(name)
+                      for name in self.program_names}
         with _telem.span("serve.warmup", "serve"):
-            for bucket in self.buckets:
-                if bucket not in self._prefill_exec:
-                    self._compile_prefill(bucket)
-            if self._decode_exec is None:
-                self._compile_decode()
+            for name in self.program_names:
+                if name not in self._exec:
+                    jitted, args = self._program_args(name)
+                    self._compile_or_restore(name, jitted, args)
         self._warm = True
 
     def _on_miss(self, kind, reason):
@@ -181,51 +288,110 @@ class ServePrograms:
         _telem.note_compile("serve.%s(retrace)" % kind)
         from ..analysis import guard as _guard
         if _guard.ACTIVE:
-            n = len(self._prefill_exec) + (1 if self._decode_exec else 0)
-            _guard.on_retrace("serve.%s" % kind, n + 1, reason)
+            _guard.on_retrace("serve.%s" % kind, len(self._exec) + 1,
+                              reason)
+
+    def _run(self, name):
+        ex = self._exec.get(name)
+        if ex is None:
+            self._on_miss(name, "executable %r missing at dispatch "
+                          "(warmed: %s)" % (name,
+                                            ",".join(self._exec) or "none"))
+            if not hasattr(self, "_keys"):
+                self._keys = {}
+            self._keys[name] = self._key_for(name)
+            jitted, args = self._program_args(name)
+            ex = self._compile_or_restore(name, jitted, args)
+        return ex
 
     # ------------------------------------------------------------- execute
-    def prefill(self, tokens, table):
-        """Run the bucketed prefill for a context of `tokens` (list/array
-        of ints). `table` is the stream's padded-to-bucket block table.
-        Returns the next token id (int)."""
-        n = len(tokens)
-        bucket = self.bucket_for(n)
-        if bucket is None:
-            raise ValueError(
-                "serve: context of %d tokens exceeds the largest bucket "
-                "(%d) — admission should have shed this request"
-                % (n, self.buckets[-1]))
-        ex = self._prefill_exec.get(bucket)
-        if ex is None:
-            self._on_miss("prefill", "unwarmed bucket S=%d (warmed: %s)"
-                          % (bucket, ",".join(map(str, self._prefill_exec))
-                             or "none"))
-            ex = self._compile_prefill(bucket)
-        padded = np.zeros(bucket, np.int32)
-        padded[:n] = tokens
-        tbl = np.asarray(table, np.int32)[:bucket // self.pool.block_size]
+    def chunk_prefill(self, tokens, positions, tables, seeds, sample_pos,
+                      temps, top_k, top_p):
+        """One multi-stream prefill window: rows of (chunk_size,) prompt
+        tokens at absolute positions (−1 = pad). Returns the sampled
+        next-token per row (meaningful only for rows that completed their
+        stream's fill — the scheduler knows which)."""
+        ex = self._run("chunk")
         ts = _telem.span_clock()
         t0 = time.perf_counter()
-        tok, pools = ex(self.params, self.pool.pools, padded,
-                        np.int32(n), tbl)
+        tok, pools = ex(self.params, self.pool.pools,
+                        np.asarray(tokens, np.int32),
+                        np.asarray(positions, np.int32),
+                        np.asarray(tables, np.int32),
+                        np.asarray(seeds, np.uint32),
+                        np.asarray(sample_pos, np.int32),
+                        np.asarray(temps, np.float32),
+                        np.asarray(top_k, np.int32),
+                        np.asarray(top_p, np.float32))
         self.pool.update(pools)
-        # one span per prefill dispatch (cat `serve`): in the chrome dump
-        # the bucketed prefills line up under the serve.step row, and the
+        # one span per chunk window (cat `serve`): in the chrome dump the
+        # prefill windows line up under the serve.step row, and the
         # attribution pass sees the serving host timeline
-        _telem.record_span("serve.prefill[S=%d]" % bucket, "serve", ts,
-                           time.perf_counter() - t0)
-        return int(tok)
+        _telem.record_span(
+            "serve.prefill[%dx%d]" % (self.prefill_rows, self.chunk_size),
+            "serve", ts, time.perf_counter() - t0)
+        return np.asarray(tok)
 
-    def decode(self, tokens, positions, tables):
+    def decode(self, tokens, positions, tables, seeds, temps, top_k,
+               top_p):
         """One decode step over the fixed-size batch. tokens/positions
         (max_batch,) int32 (position -1 = inactive slot), tables
-        (max_batch, blocks_per_stream) int32. Returns the next token id
-        per slot as a numpy (max_batch,) array."""
-        ex = self._decode_exec
-        if ex is None:
-            self._on_miss("decode", "decode executable missing at dispatch")
-            ex = self._compile_decode()
+        (max_batch, blocks_per_stream) int32, sampling vectors
+        row-aligned. Returns the next token id per slot as a numpy
+        (max_batch,) array."""
+        ex = self._run("decode")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        out, pools = ex(self.params, self.pool.pools,
+                        np.asarray(tokens, np.int32),
+                        np.asarray(positions, np.int32),
+                        np.asarray(tables, np.int32),
+                        np.asarray(seeds, np.uint32),
+                        np.asarray(temps, np.float32),
+                        np.asarray(top_k, np.int32),
+                        np.asarray(top_p, np.float32))
+        self.pool.update(pools)
+        _telem.record_span("serve.decode", "serve", ts,
+                           time.perf_counter() - t0)
+        return np.asarray(out)
+
+    def copy_block(self, src, dst):
+        """Device-copy pool block src -> dst (the prefix-sharing CoW)."""
+        ex = self._run("copy")
+        self.pool.update(ex(self.pool.pools, np.int32(src), np.int32(dst)))
+
+    # ------------------------------------------------------- spec decoding
+    def draft_prefill(self, tokens, positions, tables):
+        """Mirror a prefill window through the draft model (spec decoding
+        needs the draft's KV for the whole context)."""
+        ex = self._run("draft_chunk")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        self.draft_pool.update(
+            ex(self.draft_params, self.draft_pool.pools,
+               np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+               np.asarray(tables, np.int32)))
+        _telem.record_span("serve.draft_prefill", "serve", ts,
+                           time.perf_counter() - t0)
+
+    def draft_k(self, tokens, positions, tables):
+        """spec_k greedy draft tokens per slot in ONE program: (B, k)."""
+        ex = self._run("draft_k")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        out, pools = ex(self.draft_params, self.draft_pool.pools,
+                        np.asarray(tokens, np.int32),
+                        np.asarray(positions, np.int32),
+                        np.asarray(tables, np.int32))
+        self.draft_pool.update(pools)
+        _telem.record_span("serve.draft", "serve", ts,
+                           time.perf_counter() - t0)
+        return np.asarray(out)
+
+    def verify(self, tokens, positions, tables):
+        """Target-model greedy tokens at every drafted position, one
+        chunk-shaped pass: (B, k+1) in, (B, k+1) out."""
+        ex = self._run("verify")
         ts = _telem.span_clock()
         t0 = time.perf_counter()
         out, pools = ex(self.params, self.pool.pools,
@@ -233,6 +399,11 @@ class ServePrograms:
                         np.asarray(positions, np.int32),
                         np.asarray(tables, np.int32))
         self.pool.update(pools)
-        _telem.record_span("serve.decode", "serve", ts,
+        _telem.record_span("serve.verify", "serve", ts,
                            time.perf_counter() - t0)
         return np.asarray(out)
+
+    def draft_copy_block(self, src, dst):
+        ex = self._run("draft_copy")
+        self.draft_pool.update(
+            ex(self.draft_pool.pools, np.int32(src), np.int32(dst)))

@@ -1,0 +1,86 @@
+"""In-program token sampling: temperature / top-k / top-p, replayable.
+
+Serving sampled streams has one hard requirement the training RNG plumbing
+cannot meet: **replayability per position**. A drained or killed stream
+resumes by re-prefilling its prompt + already-emitted tokens and must then
+draw the SAME future tokens it would have drawn uninterrupted — so the
+draw for the token occupying position p may depend only on (stream seed,
+p, logits), never on a global key table's consumption order. Every draw
+therefore derives its key as ``fold_in(PRNGKey(seed), position)``: pure,
+stateless, identical on any replica at any time.
+
+Sampling happens INSIDE the decode/chunk-prefill programs (one int32 per
+stream crosses the device boundary, not a vocab row), with per-slot
+parameter vectors so one fixed-shape executable serves every mixture of
+greedy and sampled streams:
+
+* ``temperature <= 0`` — greedy: exactly ``argmax`` (bit-identical to the
+  sampling-free path; the sampled branch's value is discarded by a
+  ``where``);
+* ``top_k > 0`` — keep only the k highest logits (value threshold: ties
+  at the boundary all stay eligible);
+* ``top_p < 1`` — nucleus: keep the smallest probability-ordered set
+  whose cumulative mass reaches top_p (the top-1 token is always kept).
+
+Filter order is temperature → top-k → top-p (the HF convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["sample_tokens"]
+
+_NEG_INF = -1e30
+
+
+def _sample(logits, seeds, positions, temperature, top_k, top_p, greedy):
+    """The full filter + draw pipeline (the lax.cond sampled branch)."""
+    V = logits.shape[1]
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    order = jnp.sort(scaled, axis=-1)[:, ::-1]          # descending
+    # top-k: everything below the kth-largest scaled logit drops
+    k = jnp.clip(jnp.where(top_k > 0, top_k, V), 1, V)
+    kth = jnp.take_along_axis(order, k[:, None] - 1, axis=1)
+    masked = jnp.where(scaled >= kth, scaled, _NEG_INF)
+    # top-p over what survived top-k: walk the sorted probabilities and
+    # keep rows whose PRECEDING cumulative mass is still under top_p —
+    # the top-1 token's preceding mass is 0, so it always survives
+    order_m = jnp.where(order >= kth, order, _NEG_INF)
+    probs = jax.nn.softmax(order_m, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    keep_p = before < jnp.minimum(top_p, 1.0)[:, None]
+    # value threshold of the last kept sorted entry
+    thresh = jnp.min(jnp.where(keep_p, order_m, jnp.inf), axis=-1)
+    masked = jnp.where(scaled >= thresh[:, None], masked, _NEG_INF)
+
+    def draw(seed, pos, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(draw)(seeds.astype(jnp.uint32), positions,
+                             masked).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def sample_tokens(logits, seeds, positions, temperature, top_k, top_p):
+    """Draw one token per row. All inputs row-aligned:
+
+    logits (N, V) fp32; seeds (N,) uint32 — the stream's sampling seed;
+    positions (N,) int32 — the position the NEW token will occupy (the
+    replay key); temperature (N,) fp32 (<= 0 selects greedy argmax);
+    top_k (N,) int32 (0 disables); top_p (N,) fp32 (>= 1 disables).
+    Returns (N,) int32.
+
+    The sampling pipeline (a vocab-wide sort + softmax + cumsum) rides a
+    `lax.cond` on "any row sampled?": an all-greedy batch — the common
+    decode-hot-path case — pays only the argmax at runtime, in the SAME
+    fixed-shape executable (no second program, no retrace).
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return lax.cond(
+        jnp.any(temperature > 0.0),
+        lambda args: _sample(*args),
+        lambda args: greedy,
+        (logits, seeds, positions, temperature, top_k, top_p, greedy))

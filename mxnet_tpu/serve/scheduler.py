@@ -1,4 +1,4 @@
-"""Continuous-batching scheduler: admission, decode loop, fault recovery.
+"""Continuous-batching scheduler: admission, chunked prefill, decode, spec.
 
 The serving analog of `resilience.run.ResilientRunner`: one replica =
 one `InferenceServer`, driving the AOT programs (`serve.programs`) over the
@@ -7,9 +7,30 @@ through the existing planes —
 
 * **continuous batching** — requests join and leave the running batch
   *between* decode steps (the way the bucketed comm engine overlaps
-  buckets): a fresh request is admitted into any free batch slot, prefilled
-  at its bucket, and decodes alongside whatever is already running; a
-  finished stream frees its slot and blocks immediately;
+  buckets): a fresh request is admitted into any free batch slot and
+  decodes alongside whatever is already running; a finished stream frees
+  its slot and blocks immediately;
+* **chunked, batched prefill** — prompt work is cut into fixed-shape
+  (rows × chunk) windows interleaved with decode under a per-step token
+  budget (``MXNET_TPU_SERVE_PREFILL_BUDGET``): a burst of arrivals
+  prefills TOGETHER in one program instead of serializing TTFT behind
+  batch-1 prefills, and a long prompt cannot starve running decodes;
+* **prefix sharing** — admission looks the stream's context up in the
+  pool's hash-consed prefix index (`KVBlockPool.admit`): full blocks of
+  an already-cached prompt prefix join the table by refcount and prefill
+  skips their positions (copy-on-write at the divergence block), so N
+  users of one system prompt pay for its KV once;
+* **speculative decoding** — when a draft model is configured, greedy
+  streams decode via a draft-k / verify-k acceptance loop (`serve.spec.*`
+  counters): the tiny draft proposes ``spec_k`` tokens in one program,
+  the target model verifies them all in one chunk-shaped pass, and every
+  accepted token skips a full decode dispatch — byte-identical to the
+  non-speculative greedy path by construction (only tokens the target's
+  own argmax agrees with are ever emitted);
+* **sampling** — per-request temperature/top-k/top-p ride the programs as
+  per-slot vectors (`serve.sampling`); draws key on (stream seed,
+  position), so a recovered stream replays the same tokens. Sampled
+  streams take the plain decode path (spec stays greedy-verify);
 * **admission control** — a full queue or an exhausted KV pool answers
   with a structured `Overloaded` (shed, never OOM); a request whose
   worst-case context can NEVER fit is shed at submit; a transiently
@@ -25,16 +46,20 @@ through the existing planes —
   (``MXNET_TPU_SERVE_STEP_DEADLINE_S``, falling back to the global step
   deadline), so a dead decode becomes a recoverable `StallError`;
 * **drain & resume** — any retriable fault drains the replica: every
-  in-flight stream's blocks are freed and the stream re-enters the queue
-  (front, budget decremented), to resume — here or on another replica —
-  by **re-prefilling its prompt + already-emitted tokens**. Greedy decode
-  plus the bit-matching paged/prefill math make the resumed output
+  in-flight stream's blocks are freed (refcount-exactly — a shared
+  prefix block under a live sibling survives) and the stream re-enters
+  the queue (front, budget decremented), to resume — here or on another
+  replica — by **re-prefilling its prompt + already-emitted tokens**.
+  Deterministic decode (greedy argmax, or position-keyed sampling) plus
+  the bit-matching paged chunk math make the resumed output
   byte-identical: no token is lost (emitted tokens are the new context)
   and none duplicated (the resumed prefill emits the FIRST not-yet-seen
   token).
 
 Telemetry: ``serve.requests/admitted/completed/shed[.reason]/tokens/
-prefills/decode_steps/recoveries/requeued_streams/failed`` counters,
+prefills/prefill_chunks/decode_steps/recoveries/requeued_streams/failed``
+counters, the prefix story (``serve.prefix.*`` from the pool), the spec
+story (``serve.spec.drafted/accepted/rejected/rounds``),
 ``serve.queue_depth`` / ``serve.batch_occupancy`` / ``serve.kv.*`` gauges,
 ``serve.ttft_ms`` / ``serve.tpot_ms`` / ``serve.step_ms`` histograms, a
 ``serve.step`` span per step (cat ``step`` — the attribution profiler's
@@ -58,6 +83,7 @@ import os
 import threading
 import time
 import uuid
+import zlib
 from collections import deque
 
 import numpy as np
@@ -91,6 +117,14 @@ def default_queue_cap():
         return 64
 
 
+def default_prefill_budget(rows, chunk):
+    try:
+        raw = int(os.environ.get("MXNET_TPU_SERVE_PREFILL_BUDGET", "0"))
+    except (TypeError, ValueError):
+        raw = 0
+    return raw if raw > 0 else rows * chunk
+
+
 def _step_deadline_s():
     raw = os.environ.get("MXNET_TPU_SERVE_STEP_DEADLINE_S")
     if raw:
@@ -102,15 +136,20 @@ def _step_deadline_s():
 
 
 class Request:
-    """One generation request: a token prompt plus its budgets.
+    """One generation request: a token prompt plus its budgets and
+    sampling policy.
 
     deadline_s is relative to submission and covers queue wait AND decode;
     eos_id stops the stream early; retries overrides the replica-fault
     budget (default: `RetryPolicy().max_attempts`, i.e. MXNET_TPU_RETRIES).
+    temperature <= 0 is greedy (the default); top_k/top_p filter the
+    sampled distribution; seed pins the sampling draws (default: derived
+    from request_id, so retries of one request replay the same tokens).
     """
 
     def __init__(self, prompt, max_new_tokens=16, request_id=None,
-                 deadline_s=None, eos_id=None, retries=None):
+                 deadline_s=None, eos_id=None, retries=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None):
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise ValueError("serve: empty prompt")
@@ -124,6 +163,20 @@ class Request:
         self.deadline_s = deadline_s
         self.eos_id = eos_id
         self.retries = retries
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if self.top_k < 0:
+            raise ValueError("serve: top_k must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("serve: top_p must be in (0, 1]")
+        # the replay key: stable across requeues/replicas by construction
+        self.seed = (int(seed) if seed is not None
+                     else zlib.crc32(self.request_id.encode())) & 0xffffffff
+
+    @property
+    def greedy(self):
+        return self.temperature <= 0.0
 
 
 class StreamHandle:
@@ -173,7 +226,7 @@ class _Stream:
 
     __slots__ = ("handle", "request", "retries_left", "deadline",
                  "last_token_t", "t_submit", "owner", "table_row",
-                 "kv_id")
+                 "kv_id", "fill_pos", "fill_len", "fill_chunks")
 
     def __init__(self, handle, retries_left):
         self.handle = handle
@@ -200,10 +253,20 @@ class _Stream:
         # reserved up front), so the decode hot path must not rebuild it
         # per token
         self.table_row = None
+        # chunked-prefill progress: context positions [fill_pos, fill_len)
+        # still need their KV written (fill_pos starts past any shared
+        # prefix); the stream joins decode once fill_pos == fill_len
+        self.fill_pos = 0
+        self.fill_len = 0
+        self.fill_chunks = 0
 
     @property
     def context(self):
         return self.request.prompt + self.handle.tokens
+
+    @property
+    def filling(self):
+        return self.fill_pos < self.fill_len
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
@@ -293,23 +356,47 @@ class InferenceServer:
         h = server.submit(mx.serve.Request([1, 2, 3], max_new_tokens=8))
         server.run()                          # drive until idle
         print(h.result())
+
+    Speculative decoding rides a draft model::
+
+        server = mx.serve.InferenceServer(
+            params, cfg, draft_params=dparams, draft_cfg=dcfg, spec_k=4)
     """
 
     def __init__(self, params, cfg, *, max_batch=None, kv_blocks=None,
-                 block_size=None, max_context=None, buckets=None,
-                 queue=None, queue_cap=None, step_deadline_s=None,
-                 max_restarts=3, name="replica0"):
+                 block_size=None, max_context=None, chunk_size=None,
+                 prefill_rows=None, prefill_budget=None,
+                 prefix_sharing=None, draft_params=None, draft_cfg=None,
+                 spec_k=None, queue=None, queue_cap=None,
+                 step_deadline_s=None, max_restarts=3, name="replica0"):
         self.name = name
         self.cfg = cfg
         self.pool = KVBlockPool(cfg, num_blocks=kv_blocks,
-                                block_size=block_size)
+                                block_size=block_size,
+                                prefix_sharing=prefix_sharing)
         if max_context is None:
             max_context = min(cfg.max_seq_len,
                               self.pool.num_blocks * self.pool.block_size)
         self.max_batch = int(max_batch or default_max_batch())
-        self.programs = ServePrograms(params, cfg, self.pool,
-                                      self.max_batch, max_context,
-                                      buckets=buckets)
+        # the draft pool mirrors the target pool's geometry and BLOCK IDS
+        # (one table indexes both) — accounting lives only on the target
+        # pool, the draft pool is pure storage
+        self.draft_pool = None
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("serve: draft_params needs draft_cfg")
+            self.draft_pool = KVBlockPool(
+                draft_cfg, num_blocks=self.pool.num_blocks,
+                block_size=self.pool.block_size, prefix_sharing=False)
+        self.programs = ServePrograms(
+            params, cfg, self.pool, self.max_batch, max_context,
+            chunk_size=chunk_size, prefill_rows=prefill_rows,
+            draft_params=draft_params, draft_cfg=draft_cfg,
+            draft_pool=self.draft_pool, spec_k=spec_k)
+        self.prefill_budget = (int(prefill_budget) if prefill_budget
+                               else default_prefill_budget(
+                                   self.programs.prefill_rows,
+                                   self.programs.chunk_size))
         self.queue = queue if queue is not None else RequestQueue(queue_cap)
         self.step_deadline_s = (step_deadline_s if step_deadline_s
                                 is not None else _step_deadline_s())
@@ -320,8 +407,8 @@ class InferenceServer:
         # the stream currently mid-admission (popped from the queue but
         # not yet in a slot): a fault landing inside _admit — including
         # the watchdog's ASYNC StallError, which can fire between any two
-        # bytecodes of the prefill — must find it here, or recovery would
-        # drain only _slots and silently lose the stream
+        # bytecodes of the KV reservation — must find it here, or recovery
+        # would drain only _slots and silently lose the stream
         self._admitting = None
         # request ids retired during the CURRENT step — reset at step
         # start, embedded (with the active set) in the step's flight
@@ -352,7 +439,7 @@ class InferenceServer:
     def submit(self, request):
         """Admit a request into the queue; returns a `StreamHandle`.
         Raises `Overloaded` (structured, never an OOM later) when the
-        queue is full or the request can never fit the KV pool/buckets."""
+        queue is full or the request can never fit the KV pool."""
         _faults.check("serve.admit", context="request=%s"
                       % request.request_id)
         _telem.inc("serve.requests")
@@ -362,11 +449,7 @@ class InferenceServer:
         # the longest context this request can ever re-prefill (a resumed
         # stream prefills prompt + all-but-one emitted budget)
         max_prefill = len(request.prompt) + request.max_new_tokens - 1
-        # the explicit max_context bound matters when the last bucket
-        # rounded UP past it (block alignment): bucket existence alone
-        # would admit positions beyond the model's trained context
         if (self._worst_blocks(request) > self.pool.num_blocks
-                or self.programs.bucket_for(max_prefill) is None
                 or max_prefill > self.programs.max_context):
             trace.finish("shed.too_large", tokens=0)
             self._shed(Overloaded(
@@ -449,10 +532,12 @@ class InferenceServer:
         return False
 
     def _admit(self):
-        """Fill free batch slots from the queue: pop → reserve KV → prefill
-        (prompt + any already-emitted tokens — the resume path) → join the
-        running batch. A transiently unfit head request goes back to the
-        front and admission stops (backpressure, streams keep decoding)."""
+        """Fill free batch slots from the queue: pop → reserve KV (sharing
+        any cached prompt prefix, copy-on-write at the divergence block)
+        → join the batch in the *filling* state; the step's chunked
+        prefill phase writes the context. A transiently unfit head
+        request goes back to the front and admission stops (backpressure,
+        streams keep decoding)."""
         admitted = 0
         while True:
             slot = self._free_slot()
@@ -492,10 +577,12 @@ class InferenceServer:
                     tokens=stream.handle.tokens, request_trace=payload))
                 self._admitting = None
                 continue
+            context = stream.context
             try:
-                self.pool.alloc(stream.kv_id,
-                                len(stream.request.prompt)
-                                + stream.request.max_new_tokens - 1)
+                _, fill_start, cow = self.pool.admit(
+                    stream.kv_id,
+                    len(stream.request.prompt)
+                    + stream.request.max_new_tokens - 1, context=context)
             except Overloaded:
                 # transient: the pool drains as running streams finish
                 self.queue.requeue(stream)
@@ -503,71 +590,252 @@ class InferenceServer:
                 break
             # the table is immutable for the stream's in-flight life
             # (worst case reserved above): build the padded row once,
-            # decode reuses it every step
+            # the prefill/decode hot paths must not rebuild it per token
             stream.table_row = self.pool.table(
                 stream.kv_id, self.programs.blocks_per_stream)
-            context = stream.context
-            width = self.programs.bucket_for(len(context))
-            table = stream.table_row[:width // self.pool.block_size]
-            t0 = time.perf_counter()
-            token = self.programs.prefill(context, table)
-            _telem.inc("serve.prefills")
-            _telem.observe("serve.prefill_ms",
-                           (time.perf_counter() - t0) * 1e3)
-            now = time.monotonic()
-            stream.handle.tokens.append(token)
-            stream.last_token_t = now
-            trace.mark("prefill", tokens=len(context), bucket=width)
-            _telem.inc("serve.tokens")
-            if stream.handle.ttft_ms is None:
-                # time-to-first-token counts the queue wait, not just the
-                # prefill — that is the latency the client experienced
-                stream.handle.ttft_ms = (time.perf_counter()
-                                         - stream.t_submit) * 1e3
-                _telem.observe("serve.ttft_ms", stream.handle.ttft_ms)
+            stream.fill_pos = fill_start
+            stream.fill_len = len(context)
+            stream.fill_chunks = 0
+            if cow is not None:
+                # copy-on-write at the divergence block: the partially
+                # matched source block's KV is bit-identical below
+                # fill_start, so copy it instead of recomputing
+                self.programs.copy_block(*cow)
+                if self.draft_pool is not None:
+                    self.programs.draft_copy_block(*cow)
             self._slots[slot] = stream
             self._admitting = None
             _telem.inc("serve.admitted")
             admitted += 1
-            self._finish_check(slot, stream, token, now)
         return admitted
 
-    def _decode(self):
-        """One decode step over every active slot (fixed program shape:
-        inactive slots ride along masked)."""
-        active = [(i, s) for i, s in enumerate(self._slots) if s is not None]
-        if not active:
+    # -------------------------------------------------------------- prefill
+    def _plan_chunks(self):
+        """Assign this step's prefill windows: up to `prefill_rows` rows of
+        up to `chunk_size` tokens, total capped by the token budget.
+        Round-robin — every filling stream gets a row before any stream
+        gets its second — so a burst of arrivals shares the window and a
+        long prompt cannot monopolize it."""
+        filling = [s for s in self._slots if s is not None and s.filling]
+        if not filling:
+            return []
+        plan = []                   # (stream, start, n)
+        progress = {s.kv_id: s.fill_pos for s in filling}
+        budget = self.prefill_budget
+        rows = self.programs.prefill_rows
+        while len(plan) < rows and budget > 0:
+            advanced = False
+            for s in filling:
+                if len(plan) >= rows or budget <= 0:
+                    break
+                rem = s.fill_len - progress[s.kv_id]
+                if rem <= 0:
+                    continue
+                n = min(rem, self.programs.chunk_size, budget)
+                plan.append((s, progress[s.kv_id], n))
+                progress[s.kv_id] += n
+                budget -= n
+                advanced = True
+            if not advanced:
+                break
+        return plan
+
+    def _prefill(self):
+        """One chunked-prefill window: scatter the planned chunks' KV
+        (target model, and the draft mirror when spec is on) and emit the
+        first token of every stream whose fill completes."""
+        plan = self._plan_chunks()
+        if not plan:
             return 0
-        tokens = np.zeros(self.max_batch, np.int32)
-        positions = np.full(self.max_batch, -1, np.int32)
-        tables = np.full((self.max_batch, self.programs.blocks_per_stream),
+        P, C = self.programs.prefill_rows, self.programs.chunk_size
+        nb = self.programs.blocks_per_stream
+        tokens = np.zeros((P, C), np.int32)
+        positions = np.full((P, C), -1, np.int32)
+        tables = np.full((P, nb), self.pool.num_blocks, np.int32)
+        seeds = np.zeros(P, np.uint32)
+        sample_pos = np.zeros(P, np.int32)
+        temps = np.zeros(P, np.float32)
+        top_k = np.zeros(P, np.int32)
+        top_p = np.ones(P, np.float32)
+        final_row = {}              # kv_id -> (row, stream)
+        for r, (s, start, n) in enumerate(plan):
+            ctx = s.context
+            tokens[r, :n] = ctx[start:start + n]
+            positions[r, :n] = np.arange(start, start + n)
+            tables[r] = s.table_row
+            req = s.request
+            seeds[r] = req.seed
+            sample_pos[r] = s.fill_len
+            temps[r] = req.temperature
+            top_k[r] = req.top_k
+            top_p[r] = req.top_p
+            s.fill_chunks += 1
+            if start + n >= s.fill_len:
+                final_row[s.kv_id] = (r, s)
+        t0 = time.perf_counter()
+        out = self.programs.chunk_prefill(tokens, positions, tables, seeds,
+                                          sample_pos, temps, top_k, top_p)
+        if self.draft_pool is not None:
+            self.programs.draft_prefill(tokens, positions, tables)
+        _telem.inc("serve.prefill_chunks", len(plan))
+        _telem.inc("serve.prefill_chunk_tokens",
+                   int(sum(n for _, _, n in plan)))
+        _telem.observe("serve.prefill_ms", (time.perf_counter() - t0) * 1e3)
+        for s, start, n in plan:
+            s.fill_pos = max(s.fill_pos, start + n)
+        now = time.monotonic()
+        for r, s in final_row.values():
+            # the fill is complete: the row's sampled token is the
+            # stream's first output token, and its full prompt prefix is
+            # now cacheable for the next user of the same system prompt
+            token = int(out[r])
+            _telem.inc("serve.prefills")
+            self.pool.register_prefix(s.kv_id, s.request.prompt)
+            s.handle.tokens.append(token)
+            s.last_token_t = now
+            s.handle.trace.mark("prefill", tokens=s.fill_len,
+                                chunks=s.fill_chunks)
+            _telem.inc("serve.tokens")
+            if s.handle.ttft_ms is None:
+                # time-to-first-token counts the queue wait, not just the
+                # prefill — that is the latency the client experienced
+                s.handle.ttft_ms = (time.perf_counter()
+                                    - s.t_submit) * 1e3
+                _telem.observe("serve.ttft_ms", s.handle.ttft_ms)
+            slot = self._slots.index(s)
+            self._finish_check(slot, s, token, now)
+        return len(plan)
+
+    # --------------------------------------------------------------- decode
+    def _emit(self, slot, stream, token, now, dt_share):
+        """Append one decoded token to the stream and run the retirement
+        checks. Returns True when the stream retired."""
+        stream.handle.tokens.append(token)
+        _telem.inc("serve.tokens")
+        if stream.last_token_t is not None:
+            stream.handle.tpot_ms.append(dt_share)
+            _telem.observe("serve.tpot_ms", dt_share)
+        stream.last_token_t = now
+        # one decode span per emitted token: the inter-token interval,
+        # so slot residency tiles the request's timeline completely
+        stream.handle.trace.mark("decode", token=len(stream.handle.tokens))
+        return self._finish_check(slot, stream, token, now)
+
+    def _spec_eligible(self, stream):
+        return (self.programs.spec and stream.request.greedy)
+
+    def _decode_plain(self, active):
+        """One decode step over `active` [(slot, stream)] (fixed program
+        shape: the other slots ride along masked)."""
+        B = self.max_batch
+        tokens = np.zeros(B, np.int32)
+        positions = np.full(B, -1, np.int32)
+        tables = np.full((B, self.programs.blocks_per_stream),
                          self.pool.num_blocks, np.int32)
+        seeds = np.zeros(B, np.uint32)
+        temps = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        for i, s in active:
+            req = s.request
+            tokens[i] = s.handle.tokens[-1]
+            positions[i] = len(s.context) - 1
+            tables[i] = s.table_row
+            seeds[i] = req.seed
+            temps[i] = req.temperature
+            top_k[i] = req.top_k
+            top_p[i] = req.top_p
+        out = self.programs.decode(tokens, positions, tables, seeds,
+                                   temps, top_k, top_p)
+        now = time.monotonic()
+        for i, s in active:
+            dt = ((now - s.last_token_t) * 1e3
+                  if s.last_token_t is not None else 0.0)
+            self._emit(i, s, int(out[i]), now, dt)
+        return len(active)
+
+    def _decode_spec(self, active):
+        """Draft-k / verify acceptance round over `active` greedy streams:
+        ONE draft program proposes spec_k tokens per stream, ONE verify
+        pass computes the target's greedy token at every drafted
+        position, and the matching prefix (+ the target's own next token)
+        is emitted — 1..spec_k+1 tokens per stream per round, byte-
+        identical to plain greedy decode by construction."""
+        B, k = self.max_batch, self.programs.spec_k
+        nb = self.programs.blocks_per_stream
+        tokens = np.zeros(B, np.int32)
+        positions = np.full(B, -1, np.int32)
+        tables = np.full((B, nb), self.pool.num_blocks, np.int32)
         for i, s in active:
             tokens[i] = s.handle.tokens[-1]
             positions[i] = len(s.context) - 1
             tables[i] = s.table_row
-        out = self.programs.decode(tokens, positions, tables)
-        _telem.inc("serve.decode_steps")
+        drafted = self.programs.draft_k(tokens, positions, tables)
+        # verify window: [last token, d1..dk] at positions p..p+k — the
+        # target's greedy answer at column j is the token FOLLOWING the
+        # fed token, so column j+1's feed is valid iff it matched.
+        # Columns past the stream's REMAINING BUDGET are masked to -1:
+        # their positions would overrun the reserved block range, and an
+        # out-of-range scatter clamps into the stream's own last block —
+        # overwriting valid KV rows (the draft loop can still overrun its
+        # mirror pool, which only costs accept rate, never output)
+        vt = np.zeros((B, k + 1), np.int32)
+        vp = np.full((B, k + 1), -1, np.int32)
+        vt[:, 0] = tokens
+        vt[:, 1:] = drafted
+        remaining = {}
+        for i, s in active:
+            rem = s.request.max_new_tokens - len(s.handle.tokens)
+            remaining[i] = rem
+            cols = min(k + 1, max(rem, 1))
+            vp[i, :cols] = np.arange(positions[i], positions[i] + cols)
+        ver = self.programs.verify(vt, vp, tables)
+        _telem.inc("serve.spec.rounds")
         now = time.monotonic()
         for i, s in active:
-            token = int(out[i])
-            s.handle.tokens.append(token)
-            _telem.inc("serve.tokens")
-            if s.last_token_t is not None:
-                tpot = (now - s.last_token_t) * 1e3
-                s.handle.tpot_ms.append(tpot)
-                _telem.observe("serve.tpot_ms", tpot)
-            s.last_token_t = now
-            # one decode span per emitted token: the inter-token interval,
-            # so slot residency tiles the request's timeline completely
-            s.handle.trace.mark("decode", token=len(s.handle.tokens))
-            self._finish_check(i, s, token, now)
+            cap = min(k, max(remaining[i] - 1, 0))
+            accept = 0
+            while accept < cap and drafted[i, accept] == ver[i, accept]:
+                accept += 1
+            emit = [int(t) for t in ver[i, :accept + 1]]
+            # drafted = drafts that REACHED verification: accept_rate is
+            # a draft-quality metric, and a budget-capped final round
+            # must not dilute it (the surplus counts as discarded)
+            _telem.inc("serve.spec.drafted", cap)
+            _telem.inc("serve.spec.accepted", accept)
+            _telem.inc("serve.spec.rejected", cap - accept)
+            if k > cap:
+                _telem.inc("serve.spec.discarded", k - cap)
+            dt = ((now - s.last_token_t) * 1e3 / len(emit)
+                  if s.last_token_t is not None else 0.0)
+            for token in emit:
+                if self._emit(i, s, token, now, dt):
+                    break
         return len(active)
 
+    def _decode(self):
+        """One decode phase over every slot whose fill is complete:
+        spec-eligible (greedy) streams ride the draft/verify loop, the
+        rest (sampled, or no draft model) the plain decode program."""
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None and not s.filling]
+        if not active:
+            return 0
+        spec = [(i, s) for i, s in active if self._spec_eligible(s)]
+        plain = [(i, s) for i, s in active if not self._spec_eligible(s)]
+        decoded = 0
+        if spec:
+            decoded += self._decode_spec(spec)
+        if plain:
+            decoded += self._decode_plain(plain)
+        _telem.inc("serve.decode_steps")
+        return decoded
+
     def step(self):
-        """One scheduler iteration: (maybe) admit, (maybe) decode. Returns
-        True while there is in-flight or queued work. Raises the injected/
-        real `RetriableError`s the recovery path (`run`) absorbs."""
+        """One scheduler iteration: (maybe) admit, (maybe) prefill a
+        chunk window, (maybe) decode. Returns True while there is
+        in-flight or queued work. Raises the injected/real
+        `RetriableError`s the recovery path (`run`) absorbs."""
         if not self.programs._warm:
             self.warmup()
         t0 = time.perf_counter()
@@ -576,13 +844,14 @@ class InferenceServer:
         with _watchdog.guard("serve.step", deadline_s=self.step_deadline_s):
             _faults.check("serve.step", context="replica=%s" % self.name)
             admitted = self._admit()
+            prefilled = self._prefill()
             decoded = self._decode()
         occupancy = sum(1 for s in self._slots if s is not None)
         _telem.set_gauge("serve.batch_occupancy", occupancy)
         # admission-only steps (e.g. a max_new_tokens=1 request retired at
         # prefill) must still land in the step plane, or their completed
         # ids never reach a flight post-mortem
-        if decoded or admitted or self._step_completed:
+        if decoded or admitted or prefilled or self._step_completed:
             dur = time.perf_counter() - t0
             _telem.observe("serve.step_ms", dur * 1e3)
             # the serving cadence joins the step-span plane: attribution
@@ -602,6 +871,7 @@ class InferenceServer:
         queue (front, budget decremented) — or fail it when the budget is
         spent. Returns 1 when the stream was requeued."""
         stream.table_row = None     # blocks are going back to the pool
+        stream.fill_pos = stream.fill_len = 0
         if stream.handle.done():
             # retirement's terminal event already fired when the fault
             # landed; only the pool/slot cleanup remained
@@ -670,11 +940,20 @@ class InferenceServer:
             drain(stream)
         # a fault between a donating program call and pool.update leaves
         # deleted pool buffers; every stream re-prefills anyway, so just
-        # re-materialize the storage
-        self.pool.ensure_storage()
+        # re-materialize the storage — but a re-materialized pool is
+        # ZEROS, so every cached prefix must go with it (a later match
+        # would hand out garbage KV)
+        reset = self.pool.ensure_storage()
+        if self.draft_pool is not None:
+            # draft wreckage alone only costs accept-rate, but a cleared
+            # target index must not leave draft rows pretending to match
+            reset = self.draft_pool.ensure_storage() or reset
+        if reset:
+            self.pool.clear_prefix_cache()
         # ... and one landing inside an alloc/free can tear the free-list
-        # (blocks in neither a table nor the list): rebuild it as the
-        # complement of the surviving tables
+        # or a shared block's refcount (blocks in neither a table nor the
+        # list, or counted under the wrong number of owners): rebuild
+        # both as the exact complement of the surviving tables + index
         self.pool.reconcile()
         _telem.inc("serve.recoveries")
         # the drain post-mortem names the requests it touched, not just a

@@ -521,21 +521,37 @@ def test_serve_warmup_restores_from_cache(tmp_path, monkeypatch):
 
     def build():
         pool = KVBlockPool(cfg, num_blocks=16, block_size=8)
-        sp = ServePrograms(params, cfg, pool, max_batch=2, max_context=16)
+        sp = ServePrograms(params, cfg, pool, max_batch=2, max_context=16,
+                           chunk_size=8, prefill_rows=2)
         sp.warmup()
         return sp
 
+    def chunk_once(sp):
+        import numpy as np
+        prompt = [5, 6, 7]
+        tokens = np.zeros((2, 8), np.int32)
+        positions = np.full((2, 8), -1, np.int32)
+        tokens[0, :3] = prompt
+        positions[0, :3] = [0, 1, 2]
+        tables = np.full((2, sp.blocks_per_stream), sp.pool.num_blocks,
+                         np.int32)
+        tables[0, 0] = 0
+        return int(sp.chunk_prefill(
+            tokens, positions, tables, np.zeros(2, np.uint32),
+            np.asarray([3, 0], np.int32), np.zeros(2, np.float32),
+            np.zeros(2, np.int32), np.ones(2, np.float32))[0])
+
     sp1 = build()
-    n_exec = len(sp1._prefill_exec) + 1
+    n_exec = len(sp1.program_names)
     assert _counters().get("serve.compile") == n_exec
-    tok1 = sp1.prefill([5, 6, 7], [0, 1])
+    tok1 = chunk_once(sp1)
     telemetry.reset()
     sp2 = build()
     c = _counters()
     assert c.get("serve.compile", 0) == 0, \
         "warm warmup must restore every executable"
     assert c.get("compiler.cache.hits") == n_exec
-    assert tok1 == sp2.prefill([5, 6, 7], [0, 1])
+    assert tok1 == chunk_once(sp2)
     ring = [name for name, _ in telemetry.recent_compiles()]
     assert all("[cached]" in name for name in ring), ring
 

@@ -8,6 +8,13 @@ in-flight stream with no lost or duplicated tokens (byte-identical
 output). Paged-KV edge cases: pool exhaustion -> structured Overloaded,
 block reuse after stream completion, fragmentation across many short
 streams.
+
+Serving v2 (ISSUE 13): chunked multi-stream prefill byte-matches the
+monolithic reference; shared-prefix admission reuses cached prompt blocks
+(refcount-exact through kill-recovery, copy-on-write at the divergence
+block); speculative greedy decode is byte-identical to the
+non-speculative path; sampled streams replay the same draws after a
+drain; all at zero post-warm-up compiles.
 """
 import functools
 import os
@@ -27,8 +34,7 @@ from mxnet_tpu.models.llama import (LlamaConfig, llama_init, llama_forward,
 from mxnet_tpu.resilience import faults
 from mxnet_tpu.resilience.errors import RetryExhausted, is_retriable
 from mxnet_tpu.serve import (DeadlineExceeded, InferenceServer, KVBlockPool,
-                             Overloaded, ReplicaGroup, Request,
-                             default_buckets)
+                             Overloaded, ReplicaGroup, Request)
 
 pytestmark = pytest.mark.serve
 
@@ -36,6 +42,12 @@ CFG = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                   n_kv_heads=2, hidden_dim=128, rope_theta=10000.0,
                   max_seq_len=64, dtype=jnp.float32)
 PARAMS = llama_init(jax.random.PRNGKey(0), CFG)
+# a deliberately different tiny draft model: near-zero accept rate, which
+# is exactly what the byte-identical parity bar must survive
+DRAFT_CFG = LlamaConfig(vocab_size=256, dim=32, n_layers=1, n_heads=2,
+                        n_kv_heads=1, hidden_dim=64, rope_theta=10000.0,
+                        max_seq_len=64, dtype=jnp.float32)
+DRAFT_PARAMS = llama_init(jax.random.PRNGKey(7), DRAFT_CFG)
 
 
 @pytest.fixture(autouse=True)
@@ -143,10 +155,23 @@ def test_kv_pool_fragmentation_across_short_streams():
     assert table != sorted(table)
 
 
-def test_default_buckets_block_aligned():
-    assert default_buckets(8, 64) == (8, 16, 32, 64)
-    assert default_buckets(16, 100) == (16, 32, 64, 112)
-    assert all(b % 16 == 0 for b in default_buckets(16, 100))
+def test_chunk_geometry_defaults(monkeypatch):
+    from mxnet_tpu.serve import (default_chunk_size, default_prefill_rows,
+                                 default_spec_k)
+    monkeypatch.setenv("MXNET_TPU_SERVE_CHUNK", "24")
+    monkeypatch.setenv("MXNET_TPU_SERVE_PREFILL_ROWS", "6")
+    monkeypatch.setenv("MXNET_TPU_SERVE_SPEC_K", "2")
+    assert default_chunk_size() == 24
+    assert default_prefill_rows() == 6
+    assert default_spec_k() == 2
+    monkeypatch.setenv("MXNET_TPU_SERVE_CHUNK", "bogus")
+    assert default_chunk_size() == 16
+    server = make_server(chunk_size=4, prefill_rows=3)
+    assert server.programs.chunk_size == 4
+    assert server.programs.prefill_rows == 3
+    assert server.prefill_budget == 12          # rows x chunk by default
+    assert "chunk" in server.programs.program_names
+    assert "draft_k" not in server.programs.program_names  # no draft model
 
 
 # ---------------------------------------------------------------------------
@@ -223,10 +248,10 @@ def test_oversized_request_shed_at_submit():
         server.submit(Request([1] * 8, max_new_tokens=1000))
     assert ei.value.reason == "too_large"
     assert telemetry.snapshot()["counters"]["serve.shed.too_large"] == 1
-    # the max_context bound holds even when the last bucket rounded UP
-    # past it (block alignment): buckets (8, 16, 24) for max_context 20
+    # the max_context bound holds independently of the pool: a request
+    # whose worst-case re-prefill exceeds the model context sheds even
+    # when the blocks would fit
     tight = make_server(max_context=20)
-    assert tight.programs.buckets[-1] > 20
     with pytest.raises(Overloaded) as ei:
         tight.submit(Request([1] * 5, max_new_tokens=18))   # 22 > 20
     assert ei.value.reason == "too_large"
@@ -365,21 +390,21 @@ def test_replica_group_survives_replica_death():
 
 
 def test_fault_mid_admission_loses_no_stream():
-    """A fault landing INSIDE _admit (after the queue pop, during the
-    prefill — where an async watchdog stall would land) must drain the
-    half-admitted stream back to the queue, not lose it."""
+    """A fault landing INSIDE _admit (after the queue pop, during the KV
+    reservation — where an async watchdog stall would land) must drain
+    the half-admitted stream back to the queue, not lose it."""
     from mxnet_tpu.resilience.errors import InjectedFault
     server = make_server().warmup()
-    real_prefill = server.programs.prefill
+    real_admit = server.pool.admit
     state = {"fired": False}
 
-    def flaky_prefill(tokens, table):
+    def flaky_admit(stream_id, n_tokens, context=None):
         if not state["fired"]:
             state["fired"] = True
             raise InjectedFault("mid-admission fault", site="serve.step")
-        return real_prefill(tokens, table)
+        return real_admit(stream_id, n_tokens, context=context)
 
-    server.programs.prefill = flaky_prefill
+    server.pool.admit = flaky_admit
     prompt = [5, 6, 7]
     h = server.submit(Request(prompt, max_new_tokens=4))
     server.run()
@@ -387,7 +412,8 @@ def test_fault_mid_admission_loses_no_stream():
     assert h.requeues == 1
     snap = telemetry.snapshot()["counters"]
     assert snap["serve.requeued_streams"] == 1
-    assert server.pool.blocks_in_use == 0   # nothing leaked
+    assert server.pool.blocks_in_use == server.pool.prefix_blocks
+    assert server.pool.reconcile() == 0     # nothing leaked or torn
 
 
 def test_nonretriable_death_drains_streams():
@@ -400,11 +426,11 @@ def test_nonretriable_death_drains_streams():
     boom = {"armed": True}
     real_decode = server.programs.decode
 
-    def bad_decode(tokens, positions, tables):
+    def bad_decode(*args):
         if boom["armed"]:
             boom["armed"] = False
             raise RuntimeError("simulated device loss")
-        return real_decode(tokens, positions, tables)
+        return real_decode(*args)
 
     server.programs.decode = bad_decode
     with pytest.raises(RuntimeError):
@@ -640,20 +666,19 @@ def test_serving_telemetry_and_flight_records():
 
 
 def test_post_warmup_signature_miss_counts_as_retrace():
-    """White-box: a prefill signature that escaped warm-up is handled (the
+    """White-box: an executable that escaped warm-up is handled (the
     request still completes) but counted and reported like a CachedOp
     retrace."""
     server = make_server().warmup()
-    bucket = server.programs.buckets[0]
-    del server.programs._prefill_exec[bucket]   # simulate the escape
-    prompt = [1, 2, 3]                          # rides the smallest bucket
+    del server.programs._exec["chunk"]          # simulate the escape
+    prompt = [1, 2, 3]
     h = server.submit(Request(prompt, max_new_tokens=3))
     server.run()
     assert h.result(timeout=10) == reference_generate(prompt, 3)
     snap = telemetry.snapshot()["counters"]
     assert snap["serve.retrace"] == 1
     names = [n for n, _ in telemetry.recent_compiles()]
-    assert "serve.prefill(retrace)" in names
+    assert "serve.chunk(retrace)" in names
 
 
 def test_duplicate_request_ids_do_not_share_kv():
@@ -666,7 +691,9 @@ def test_duplicate_request_ids_do_not_share_kv():
     server.run()
     assert h1.result(timeout=10) == reference_generate(p1, 5)
     assert h2.result(timeout=10) == reference_generate(p2, 5)
-    assert server.pool.blocks_in_use == 0
+    # only the prefix index may still hold blocks (cached full prompt
+    # blocks outlive their stream by design)
+    assert server.pool.blocks_in_use == server.pool.prefix_blocks
 
 
 def test_zero_deadline_means_expired_not_disabled():
@@ -683,6 +710,415 @@ def test_admit_fault_site_wired():
         with pytest.raises(Exception) as ei:
             server.submit(Request([1, 2], max_new_tokens=2))
     assert "serve.admit" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# serving v2 (ISSUE 13): chunked prefill, prefix sharing, spec, sampling
+# ---------------------------------------------------------------------------
+def test_chunked_prefill_matches_reference_across_geometries():
+    """A long prompt split over many chunk windows — and several window
+    geometries — always byte-matches the monolithic reference."""
+    prompt = prompts_for(1, lo=20, hi=21, seed=20)[0]
+    ref = reference_generate(prompt, 5)
+    for chunk, rows in ((4, 1), (4, 3), (8, 2), (32, 4)):
+        server = make_server(chunk_size=chunk, prefill_rows=rows).warmup()
+        h = server.submit(Request(prompt, max_new_tokens=5))
+        server.run()
+        assert h.result(timeout=10) == ref, (chunk, rows)
+        snap = telemetry.snapshot()["counters"]
+        assert snap["serve.prefill_chunks"] >= -(-len(prompt) // chunk)
+        telemetry.reset()
+
+
+def test_burst_prefill_batches_windows():
+    """THE chunked-prefill win: a burst of arrivals prefills together —
+    fewer prefill program dispatches than streams — instead of
+    serializing TTFT behind batch-1 programs."""
+    server = make_server(max_batch=8, kv_blocks=64, prefill_rows=4,
+                         chunk_size=16).warmup()
+    prompts = prompts_for(8, lo=6, hi=12, seed=21)
+    handles = [server.submit(Request(p, max_new_tokens=4))
+               for p in prompts]
+    server.run()
+    for h, p in zip(handles, prompts):
+        assert h.result(timeout=10) == reference_generate(p, 4)
+    snap = telemetry.snapshot()
+    windows = snap["histograms"]["serve.prefill_ms"]["count"]
+    assert windows < len(prompts), \
+        "burst prefills did not batch (%d windows)" % windows
+    assert snap["counters"]["serve.prefill_chunks"] >= len(prompts)
+
+
+def test_prefix_sharing_reuses_system_prompt_blocks():
+    """N users of one system prompt: the first stream pays the prefill,
+    later streams share its cached blocks (refcounted) and skip those
+    positions — outputs still byte-match the unshared reference."""
+    server = make_server(max_batch=2, kv_blocks=64).warmup()
+    sysp = prompts_for(1, lo=16, hi=17, seed=22)[0]     # 2 full blocks
+    tails = prompts_for(4, lo=2, hi=5, seed=23)
+    first = server.submit(Request(sysp + tails[0], max_new_tokens=4))
+    server.run()                        # prefix now cached
+    handles = [server.submit(Request(sysp + t, max_new_tokens=4))
+               for t in tails[1:]]
+    server.run()
+    assert first.result(timeout=10) == reference_generate(sysp + tails[0], 4)
+    for h, t in zip(handles, tails[1:]):
+        assert h.result(timeout=10) == reference_generate(sysp + t, 4)
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve.prefix.hits"] >= 3
+    assert snap["serve.prefix.blocks_shared"] >= 6      # 2 blocks x 3
+    # every stream retired: only the index holds blocks, refcounts exact
+    assert server.pool.blocks_in_use == server.pool.prefix_blocks
+    assert server.pool.reconcile() == 0
+
+
+def test_prefix_cow_at_divergence_block():
+    """Two prompts diverging INSIDE a block: the divergence block is
+    copied-on-write (counted) and only the true tail re-prefills."""
+    base = prompts_for(1, lo=16, hi=17, seed=24)[0]
+    p1 = base + [1, 2]
+    p2 = base[:12] + [9, 9, 9]          # diverges inside block 1
+    server = make_server(max_batch=1, kv_blocks=64).warmup()
+    h1 = server.submit(Request(p1, max_new_tokens=4))
+    server.run()
+    h2 = server.submit(Request(p2, max_new_tokens=4))
+    server.run()
+    assert h1.result(timeout=10) == reference_generate(p1, 4)
+    assert h2.result(timeout=10) == reference_generate(p2, 4)
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve.prefix.cow"] >= 1
+    assert snap["serve.prefix.hits"] >= 1
+
+
+def test_prefix_eviction_under_pool_pressure():
+    """Cached prefixes are best-effort: when a fresh allocation would
+    fail, least-recently-matched index entries are evicted and the
+    request still completes."""
+    server = make_server(max_batch=1, kv_blocks=6, block_size=8,
+                         max_context=48).warmup()
+    p1 = prompts_for(1, lo=16, hi=17, seed=25)[0]
+    h1 = server.submit(Request(p1, max_new_tokens=4))
+    server.run()
+    assert server.pool.prefix_blocks >= 1
+    big = prompts_for(1, lo=30, hi=31, seed=26)[0]      # needs ~the pool
+    h2 = server.submit(Request(big, max_new_tokens=8))
+    server.run()
+    assert h2.result(timeout=10) == reference_generate(big, 8)
+    assert telemetry.snapshot()["counters"]["serve.prefix.evictions"] >= 1
+    h1.result(timeout=10)
+
+
+def test_prefix_eviction_never_recycles_own_match():
+    """Regression: under pressure, admission's eviction pass must not
+    reclaim the very blocks it just matched as this stream's shared
+    prefix — the freed block would be popped right back as a 'fresh'
+    block, the table holding the same id twice and the stream clobbering
+    its own shared KV. Protecting the match costs nothing (sharing s
+    blocks shrinks demand by the same s an eviction would free), so a
+    shortfall here is a true Overloaded — with NOTHING reserved."""
+    pool = KVBlockPool(CFG, num_blocks=5, block_size=4)
+    base = list(range(100, 108))        # 2 full blocks
+    ta, _, _ = pool.admit("a", 8, context=base)
+    pool.register_prefix("a", base)
+    pool.free("a")                      # index-only refs on ta[0], ta[1]
+    pool.admit("live", 8)               # 2 blocks held by a live stream
+    assert pool.free_blocks == 1
+    with pytest.raises(Overloaded):     # 4 blocks can never fit: 2 are
+        pool.admit("b", 16, context=base + [1] * 8)  # live, match kept
+    assert pool.owned_blocks("b") == []          # nothing reserved
+    assert pool.prefix_blocks == 2               # match NOT evicted
+    assert pool.reconcile() == 0                 # refcounts exact
+    # backpressure resolves it: the live stream frees, admission then
+    # shares the (still-cached) prefix with no duplicate block ids
+    pool.free("live")
+    tb, fs, cow = pool.admit("b", 16, context=base + [1] * 8)
+    assert len(tb) == len(set(tb)) == 4, tb
+    assert fs == 8 and tb[:2] == ta[:2]
+    pool.free("b")
+    assert pool.blocks_in_use == pool.prefix_blocks
+
+
+def test_prefix_sharing_knob_inert(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SERVE_PREFIX", "0")
+    server = make_server().warmup()
+    p = prompts_for(1, lo=10, hi=11, seed=27)[0]
+    for _ in range(2):
+        h = server.submit(Request(p, max_new_tokens=3))
+        server.run()
+        h.result(timeout=10)
+    snap = telemetry.snapshot()["counters"]
+    assert "serve.prefix.lookups" not in snap
+    assert server.pool.prefix_blocks == 0
+
+
+def make_spec_server(identity=False, **kw):
+    kw.setdefault("kv_blocks", 64)
+    kw.setdefault("max_batch", 4)
+    if identity:
+        kw.update(draft_params=PARAMS, draft_cfg=CFG)
+    else:
+        kw.update(draft_params=DRAFT_PARAMS, draft_cfg=DRAFT_CFG)
+    kw.setdefault("spec_k", 3)
+    return make_server(**kw)
+
+
+def test_spec_decode_byte_identical_to_plain_greedy():
+    """THE spec acceptance bar: draft-k/verify greedy decode emits the
+    exact token streams of the non-speculative path — with a random
+    draft (accept ~0) AND an identity draft (accept 1.0) — at zero
+    post-warm-up compiles."""
+    prompts = prompts_for(6, seed=28)
+    budgets = [5 + i % 3 for i in range(6)]
+    baseline, _ = _serve_all(make_server(max_batch=4,
+                                         kv_blocks=64).warmup(),
+                             prompts, budgets)
+    for identity in (False, True):
+        telemetry.reset()
+        server = make_spec_server(identity=identity).warmup()
+        warm = len(telemetry.recent_compiles())
+        out, _ = _serve_all(server, prompts, budgets)
+        assert out == baseline, "spec output diverged (identity=%s)" \
+            % identity
+        new = [n for n, _ in telemetry.recent_compiles()][warm:]
+        assert new == [], new
+        snap = telemetry.snapshot()["counters"]
+        assert snap["serve.spec.rounds"] >= 1
+        assert snap["serve.spec.drafted"] == (snap["serve.spec.accepted"]
+                                              + snap["serve.spec.rejected"])
+        rate = snap["serve.spec.accepted"] / snap["serve.spec.drafted"]
+        if identity:
+            # the draft IS the target: every draft must verify (this is
+            # the no-stale-KV invariant, not a modeling claim)
+            assert rate == 1.0, rate
+    telemetry.reset()
+
+
+def test_spec_mixed_with_sampled_streams():
+    """Sampled streams bypass the draft/verify loop (spec stays
+    greedy-verify) but decode alongside spec streams — and their draws
+    match a spec-free server's draws exactly."""
+    prompts = prompts_for(4, seed=29)
+    plain = make_server(max_batch=4, kv_blocks=64).warmup()
+    ph = [plain.submit(Request(p, max_new_tokens=5, request_id="r%d" % i,
+                               temperature=0.8 if i % 2 else 0.0))
+          for i, p in enumerate(prompts)]
+    plain.run()
+    expected = [h.result(timeout=10) for h in ph]
+    telemetry.reset()
+    server = make_spec_server(identity=True).warmup()
+    sh = [server.submit(Request(p, max_new_tokens=5, request_id="r%d" % i,
+                                temperature=0.8 if i % 2 else 0.0))
+          for i, p in enumerate(prompts)]
+    server.run()
+    assert [h.result(timeout=10) for h in sh] == expected
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve.spec.rounds"] >= 1   # greedy streams rode spec
+
+
+def test_sampling_deterministic_and_filtered():
+    """Per-stream draws are a pure function of (seed, position): reruns
+    replay them; top_k=1 collapses to greedy; a different seed moves."""
+    prompt = prompts_for(1, seed=30)[0]
+
+    def run_once(**kw):
+        server = make_server(max_batch=1).warmup()
+        h = server.submit(Request(prompt, max_new_tokens=6,
+                                  request_id="fixed", **kw))
+        server.run()
+        return h.result(timeout=10)
+
+    greedy = run_once()
+    a = run_once(temperature=0.9, seed=11)
+    assert a == run_once(temperature=0.9, seed=11)
+    assert a != run_once(temperature=0.9, seed=12)
+    assert run_once(temperature=0.9, top_k=1, seed=11) == greedy
+    # id-derived default seed: same request_id -> same draws
+    assert run_once(temperature=0.9) == run_once(temperature=0.9)
+    with pytest.raises(ValueError):
+        Request([1], top_p=0.0)
+    with pytest.raises(ValueError):
+        Request([1], top_k=-1)
+
+
+def test_sampled_stream_kill_recovery_byte_identical():
+    """Kill-recovery replay for SAMPLED streams: the position-keyed draws
+    make the resumed stream emit the same tokens the unfaulted run
+    would."""
+    prompts = prompts_for(4, seed=31)
+    kw = dict(max_new_tokens=6, temperature=0.7, top_p=0.9)
+    server = make_server(max_batch=2, kv_blocks=64).warmup()
+    handles = [server.submit(Request(p, seed=40 + i, **kw))
+               for i, p in enumerate(prompts)]
+    server.run()
+    baseline = [h.result(timeout=10) for h in handles]
+    telemetry.reset()
+    server = make_server(max_batch=2, kv_blocks=64).warmup()
+    with faults.inject("serve.step:error:3"):
+        handles = [server.submit(Request(p, seed=40 + i, **kw))
+                   for i, p in enumerate(prompts)]
+        server.run()
+    assert [h.result(timeout=10) for h in handles] == baseline
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve.recoveries"] == 1
+    assert snap["serve.requeued_streams"] >= 1
+
+
+def test_spec_and_prefix_survive_replica_kill_exact_refcounts():
+    """THE ISSUE 13 recovery acceptance: replicas killed mid-stream under
+    spec decoding + shared prefixes resume byte-identical, with the
+    shared-prefix refcounts reconciled exactly (no leaked or double-freed
+    blocks) and zero post-warm-up compiles."""
+    sysp = prompts_for(1, lo=16, hi=17, seed=32)[0]
+    tails = prompts_for(6, lo=2, hi=5, seed=33)
+    prompts = [sysp + t for t in tails]
+    budgets = [6] * 6
+    baseline, _ = _serve_all(
+        make_spec_server(identity=True, max_batch=4).warmup(),
+        prompts, budgets)
+    telemetry.reset()
+    server = make_spec_server(identity=True, max_batch=4).warmup()
+    warm = len(telemetry.recent_compiles())
+    os.environ["MXNET_TPU_FAULT_PLAN"] = \
+        "serve.step:error:3;serve.step:error:6"
+    try:
+        faults.activate()
+        chaos, handles = _serve_all(server, prompts, budgets)
+    finally:
+        del os.environ["MXNET_TPU_FAULT_PLAN"]
+        faults.deactivate()
+    assert chaos == baseline
+    new = [n for n, _ in telemetry.recent_compiles()][warm:]
+    assert new == [], new
+    snap = telemetry.snapshot()["counters"]
+    assert snap["serve.recoveries"] == 2
+    # refcounts exact: a reconcile finds NOTHING to fix, and the only
+    # live blocks are the index's
+    assert server.pool.reconcile() == 0
+    assert server.pool.blocks_in_use == server.pool.prefix_blocks
+    assert snap.get("serve.prefix.hits", 0) >= 1
+
+
+def test_recovery_storage_reset_clears_prefix_cache():
+    """White-box: when recovery re-materializes donated-away pool storage
+    (fresh zeros), every cached prefix must be dropped with it — a later
+    match would hand out garbage KV."""
+    from mxnet_tpu.resilience.errors import InjectedFault
+    server = make_server(max_batch=1).warmup()
+    p = prompts_for(1, lo=12, hi=13, seed=34)[0]
+    h = server.submit(Request(p, max_new_tokens=3))
+    server.run()
+    h.result(timeout=10)
+    assert server.pool.prefix_blocks >= 1
+    for leaf in jax.tree_util.tree_leaves(server.pool.pools):
+        leaf.delete()
+    server._recover(InjectedFault("window", site="serve.step"))
+    assert server.pool.prefix_blocks == 0
+    assert server.pool.blocks_in_use == 0
+    # and the cache rebuilds from the next completed prefill
+    h2 = server.submit(Request(p, max_new_tokens=3))
+    server.run()
+    assert h2.result(timeout=10) == h.result()
+    assert server.pool.prefix_blocks >= 1
+
+
+def test_spec_verify_window_respects_reserved_range():
+    """Regression: near the end of a stream's budget the verify window
+    p..p+k would overrun the stream's reserved positions; the gather
+    clamp then redirects those writes into its LAST real block,
+    overwriting valid KV rows the same round still reads. Overflow
+    columns must ride position -1 (dropped), capped at the remaining
+    budget — asserted by byte-parity on a stream whose worst-case
+    context exactly fills max_context."""
+    # geometry chosen so the LAST spec round starts at p = 29 with spec_k
+    # = 3: its unmasked window reaches position 32 == max_context, one
+    # past the reserved range (a 12-token prompt aligns the rounds so
+    # the window never overruns — 13 breaks the alignment)
+    prompt = prompts_for(1, lo=13, hi=14, seed=35)[0]
+    budget = 32 - len(prompt) + 1       # prompt + budget - 1 == 32
+    server = make_server(max_batch=1, kv_blocks=64).warmup()
+    h = server.submit(Request(prompt, max_new_tokens=budget))
+    server.run()
+    baseline = h.result(timeout=20)
+    spec = make_spec_server(identity=True, max_batch=1).warmup()
+    h2 = spec.submit(Request(prompt, max_new_tokens=budget))
+    spec.run()
+    assert h2.result(timeout=20) == baseline
+
+
+def test_chunk_writes_drop_past_table_range():
+    """White-box program-level guard: a chunk/verify position past the
+    block table must DROP its KV write — a clamped gather index would
+    silently land it in the stream's last real block, overwriting live
+    rows (caught building the spec verify window)."""
+    server = make_spec_server(identity=True, max_batch=1).warmup()
+    pool = server.pool
+    table, _, _ = pool.admit("s", 32)           # all 4 blocks of a 32-ctx
+    nb = server.programs.blocks_per_stream
+    tables = np.full((1, nb), pool.num_blocks, np.int32)
+    tables[0, :len(table)] = table
+    before = jax.tree_util.tree_map(np.asarray, pool.pools)
+    k = server.programs.spec_k
+    vt = np.full((1, k + 1), 5, np.int32)
+    vp = np.full((1, k + 1), -1, np.int32)
+    vp[0, 0] = 32                               # one past the table range
+    server.programs.verify(vt, vp, tables)
+    after = jax.tree_util.tree_map(np.asarray, pool.pools)
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    pool.free("s")
+
+
+def test_prebake_cache_tool_warms_fleet_boot(tmp_path, monkeypatch):
+    """tools/prebake_cache.py (the PR 11 follow-on): a manifest-driven
+    pre-bake pays every serve compile once; a replica booting with the
+    same geometry then warms up at ZERO fresh compiles."""
+    import json
+    import subprocess
+    import sys
+    manifest = {"programs": [{
+        "model": "llama_tiny",
+        "overrides": {"dtype": "float32", "max_seq_len": 64},
+        "serve": {"max_batch": 2, "kv_blocks": 16, "block_size": 8,
+                  "max_context": 16, "chunk_size": 8, "prefill_rows": 2,
+                  "spec_k": 2, "draft_model": "llama_tiny",
+                  "draft_overrides": {"dtype": "float32", "n_layers": 1,
+                                      "max_seq_len": 64}}}]}
+    mpath = tmp_path / "manifest.json"
+    mpath.write_text(json.dumps(manifest))
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "prebake_cache.py")
+    cache = str(tmp_path / "aot")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXNET_TPU_AOT_CACHE", None)
+    proc = subprocess.run(
+        [sys.executable, tool, str(mpath), "--cache", cache,
+         "--format", "json"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout)["entries"][0]
+    assert row["programs"] == 7         # chunk/decode/copy + 4 spec
+    assert row["compiled"] == 7 and row["written"] == 7
+    assert row["errors"] == 0
+    # the fleet-boot experience: same geometry, fresh process-equivalent
+    # params -> every executable restores, zero fresh compiles
+    monkeypatch.setenv("MXNET_TPU_AOT_CACHE", cache)
+    import dataclasses
+
+    from mxnet_tpu.models.llama import CONFIGS
+    cfg = dataclasses.replace(CONFIGS["llama_tiny"], dtype=jnp.float32,
+                              max_seq_len=64)
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    telemetry.reset()
+    InferenceServer(llama_init(jax.random.PRNGKey(9), cfg), cfg,
+                    max_batch=2, kv_blocks=16, block_size=8,
+                    max_context=16, chunk_size=8, prefill_rows=2,
+                    spec_k=2, draft_cfg=dcfg,
+                    draft_params=llama_init(jax.random.PRNGKey(8),
+                                            dcfg)).warmup()
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("serve.compile", 0) == 0, snap
+    assert snap.get("compiler.cache.hits") == 7
 
 
 @pytest.mark.lint

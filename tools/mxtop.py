@@ -16,9 +16,11 @@ Sources (pick one):
 
 Options:
   --serve               serving view: tokens/s, queue depth, batch
-                        occupancy, shed counts, TTFT/TPOT p50/p99 — from a
-                        single replica's /snapshot OR rank 0's
-                        /fleet/snapshot (one row per rank + fleet totals)
+                        occupancy, shed counts, chunked-prefill windows,
+                        prefix-cache hit rate, speculative accept rate,
+                        TTFT/TPOT p50/p99 — from a single replica's
+                        /snapshot OR rank 0's /fleet/snapshot (one row
+                        per rank + fleet totals)
   --interval S          refresh period (default 2 s)
   --once                render a single frame and exit (scripting / tests)
 
@@ -232,10 +234,16 @@ def _serve_row(label, snap, quants):
             return "-"
         return "%s/%s" % (_fmt_num(q.get("p50")), _fmt_num(q.get("p99")))
 
+    def rate(num, den):
+        d = counters.get(den, 0)
+        if not d:
+            return "-"
+        return "%d%%" % round(100.0 * counters.get(num, 0) / d)
+
     tok_s, _ = g("serve.tokens_per_s")
     qd, qd_peak = g("serve.queue_depth")
     occ, occ_peak = g("serve.batch_occupancy")
-    return "  %-6s %9s %7s %7s %6s %6s %6s %6s %15s %15s" % (
+    return "  %-6s %9s %7s %7s %6s %6s %6s %6s %7s %5s %5s %15s %15s" % (
         label, _fmt_num(tok_s),
         "%s/%s" % (_fmt_num(qd), _fmt_num(qd_peak)),
         "%s/%s" % (_fmt_num(occ), _fmt_num(occ_peak)),
@@ -243,6 +251,11 @@ def _serve_row(label, snap, quants):
         counters.get("serve.completed", 0),
         counters.get("serve.shed", 0),
         counters.get("serve.requeued_streams", 0),
+        counters.get("serve.prefill_chunks", 0),
+        # prefix-cache hit rate (admissions reusing cached prompt blocks)
+        # and speculative accept rate (drafts the target agreed with)
+        rate("serve.prefix.hits", "serve.prefix.lookups"),
+        rate("serve.spec.accepted", "serve.spec.drafted"),
         qfmt("serve.ttft_ms"), qfmt("serve.tpot_ms"))
 
 
@@ -265,9 +278,10 @@ def render_serve(payload, prev_payload=None, dt=None, source=""):
             health += ", %s%d missing%s" % (RED, len(missing), RESET)
         lines.append("  fleet: " + health)
     lines.append("")
-    header = "  %-6s %9s %7s %7s %6s %6s %6s %6s %15s %15s" % (
-        "rank", "tok/s", "queue", "batch", "reqs", "done", "shed",
-        "requeue", "ttft p50/p99", "tpot p50/p99")
+    header = "  %-6s %9s %7s %7s %6s %6s %6s %6s %7s %5s %5s %15s %15s" \
+        % ("rank", "tok/s", "queue", "batch", "reqs", "done", "shed",
+           "requeue", "chunks", "pfx%", "acc%", "ttft p50/p99",
+           "tpot p50/p99")
     lines.append(BOLD + header + RESET)
     if fleet:
         merged_counters = payload["merged"].get("counters", {})

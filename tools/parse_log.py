@@ -321,18 +321,22 @@ def _hist_quantile(h, q):
     return round(val, 3)
 
 
-# the serving headline, in client-experience order: traffic in, latency
-# felt, pressure and shedding, recovery churn
+# the serving headline, in client-experience order: traffic in, prompt
+# work (chunked prefill + prefix reuse), decode (incl. speculation),
+# latency felt, pressure and shedding, recovery churn
 _SERVE_COUNTERS = ("requests", "admitted", "completed", "tokens",
-                   "prefills", "decode_steps", "shed", "failed",
+                   "prefills", "prefill_chunks", "prefill_chunk_tokens",
+                   "prefix", "decode_steps", "spec", "shed", "failed",
                    "recoveries", "requeued_streams", "compile", "retrace")
 
 
 def parse_serve(obj):
     """Extract the serving story from a telemetry snapshot: serve.*
-    counters, TTFT/TPOT quantiles derived from the latency histograms,
-    and the pressure gauges (queue depth, batch occupancy, KV-pool
-    blocks). Returns [(metric, value)] rows."""
+    counters (chunked-prefill, prefix-sharing, and speculative-decoding
+    columns included), derived prefix_hit_rate / spec_accept_rate,
+    TTFT/TPOT quantiles from the latency histograms, and the pressure
+    gauges (queue depth, batch occupancy, KV-pool blocks).
+    Returns [(metric, value)] rows."""
     if "telemetry" in obj and isinstance(obj["telemetry"], dict):
         obj = obj["telemetry"]
     counters = obj.get("counters", {})
@@ -350,6 +354,16 @@ def parse_serve(obj):
         for sub in sorted(counters):
             if sub.startswith(prefix):
                 rows.append((sub[len("serve."):], counters[sub]))
+    lookups = counters.get("serve.prefix.lookups", 0)
+    if lookups:
+        rows.append(("prefix_hit_rate",
+                     round(counters.get("serve.prefix.hits", 0)
+                           / lookups, 4)))
+    drafted = counters.get("serve.spec.drafted", 0)
+    if drafted:
+        rows.append(("spec_accept_rate",
+                     round(counters.get("serve.spec.accepted", 0)
+                           / drafted, 4)))
     for hname, label in (("serve.ttft_ms", "ttft_ms"),
                          ("serve.tpot_ms", "tpot_ms"),
                          ("serve.step_ms", "step_ms"),
@@ -361,6 +375,7 @@ def parse_serve(obj):
     for gname, label in (("serve.queue_depth", "queue_depth"),
                          ("serve.batch_occupancy", "batch_occupancy"),
                          ("serve.kv.blocks_in_use", "kv_blocks_in_use"),
+                         ("serve.prefix.blocks", "prefix_cache_blocks"),
                          ("serve.replicas_alive", "replicas_alive")):
         g = gauges.get(gname)
         if g is not None:

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""prebake_cache — populate a shared AOT-executable cache before the
+fleet boots.
+
+The persistent AOT cache (``MXNET_TPU_AOT_CACHE``) makes the SECOND
+process cheap; this tool makes the FIRST one cheap too, by paying every
+serve-program compile once, centrally, from a **program manifest** — so a
+thousand replicas cold-start restoring serialized executables instead of
+racing XLA. Executables are value-independent (the cache key is model
+geometry + pool geometry + param avals), so the tool compiles against
+freshly-initialized parameters of the right shapes; the weights the fleet
+loads later restore the same binaries.
+
+Manifest (JSON)::
+
+    {"programs": [
+      {"model": "llama_tiny",                   # models.llama.CONFIGS key
+       "overrides": {"dtype": "float32"},       # LlamaConfig replacements
+       "serve": {"max_batch": 8, "kv_blocks": 64, "block_size": 8,
+                 "max_context": 48, "chunk_size": 16, "prefill_rows": 4,
+                 "spec_k": 4,                   # with draft_model: spec
+                 "draft_model": "llama_tiny",   # draft programs prebaked
+                 "draft_overrides": {"n_layers": 1}}}
+    ]}
+
+Every entry warms one `InferenceServer` geometry: the chunk-prefill,
+decode, and CoW-copy executables — plus the draft-chunk / draft-k /
+verify executables when a draft model is named. Run it twice and the
+second pass reports 0 fresh compiles (the fleet's boot experience).
+
+Usage::
+
+    python tools/prebake_cache.py manifest.json --cache /shared/aot
+    python tools/prebake_cache.py manifest.json          # env cache dir
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable from anywhere: an installed mxnet_tpu wins, otherwise the
+# checkout this script lives in (tools/..) provides it
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _build_cfg(entry_model, overrides):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from mxnet_tpu.models.llama import CONFIGS
+    if entry_model not in CONFIGS:
+        raise SystemExit("prebake: unknown model %r (have: %s)"
+                         % (entry_model, ", ".join(sorted(CONFIGS))))
+    cfg = CONFIGS[entry_model]
+    overrides = dict(overrides or {})
+    if "dtype" in overrides:
+        overrides["dtype"] = jnp.dtype(overrides["dtype"]).type
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def prebake(manifest, cache_dir=None):
+    """Warm every manifest entry; returns the per-entry report rows."""
+    if cache_dir:
+        os.environ["MXNET_TPU_AOT_CACHE"] = cache_dir
+    if not os.environ.get("MXNET_TPU_AOT_CACHE"):
+        raise SystemExit(
+            "prebake: no cache directory — pass --cache DIR or set "
+            "MXNET_TPU_AOT_CACHE (without it this tool only measures "
+            "compile times and bakes nothing)")
+    import jax
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models.llama import llama_init
+    from mxnet_tpu.serve import InferenceServer
+
+    rows = []
+    for i, entry in enumerate(manifest.get("programs", [])):
+        serve_kw = dict(entry.get("serve", {}))
+        draft_model = serve_kw.pop("draft_model", None)
+        draft_overrides = serve_kw.pop("draft_overrides", None)
+        cfg = _build_cfg(entry.get("model", "llama_tiny"),
+                         entry.get("overrides"))
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        if draft_model is not None:
+            dcfg = _build_cfg(draft_model, draft_overrides)
+            serve_kw["draft_cfg"] = dcfg
+            serve_kw["draft_params"] = llama_init(jax.random.PRNGKey(1),
+                                                  dcfg)
+
+        def counters():
+            return telemetry.snapshot().get("counters", {})
+
+        before = counters()
+        server = InferenceServer(params, cfg, **serve_kw)
+        server.warmup()
+        after = counters()
+
+        def delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+
+        rows.append({
+            "entry": i,
+            "model": entry.get("model", "llama_tiny"),
+            "programs": len(server.programs.program_names),
+            "compiled": delta("serve.compile"),
+            "restored": delta("compiler.cache.hits"),
+            "written": delta("compiler.cache.writes"),
+            "errors": (delta("compiler.cache.serialize_error")
+                       + delta("compiler.cache.write_error")),
+        })
+    return rows
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("manifest", help="program manifest JSON")
+    parser.add_argument("--cache", help="cache directory "
+                        "(default: $MXNET_TPU_AOT_CACHE)")
+    parser.add_argument("--format", choices=("table", "json"),
+                        default="table")
+    args = parser.parse_args(argv)
+    with open(args.manifest) as f:
+        manifest = json.load(f)
+    rows = prebake(manifest, cache_dir=args.cache)
+    if args.format == "json":
+        print(json.dumps({"entries": rows}))
+    else:
+        print("entry  model            programs  compiled  restored  "
+              "written  errors")
+        for r in rows:
+            print("%-6s %-16s %8d  %8d  %8d  %7d  %6d"
+                  % (r["entry"], r["model"][:16], r["programs"],
+                     r["compiled"], r["restored"], r["written"],
+                     r["errors"]))
+        total_c = sum(r["compiled"] for r in rows)
+        total_r = sum(r["restored"] for r in rows)
+        print("total: %d compiled, %d restored -> %s"
+              % (total_c, total_r, os.environ.get("MXNET_TPU_AOT_CACHE")))
+    # a serialize/write error means the NEXT boot will recompile — that
+    # is the condition a pre-bake pipeline must fail loudly on
+    return 1 if any(r["errors"] for r in rows) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

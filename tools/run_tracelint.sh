@@ -41,11 +41,13 @@ if [ -n "${MXNET_TPU_TRACELINT_CACHE:-}" ]; then
 else
     set -- --cache "$@"
 fi
-# tools/mxtop.py rides along: the dashboard spawns no traces itself but
-# shares the telemetry thread model the TPU006 rule audits. The package
-# root covers mxnet_tpu/serve/ AND mxnet_tpu/compiler/ — the serving
-# scheduler/replica threads are TPU006-clean with zero suppressions
-# (tests/test_serve.py asserts it under the lint marker), and the
-# whole-graph compiler package is tracelint-clean with zero suppressions
-# (tests/test_compiler.py asserts it the same way).
-exec python -m mxnet_tpu.analysis mxnet_tpu tools/mxtop.py --fail-on=error "$@"
+# tools/mxtop.py and tools/prebake_cache.py ride along: the dashboard
+# spawns no traces itself but shares the telemetry thread model the
+# TPU006 rule audits, and the pre-bake tool drives the serve warmup
+# path. The package root covers mxnet_tpu/serve/ AND mxnet_tpu/compiler/
+# — the serving scheduler/replica threads are TPU006-clean with zero
+# suppressions (tests/test_serve.py asserts it under the lint marker),
+# and the whole-graph compiler package is tracelint-clean with zero
+# suppressions (tests/test_compiler.py asserts it the same way).
+exec python -m mxnet_tpu.analysis mxnet_tpu tools/mxtop.py \
+    tools/prebake_cache.py --fail-on=error "$@"
