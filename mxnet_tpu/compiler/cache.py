@@ -162,6 +162,13 @@ class AOTCache:
             _telem.inc("compiler.cache.corrupt")
             _telem.inc("compiler.cache.misses")
             return None
+        if isinstance(meta, dict) and meta.get("memory_analysis"):
+            # replay the static footprint recorded at compile time: a warm
+            # restore reports memory_analysis WITHOUT recompiling (the
+            # ledger's fleet cold-start evidence)
+            from ..telemetry import ledger as _ledger
+            _ledger.note_program(label, meta["memory_analysis"],
+                                 cached=True)
         _telem.inc("compiler.cache.hits")
         _telem.observe("compiler.cache.load_ms",
                        (time.perf_counter() - t0) * 1e3)
@@ -251,12 +258,23 @@ def load_or_compile(key, lower_fn, label, meta=None):
     (restored executable, True) without calling `lower_fn`; a miss
     calls it, compiles, stores, and returns (executable, False).
     Site-specific telemetry (`serve.compile`, `*.aot_restored`, ...)
-    stays with the callers — they count different things."""
+    stays with the callers — they count different things.
+
+    The miss branch harvests `compiled.memory_analysis()` into the HBM
+    ledger AND into the cache entry's meta, so the hit branch (another
+    process, a warm restart) replays the same footprint without a
+    recompile — see telemetry/ledger.py."""
+    from ..telemetry import ledger as _ledger
     cache = aot_cache()
     ex = cache.load(key, label)
     if ex is not None:
         return ex, True
     compiled = lower_fn().compile()
+    footprint = _ledger.harvest(compiled)
+    _ledger.note_program(label, footprint)
+    meta = dict(meta or {})
+    if footprint:
+        meta["memory_analysis"] = footprint
     cache.store(key, compiled, label, meta=meta)
     return compiled, False
 

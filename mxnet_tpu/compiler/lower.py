@@ -172,8 +172,15 @@ class GraphProgram:
             _telem.observe("compiler.compile_ms",
                            (time.perf_counter() - t0) * 1e3)
             _telem.note_compile(label + "[fresh]")
-            cache.store(key, compiled, label,
-                        meta={"graph": self.graph_hash, "mode": mode})
+            from ..telemetry import ledger as _ledger
+            footprint = _ledger.harvest(compiled)
+            _ledger.note_program(label, footprint)
+            meta = {"graph": self.graph_hash, "mode": mode}
+            if footprint:
+                # stored in the entry so a warm restore (cache.load)
+                # replays the footprint without recompiling
+                meta["memory_analysis"] = footprint
+            cache.store(key, compiled, label, meta=meta)
         if len(_MEMO) >= _MEMO_MAX:
             _MEMO.clear()
         _MEMO[memo_key] = compiled

@@ -418,6 +418,10 @@ class BucketLayout:
     def __init__(self, buckets, world):
         self.world = max(1, int(world))
         self.buckets = list(buckets)
+        # HBM ledger: the frozen layout IS the flat-gradient working set
+        # this rank materializes every step (pack + reduce-scatter input)
+        from .telemetry import ledger as _ledger
+        _ledger.account("grad_buckets", self.total_nbytes())
 
     @classmethod
     def from_entries(cls, entries, world, cap_bytes=None):

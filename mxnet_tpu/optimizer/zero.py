@@ -196,8 +196,10 @@ class ZeroUpdater:
 
     def _update_state_gauge(self):
         from .. import telemetry as _telem
-        _telem.set_gauge("opt.state_bytes_per_rank",
-                         self.state_bytes_per_rank())
+        from ..telemetry import ledger as _ledger
+        nbytes = self.state_bytes_per_rank()
+        _telem.set_gauge("opt.state_bytes_per_rank", nbytes)
+        _ledger.account("optimizer", nbytes)
 
     # -- per-step scalars ------------------------------------------------
     def _idx(self, key):

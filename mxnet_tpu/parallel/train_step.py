@@ -164,6 +164,9 @@ class ShardedTrainStep:
         opt_state = _tmap(
             lambda x, s: jax.device_put(
                 x, NamedSharding(self.mesh, s)), opt_state, opt_specs)
+        from ..telemetry import ledger as _ledger
+        _ledger.account("params", _ledger.tree_nbytes(params))
+        _ledger.account("optimizer", _ledger.tree_nbytes(opt_state))
         return params, opt_state
 
     def _state_specs(self, opt_state):
@@ -231,6 +234,11 @@ class ShardedTrainStep:
         opt_state = _tmap(
             lambda x, s: donated_device_put(x, s, self.mesh, donate),
             opt_state, opt_specs)
+        # re-layout is exactly when residency changes — re-account both
+        # scopes so the ledger tracks the move, not the stale layout
+        from ..telemetry import ledger as _ledger
+        _ledger.account("params", _ledger.tree_nbytes(params))
+        _ledger.account("optimizer", _ledger.tree_nbytes(opt_state))
         return params, opt_state
 
     def rebuild_for_mesh(self, mesh):

@@ -80,7 +80,8 @@ class StallError(RetriableError):
     """
 
     def __init__(self, message, site=None, deadline_s=None, span_dump=None,
-                 device_dump=None, compile_dump=None, flight_dump=None):
+                 device_dump=None, compile_dump=None, flight_dump=None,
+                 ledger_dump=None):
         super().__init__(message)
         self.site = site
         self.deadline_s = deadline_s
@@ -92,6 +93,9 @@ class StallError(RetriableError):
         self.compile_dump = list(compile_dump or [])
         # list of per-step dicts — telemetry.flight_records() tail
         self.flight_dump = list(flight_dump or [])
+        # {scope: bytes} — telemetry.memory_scopes() (the HBM ledger):
+        # WHOSE bytes the device held when it hung
+        self.ledger_dump = dict(ledger_dump or {})
 
     def format_spans(self, limit=20):
         lines = ["recent spans (newest last):"]
@@ -117,11 +121,26 @@ class StallError(RetriableError):
         from ..telemetry.flight import format_records
         return format_records(self.flight_dump, limit=limit)
 
+    def format_ledger(self, top=6):
+        """Per-scope HBM breakdown, largest first — the memory half of
+        "what was the device holding when it hung"."""
+        if not self.ledger_dump:
+            return "memory ledger: unavailable"
+        lines = ["memory ledger (top scopes):"]
+        ranked = sorted(self.ledger_dump.items(),
+                        key=lambda kv: -abs(kv[1]))
+        for name, val in ranked[:top]:
+            lines.append("  %-14s %d bytes" % (name, val))
+        return "\n".join(lines)
+
     def format_report(self, span_limit=20):
-        """The one-stop post-mortem: host spans, device state, the
-        last-compiled executables, and the flight-recorder step ledger."""
+        """The one-stop post-mortem: host spans, device state, the HBM
+        ledger's scope breakdown, the last-compiled executables, and the
+        flight-recorder step ledger."""
         lines = [str(self), "", self.format_spans(limit=span_limit), "",
                  self.format_devices()]
+        if self.ledger_dump:
+            lines.append(self.format_ledger())
         if self.compile_dump:
             lines.append("last compiled executables (newest last):")
             for name, ts_s in self.compile_dump[-10:]:

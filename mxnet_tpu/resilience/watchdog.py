@@ -180,7 +180,8 @@ class Watchdog:
             span_dump=_telem.span_events(limit=64),
             device_dump=device_dump,
             compile_dump=_telem.recent_compiles(limit=10),
-            flight_dump=_telem.flight_records(limit=32))
+            flight_dump=_telem.flight_records(limit=32),
+            ledger_dump=_telem.memory_scopes())
         with self._cond:
             if self._entries.get(tid) is not entry:
                 # the op completed between deadline-claim and now: its guard

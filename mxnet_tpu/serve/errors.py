@@ -34,13 +34,18 @@ class Overloaded(ServeError):
 
     def __init__(self, message, reason=None, queue_depth=None,
                  kv_free_blocks=None, kv_needed_blocks=None,
-                 retry_after_s=None):
+                 retry_after_s=None, ledger_breakdown=None):
         super().__init__(message)
         self.reason = reason
         self.queue_depth = queue_depth
         self.kv_free_blocks = kv_free_blocks
         self.kv_needed_blocks = kv_needed_blocks
         self.retry_after_s = retry_after_s
+        # {scope: bytes} from the HBM ledger at shed time (kv_exhausted
+        # verdicts): WHICH subsystem's bytes crowded the pool out, not
+        # just that it was full. None when the ledger is disabled.
+        self.ledger_breakdown = (dict(ledger_breakdown)
+                                 if ledger_breakdown else None)
 
 
 class DeadlineExceeded(ServeError):

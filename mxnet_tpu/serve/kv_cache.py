@@ -45,7 +45,13 @@ pressure), ``serve.kv.allocs`` / ``serve.kv.freed_blocks`` /
 ``serve.prefix.lookups`` / ``hits`` / ``blocks_shared`` (each one a
 whole block of prefill skipped AND a block of HBM saved while shared) /
 ``cow`` / ``inserted`` / ``evictions``, plus the ``serve.prefix.blocks``
-gauge (blocks currently pinned by the index).
+gauge (blocks currently pinned by the index). Byte-level attribution
+(ISSUE 16): ``serve.kv.bytes`` / ``serve.kv.draft_bytes`` (blocks in use ×
+bytes/block) and ``serve.prefix.bytes`` gauges, the pool's storage bytes
+accounted to the HBM ledger (scope ``kv_pool`` / ``kv_draft``; prefix
+bytes as the ``prefix_cache`` overlay), and an `Overloaded(kv_exhausted)`
+that carries the full ledger breakdown — the shed verdict names WHOSE
+bytes crowded the pool out.
 """
 from __future__ import annotations
 
@@ -106,14 +112,26 @@ class KVBlockPool:
     """
 
     def __init__(self, cfg, num_blocks=None, block_size=None, dtype=None,
-                 prefix_sharing=None):
+                 prefix_sharing=None, scope="kv_pool"):
         from ..models.llama import init_kv_pools
+        from ..telemetry import ledger as _ledger
         self.cfg = cfg
         self.num_blocks = int(num_blocks or default_num_blocks())
         self.block_size = int(block_size or default_block_size())
         self._dtype = dtype
         self.pools = init_kv_pools(cfg, self.num_blocks, self.block_size,
                                    dtype=dtype)
+        # HBM ledger: the pool arrays are allocated whole up-front — the
+        # scope carries the storage bytes; the byte GAUGES carry pressure
+        # (blocks in use × bytes/block)
+        self.scope = str(scope)
+        self._bytes_gauge = ("serve.kv.draft_bytes"
+                             if self.scope == "kv_draft"
+                             else "serve.kv.bytes")
+        self.storage_bytes = _ledger.tree_nbytes(self.pools)
+        self.bytes_per_block = (self.storage_bytes // self.num_blocks
+                                if self.num_blocks else 0)
+        _ledger.account(self.scope, self.storage_bytes)
         # LIFO free-list: a just-freed (cache-warm) block is reused first
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._tables = {}           # stream_id -> [block ids]
@@ -149,6 +167,25 @@ class KVBlockPool:
 
     def _gauge_locked(self):
         return self.num_blocks - len(self._free)
+
+    def _set_block_gauges(self, in_use):
+        """blocks_in_use + the per-pool byte gauge, in one place (the
+        draft pool reports serve.kv.draft_bytes, the target pool
+        serve.kv.bytes — same block math)."""
+        _telem.set_gauge("serve.kv.draft_blocks_in_use"
+                         if self.scope == "kv_draft"
+                         else "serve.kv.blocks_in_use", in_use)
+        _telem.set_gauge(self._bytes_gauge, in_use * self.bytes_per_block)
+
+    def _set_prefix_gauges(self, n_blocks):
+        """prefix blocks + bytes gauges and the ledger's overlay scope
+        (prefix bytes live INSIDE the pool's storage — annotated, not
+        summed; see ledger.OVERLAY_SCOPES)."""
+        from ..telemetry import ledger as _ledger
+        _telem.set_gauge("serve.prefix.blocks", n_blocks)
+        _telem.set_gauge("serve.prefix.bytes",
+                         n_blocks * self.bytes_per_block)
+        _ledger.account("prefix_cache", n_blocks * self.bytes_per_block)
 
     # -------------------------------------------------------- prefix match
     def _children_of(self, parent_key):
@@ -295,12 +332,16 @@ class KVBlockPool:
                 # so a leftover entry would leak one dict slot per shed
                 free = len(self._free)
                 _telem.inc("serve.kv.exhausted")
+                from ..telemetry import ledger as _ledger
+                brk = _ledger.breakdown()
                 raise Overloaded(
                     "KV pool exhausted: stream %r needs %d more block(s) "
-                    "(%d tokens) but only %d of %d are free"
-                    % (stream_id, grow, n_tokens, free, self.num_blocks),
+                    "(%d tokens) but only %d of %d are free%s"
+                    % (stream_id, grow, n_tokens, free, self.num_blocks,
+                       ("; HBM ledger: " + brk) if brk else ""),
                     reason="kv_exhausted", kv_free_blocks=free,
-                    kv_needed_blocks=grow)
+                    kv_needed_blocks=grow,
+                    ledger_breakdown=_ledger.scopes() or None)
             if grow <= 0 and not shared:
                 return list(table), 0, None
             for b in shared:
@@ -323,7 +364,7 @@ class KVBlockPool:
             _telem.inc("serve.prefix.blocks_shared", shared_n)
         if cow is not None:
             _telem.inc("serve.prefix.cow")
-        _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+        self._set_block_gauges(in_use)
         return list(table), fill_start, cow
 
     def free(self, stream_id):
@@ -338,7 +379,7 @@ class KVBlockPool:
             in_use = self._gauge_locked()
         if freed:
             _telem.inc("serve.kv.freed_blocks", freed)
-        _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+        self._set_block_gauges(in_use)
         return freed
 
     # -------------------------------------------------------- prefix index
@@ -373,7 +414,7 @@ class KVBlockPool:
             n_blocks = len(self._nodes)
         if inserted:
             _telem.inc("serve.prefix.inserted", inserted)
-        _telem.set_gauge("serve.prefix.blocks", n_blocks)
+        self._set_prefix_gauges(n_blocks)
         return inserted
 
     def clear_prefix_cache(self):
@@ -389,8 +430,8 @@ class KVBlockPool:
             in_use = self._gauge_locked()
         if freed:
             _telem.inc("serve.kv.freed_blocks", freed)
-        _telem.set_gauge("serve.prefix.blocks", 0)
-        _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+        self._set_prefix_gauges(0)
+        self._set_block_gauges(in_use)
         return freed
 
     def table(self, stream_id, width):
@@ -441,7 +482,7 @@ class KVBlockPool:
             in_use = self._gauge_locked()
         if recovered:
             _telem.inc("serve.kv.reconciled_blocks", recovered)
-            _telem.set_gauge("serve.kv.blocks_in_use", in_use)
+            self._set_block_gauges(in_use)
         return recovered
 
     def ensure_storage(self):
@@ -460,5 +501,8 @@ class KVBlockPool:
             return False
         self.pools = init_kv_pools(self.cfg, self.num_blocks,
                                    self.block_size, dtype=self._dtype)
+        from ..telemetry import ledger as _ledger
+        self.storage_bytes = _ledger.tree_nbytes(self.pools)
+        _ledger.account(self.scope, self.storage_bytes)
         _telem.inc("serve.kv.storage_resets")
         return True

@@ -387,7 +387,8 @@ class InferenceServer:
                 raise ValueError("serve: draft_params needs draft_cfg")
             self.draft_pool = KVBlockPool(
                 draft_cfg, num_blocks=self.pool.num_blocks,
-                block_size=self.pool.block_size, prefix_sharing=False)
+                block_size=self.pool.block_size, prefix_sharing=False,
+                scope="kv_draft")
         self.programs = ServePrograms(
             params, cfg, self.pool, self.max_batch, max_context,
             chunk_size=chunk_size, prefill_rows=prefill_rows,

@@ -98,6 +98,7 @@ __all__ = ["enabled", "enable", "disable", "registry", "counter", "gauge",
            "trace_id", "set_trace_id", "safe_rank", "local_trace_dump",
            "step_event", "step_quantiles", "flight_records",
            "request_traces", "overlap_report",
+           "memory_scopes", "memory_programs", "capture_profile",
            "Counter", "Gauge", "Histogram", "Registry"]
 
 # the ONLY state instrumented code reads on the disabled fast path
@@ -318,6 +319,29 @@ def maybe_sample_memory():
     return _memory.maybe_sample(registry)
 
 
+def memory_scopes():
+    """The HBM ledger's {scope: bytes} snapshot (params / optimizer /
+    grad_buckets / kv pools / programs / unattributed — see
+    telemetry/ledger.py); {} when the ledger is disabled."""
+    from . import ledger as _ledger
+    return _ledger.scopes()
+
+
+def memory_programs():
+    """Recorded per-executable static footprints
+    (`compiled.memory_analysis()` harvested at compile/AOT-restore time);
+    [] when the ledger is disabled."""
+    from . import ledger as _ledger
+    return _ledger.programs()
+
+
+def capture_profile(ms=None, dir=None):     # noqa: A002 - knob name
+    """Capture one on-demand profiling window (rate-limited; see
+    telemetry/profiling.py). Returns the trace path or None."""
+    from . import profiling as _profiling
+    return _profiling.capture_profile(ms=ms, dir=dir)
+
+
 # ---------------------------------------------------------------- export
 def snapshot():
     return registry.snapshot()
@@ -336,17 +360,20 @@ def compile_report():
 
 def reset():
     """Drop all metrics, recorded spans, the compile ring, the flight
-    recorder, the request-trace ring, and the anomaly windows (does not
-    change ENABLED)."""
+    recorder, the request-trace ring, the anomaly windows, the memory
+    ledger, and the profiling state (does not change ENABLED)."""
     registry.reset()
     _trace.clear()
     with _compiles_lock:
         del _compiles[:]
     from . import anomaly as _anomaly, flight as _flight
+    from . import ledger as _ledger, profiling as _profiling
     from . import request_trace as _reqtrace
     _anomaly.reset()
     _flight.reset()
     _reqtrace.reset()
+    _ledger.reset()
+    _profiling.reset()
 
 
 def dumps(format="table"):
@@ -411,7 +438,7 @@ def step_event(site, dur_ms, info=None):
     if not ENABLED:
         return
     from . import anomaly as _anomaly, attribution as _attrib
-    from . import flight as _flight
+    from . import flight as _flight, ledger as _ledger
     fired = _anomaly.observe(site, dur_ms)
     extras = dict(info) if info else {}
     attrib = _attrib.step_attribution(site, dur_ms, _trace)
@@ -419,6 +446,9 @@ def step_event(site, dur_ms, info=None):
         extras["attrib"] = attrib
     _flight.record_step(site, dur_ms, anomalies=fired,
                         extras=extras or None)
+    # per-step ledger reconcile (rate-limited inside): the unattributed
+    # residual tracks the run, not just its post-mortem
+    _ledger.maybe_reconcile()
 
 
 def step_quantiles(site=None):

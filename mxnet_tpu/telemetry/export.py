@@ -246,6 +246,7 @@ def snapshot_payload():
         q = histogram_quantiles(h)
         if q:
             hist_q[name] = q
+    from . import ledger, profiling
     return {
         "ts": time.time(),
         "rank": telem.safe_rank(),
@@ -254,6 +255,15 @@ def snapshot_payload():
         "step_quantiles": anomaly.quantiles_all(),
         "hist_quantiles": hist_q,
         "flight_steps": len(_flight_recorder()),
+        # the HBM ledger: per-scope bytes, per-program static footprints,
+        # and the last device reconcile (what mxtop --mem / parse_log
+        # --mem tabulate)
+        "memory": {
+            "scopes": ledger.scopes(),
+            "programs": ledger.programs(),
+            "reconcile": ledger.last_reconcile(),
+        },
+        "profiles": profiling.records(),
     }
 
 
@@ -280,9 +290,37 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "mxnet-tpu-telemetry"
 
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         try:
-            if path == "/metrics":
+            if path == "/profile":
+                # on-demand capture (rate-limited in profiling): blocks
+                # THIS handler thread for the window; other scrapes keep
+                # flowing (ThreadingHTTPServer)
+                from urllib.parse import parse_qs
+
+                from . import profiling
+                ms = None
+                raw = parse_qs(query).get("ms", [None])[0]
+                if raw is not None:
+                    try:
+                        ms = int(raw)
+                    except ValueError:
+                        ms = None
+                out = profiling.capture_profile(ms=ms)
+                if out is None:
+                    body = json.dumps(
+                        {"ok": False, "error": "rate_limited",
+                         "min_interval_s": profiling.min_interval_s()},
+                    ).encode("utf-8")
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = json.dumps({"ok": True, "path": out}).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/metrics":
                 body = prometheus_text().encode("utf-8")
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path in ("/", "/snapshot"):

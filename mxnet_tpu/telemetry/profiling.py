@@ -1,0 +1,170 @@
+"""On-demand device profiling: a rate-limited capture window.
+
+Deep profiles (XLA op timelines, HBM traffic) are too heavy to run
+always-on, and the moment an operator *wants* one — a replica suddenly
+slow, a step time regressing — is mid-incident, when restarting the
+process with a profiler attached is exactly what nobody can afford. This
+module captures a bounded `jax.profiler.trace` window **on demand** in
+the live process:
+
+* `capture_profile(ms=N)` — programmatic trigger;
+* ``GET /profile?ms=N`` on the telemetry endpoint (`telemetry.export`) —
+  the operator trigger (`tools/mxtop.py`'s ``p`` key hits it);
+* every capture is announced in the flight ring (``profile`` event), so
+  a post-mortem names the trace files that cover the crash window.
+
+On an accelerator backend the window is a real `jax.profiler.trace`
+(TensorBoard-loadable). On CPU — where the device profiler is mostly
+noise — the fallback writes the telemetry span buffer as a
+chrome://tracing JSON covering the window instead, so the endpoint
+answers with *something* on every backend.
+
+Rate limiting is the safety contract: at most one capture per
+``MXNET_TPU_PROFILE_MIN_S`` (default 30) and never two concurrently —
+a scrape loop (or a stuck retry button) cannot turn the profiler into
+a denial of service. Throttled calls return None and count
+``profile.rate_limited``.
+
+Knobs: ``MXNET_TPU_PROFILE_DIR`` (capture directory; default a
+``mxnet_tpu_profiles`` dir under the system tmp — never the workspace),
+``MXNET_TPU_PROFILE_MS`` (default window 500 ms, clamped to [10, 60000]),
+``MXNET_TPU_PROFILE_MIN_S`` (rate limit). Fully inert under
+``MXNET_TPU_TELEMETRY=0``: no directory, no file, no capture.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+
+__all__ = ["capture_profile", "records", "default_profile_dir",
+           "default_window_ms", "min_interval_s", "reset"]
+
+_MAX_WINDOW_MS = 60000
+_RECORD_LIMIT = 16
+
+_lock = threading.Lock()
+_state = {"last_ts": 0.0, "active": False}
+_records = []           # newest last: {ts, path, kind, ms}
+
+
+def _telem():
+    from .. import telemetry
+    return telemetry
+
+
+def default_profile_dir():
+    return (os.environ.get("MXNET_TPU_PROFILE_DIR")
+            or os.path.join(tempfile.gettempdir(), "mxnet_tpu_profiles"))
+
+
+def default_window_ms():
+    try:
+        return max(10, min(_MAX_WINDOW_MS, int(
+            os.environ.get("MXNET_TPU_PROFILE_MS", "500"))))
+    except (TypeError, ValueError):
+        return 500
+
+
+def min_interval_s():
+    try:
+        return max(0.0, float(os.environ.get("MXNET_TPU_PROFILE_MIN_S",
+                                             "30")))
+    except (TypeError, ValueError):
+        return 30.0
+
+
+def _on_accelerator():
+    try:
+        import jax
+        return any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+def _record(path, kind, ms):
+    entry = {"ts": time.time(), "path": path, "kind": kind, "ms": int(ms)}
+    with _lock:
+        _records.append(entry)
+        if len(_records) > _RECORD_LIMIT:
+            del _records[:-_RECORD_LIMIT]
+    return entry
+
+
+def capture_profile(ms=None, dir=None):     # noqa: A002 - knob name
+    """Capture one profiling window; returns the trace path, or None when
+    disabled, throttled, already capturing, or the capture failed (every
+    outcome is counted — the caller never gets an exception out of a
+    diagnostic)."""
+    telem = _telem()
+    if not telem.ENABLED:
+        return None
+    interval = min_interval_s()
+    now = time.monotonic()
+    with _lock:
+        if _state["active"] or (now - _state["last_ts"] < interval
+                                and _state["last_ts"] > 0.0):
+            throttled = True
+        else:
+            throttled = False
+            _state["active"] = True
+            _state["last_ts"] = now
+    if throttled:
+        telem.inc("profile.rate_limited")
+        return None
+    try:
+        window_ms = default_window_ms() if ms is None else \
+            max(10, min(_MAX_WINDOW_MS, int(ms)))
+        out_dir = dir or default_profile_dir()
+        stamp = "%d_%d" % (int(time.time()), os.getpid())
+        if _on_accelerator():
+            path = os.path.join(out_dir, "device_%s" % stamp)
+            try:
+                import jax
+                os.makedirs(path, exist_ok=True)
+                with jax.profiler.trace(path):
+                    time.sleep(window_ms / 1e3)
+                kind = "device"
+            except Exception:
+                telem.inc("profile.errors")
+                return None
+        else:
+            # CPU fallback: the host-side span window as a chrome trace —
+            # the profiler story this backend actually has
+            path = os.path.join(out_dir, "spans_%s.json" % stamp)
+            try:
+                os.makedirs(out_dir, exist_ok=True)
+                time.sleep(window_ms / 1e3)
+                telem.dump_trace(path)
+                kind = "cpu_spans"
+            except Exception:
+                telem.inc("profile.errors")
+                return None
+        telem.inc("profile.captures")
+        _record(path, kind, window_ms)
+        from . import flight
+        flight.note_event("profile", "%s (%s, %dms)"
+                          % (path, kind, window_ms))
+        return path
+    finally:
+        with _lock:
+            _state["active"] = False
+
+
+def records(limit=None):
+    """Recent capture records (ts/path/kind/ms dicts), oldest first."""
+    with _lock:
+        out = [dict(r) for r in _records]
+    if limit is not None and len(out) > limit:
+        out = out[-limit:]
+    return out
+
+
+def reset():
+    """Forget captures and the rate-limit clock (tests re-arm the
+    throttle this way)."""
+    with _lock:
+        _state["last_ts"] = 0.0
+        _state["active"] = False
+        del _records[:]

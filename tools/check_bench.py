@@ -1,0 +1,211 @@
+"""Continuous perf-regression gate over the bench history.
+
+`tools/run_tracelint.sh --ci` keeps the *code* from regressing;
+`python tools/check_bench.py --ci` keeps the *numbers* from regressing.
+Together they are the CI gate:
+
+    tools/run_tracelint.sh --ci && python tools/check_bench.py --ci
+
+For every (metric, fingerprint) series in ``bench_history.jsonl`` the gate
+compares the NEWEST row against a rolling baseline — the median of up to
+``--window`` (default 5) immediately-preceding rows with the *same*
+fingerprint. Rows from a different environment (other backend, other
+device count, a run that fell back to CPU) are never compared against
+each other: those comparisons are skipped and counted, not failed —
+a laptop checkout must not fail CI because the committed history came
+from an accelerator fleet.
+
+Direction is inferred from the metric name (`*_per_s`/`*_tok_s`/
+`*img_per_sec` → higher is better; `*_ms`/`*_us`/`*_s` → lower is
+better; unknown units are checked both ways against a symmetric band).
+Tolerance defaults to 10% and can be tuned per metric prefix with
+``--tolerance metric_prefix=0.25`` (repeatable). Series with fewer than
+``--min-rows`` (default 2) rows have no baseline yet: skipped+counted.
+
+Exit codes: 0 = no regressions (skips allowed), 1 = at least one
+regression, 2 = usage / unreadable history with --ci.
+Stdlib-only, like every tools/ script — CI runs it from a bare checkout.
+"""
+import argparse
+import json
+import statistics
+import sys
+
+try:
+    import benchdb
+except ImportError:  # invoked as tools/check_bench.py from the repo root
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import benchdb
+
+__all__ = ["direction_for", "check", "main"]
+
+# metric-name suffix → which way "good" points. Checked in order; first hit
+# wins. Throughput names in this repo end in per_sec/per_s/tok_s/img_s;
+# latency names end in _ms/_us/_ns/_s.
+_HIGHER_BETTER = ("per_sec", "per_s", "_tok_s", "_img_s", "_qps",
+                  "throughput", "hits")
+_LOWER_BETTER = ("_ms", "_us", "_ns", "_s", "latency", "overhead_pct",
+                 "_bytes")
+
+
+def direction_for(metric):
+    """'up' (higher better), 'down' (lower better), or 'both' (unknown —
+    regress on movement past the band in either direction)."""
+    name = metric.lower()
+    for suf in _HIGHER_BETTER:
+        if name.endswith(suf) or suf in name.split(".")[-1]:
+            return "up"
+    for suf in _LOWER_BETTER:
+        if name.endswith(suf):
+            return "down"
+    return "both"
+
+
+def _tolerance_for(metric, tolerances, default):
+    """Longest matching prefix wins: `--tolerance serve=0.2` covers every
+    serve_* metric unless a longer prefix is also given."""
+    best, best_len = default, -1
+    for prefix, tol in tolerances.items():
+        if metric.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = tol, len(prefix)
+    return best
+
+
+def check(rows, window=5, min_rows=2, default_tolerance=0.10,
+          tolerances=None):
+    """Evaluate the history. Returns a report dict:
+    {ok, checked, regressions: [...], skipped: {reason: count}, series: N}.
+    Never raises on malformed rows — rows without metric/value/fingerprint
+    are counted under skipped."""
+    tolerances = tolerances or {}
+    series = {}
+    skipped = {"no_fingerprint": 0, "no_value": 0, "insufficient_history": 0,
+               "fingerprint_mismatch": 0}
+    fingerprints_seen = set()
+    for row in rows:
+        metric = row.get("metric")
+        value = row.get("value")
+        fpid = row.get("fingerprint_id")
+        if not metric or not isinstance(value, (int, float)):
+            skipped["no_value"] += 1
+            continue
+        if not fpid:
+            skipped["no_fingerprint"] += 1
+            continue
+        fingerprints_seen.add(fpid)
+        series.setdefault((metric, fpid), []).append(float(value))
+    # a metric measured under several fingerprints: the cross-environment
+    # pairs we deliberately refuse to compare
+    metrics_by_name = {}
+    for metric, fpid in series:
+        metrics_by_name.setdefault(metric, set()).add(fpid)
+    skipped["fingerprint_mismatch"] = sum(
+        len(fps) - 1 for fps in metrics_by_name.values() if len(fps) > 1)
+
+    regressions, checked = [], []
+    for (metric, fpid), values in sorted(series.items()):
+        if len(values) < min_rows:
+            skipped["insufficient_history"] += 1
+            continue
+        newest = values[-1]
+        baseline = statistics.median(values[-(window + 1):-1])
+        tol = _tolerance_for(metric, tolerances, default_tolerance)
+        direction = direction_for(metric)
+        if baseline == 0:
+            delta = 0.0 if newest == 0 else float("inf")
+        else:
+            delta = (newest - baseline) / abs(baseline)
+        if direction == "up":
+            bad = delta < -tol
+        elif direction == "down":
+            bad = delta > tol
+        else:
+            bad = abs(delta) > tol
+        entry = {"metric": metric, "fingerprint_id": fpid,
+                 "newest": newest, "baseline": baseline,
+                 "delta_pct": round(delta * 100.0, 2),
+                 "tolerance_pct": round(tol * 100.0, 2),
+                 "direction": direction, "n": len(values)}
+        checked.append(entry)
+        if bad:
+            regressions.append(entry)
+    return {"ok": not regressions, "series": len(series),
+            "checked": checked, "regressions": regressions,
+            "skipped": skipped,
+            "fingerprints": len(fingerprints_seen)}
+
+
+def _parse_tolerances(pairs):
+    out = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise ValueError("--tolerance wants metric_prefix=FRACTION, "
+                             "got %r" % pair)
+        prefix, _, frac = pair.partition("=")
+        out[prefix] = float(frac)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="perf-regression gate over bench_history.jsonl")
+    ap.add_argument("history", nargs="?", default=None,
+                    help="history file (default: repo bench_history.jsonl "
+                         "or $MXNET_TPU_BENCH_HISTORY)")
+    ap.add_argument("--ci", action="store_true",
+                    help="gate mode: exit 1 on any regression, 2 if the "
+                         "history is unreadable/empty")
+    ap.add_argument("--window", type=int, default=5,
+                    help="rolling-baseline width (median of up to N prior "
+                         "rows; default 5)")
+    ap.add_argument("--min-rows", type=int, default=2,
+                    help="rows a series needs before it is gated "
+                         "(default 2)")
+    ap.add_argument("--default-tolerance", type=float, default=0.10,
+                    help="allowed regression fraction (default 0.10)")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="PREFIX=FRAC",
+                    help="per-metric-prefix tolerance override "
+                         "(repeatable, longest prefix wins)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON object")
+    args = ap.parse_args(argv)
+
+    try:
+        tolerances = _parse_tolerances(args.tolerance)
+    except ValueError as e:
+        print("check_bench: %s" % e, file=sys.stderr)
+        return 2
+    path = args.history or benchdb.history_path()
+    rows = benchdb.load(path)
+    if not rows:
+        print("check_bench: no usable rows in %s" % path, file=sys.stderr)
+        return 2 if args.ci else 0
+
+    report = check(rows, window=args.window, min_rows=args.min_rows,
+                   default_tolerance=args.default_tolerance,
+                   tolerances=tolerances)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        skips = ", ".join("%s=%d" % kv
+                          for kv in sorted(report["skipped"].items())
+                          if kv[1])
+        print("check_bench: %d series, %d gated, %d regression(s)%s"
+              % (report["series"], len(report["checked"]),
+                 len(report["regressions"]),
+                 (" [skipped: %s]" % skips) if skips else ""))
+        for entry in report["checked"]:
+            flag = "REGRESSION" if entry in report["regressions"] else "ok"
+            print("  %-10s %-40s fp=%s %+.2f%% (tol %.0f%%, %s, n=%d)"
+                  % (flag, entry["metric"], entry["fingerprint_id"],
+                     entry["delta_pct"], entry["tolerance_pct"],
+                     entry["direction"], entry["n"]))
+    if report["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,8 +21,16 @@ Options:
                         TTFT/TPOT p50/p99 — from a single replica's
                         /snapshot OR rank 0's /fleet/snapshot (one row
                         per rank + fleet totals)
+  --mem                 memory view: HBM-ledger per-scope bytes (with
+                        per-poll deltas), per-program static footprints
+                        (compile vs AOT-cache restore), the reconcile
+                        residual, and recent profile captures
   --interval S          refresh period (default 2 s)
   --once                render a single frame and exit (scripting / tests)
+
+Keys (live HTTP mode): `p` + Enter triggers an on-device profile capture
+via the endpoint's rate-limited /profile route; the result path (or the
+rate-limit notice) shows in the next frame's footer.
 
 Examples:
   MXNET_TPU_METRICS_PORT=9100 python train.py &
@@ -207,6 +215,109 @@ def render(payload, prev_payload=None, dt=None, source=""):
     return "\n".join(lines)
 
 
+def render_mem(payload, prev_payload=None, dt=None, source=""):
+    """The --mem frame: the HBM ledger's per-scope bytes (with per-poll
+    deltas), the per-program static footprints (compile vs AOT-cache
+    restore), the device/scoped reconcile, and recent profile captures."""
+    mem = payload.get("memory") or {}
+    scopes = mem.get("scopes") or {}
+    programs = mem.get("programs") or []
+    reconcile = mem.get("reconcile") or {}
+    prev_scopes = ((prev_payload or {}).get("memory") or {}).get(
+        "scopes") or {}
+    lines = ["%smxtop --mem%s  %s  %s" % (
+        BOLD, RESET,
+        time.strftime("%H:%M:%S", time.localtime(payload.get("ts",
+                                                             time.time()))),
+        DIM + source + RESET), ""]
+    lines.append(BOLD + "HBM ledger (per-scope bytes)" + RESET)
+    if scopes:
+        lines.append("  %-14s %14s %12s  %s"
+                     % ("scope", "bytes", "delta", "note"))
+        for name, val in sorted(scopes.items(), key=lambda kv: -abs(kv[1])):
+            delta = val - prev_scopes.get(name, val)
+            note = ""
+            if name == "prefix_cache":
+                note = DIM + "overlay (inside kv_pool)" + RESET
+            elif name == "unattributed":
+                note = DIM + "reconcile residual" + RESET
+            lines.append("  %-14s %14s %12s  %s"
+                         % (name, _fmt_bytes(val),
+                            ("%+d" % delta) if delta else "", note))
+    else:
+        lines.append(DIM + "  (no scopes yet — ledger disabled or idle)"
+                     + RESET)
+    if reconcile:
+        lines.append("")
+        lines.append("  reconcile: device %s  scoped %s  residual %s  (%s)"
+                     % (_fmt_bytes(reconcile.get("device_bytes", 0)),
+                        _fmt_bytes(reconcile.get("scoped_bytes", 0)),
+                        _fmt_bytes(reconcile.get("residual_bytes", 0)),
+                        reconcile.get("source", "?")))
+    lines.append("")
+    lines.append(BOLD + "program footprints (static, per executable)"
+                 + RESET)
+    if programs:
+        lines.append("  %-28s %8s %12s %12s"
+                     % ("program", "origin", "temp+code", "args"))
+        ranked = sorted(programs, key=lambda p: -p.get("bytes", 0))
+        for p in ranked[:12]:
+            lines.append("  %-28s %8s %12s %12s"
+                         % (p.get("label", "?")[:28],
+                            "cache" if p.get("cached") else "compile",
+                            _fmt_bytes(p.get("bytes", 0)),
+                            _fmt_bytes(p.get("argument_bytes", 0))))
+        if len(ranked) > 12:
+            lines.append(DIM + "  ... %d more" % (len(ranked) - 12) + RESET)
+    else:
+        lines.append(DIM + "  (none recorded yet)" + RESET)
+    profiles = payload.get("profiles") or []
+    if profiles:
+        lines.append("")
+        lines.append(BOLD + "profile captures" + RESET)
+        for rec in profiles[-4:]:
+            lines.append("  %s  %s (%sms)"
+                         % (rec.get("path", "?"), rec.get("kind", "?"),
+                            rec.get("window_ms", "?")))
+    lines.append("")
+    lines.append(DIM + "press p+Enter to capture an on-device profile"
+                 + RESET)
+    return "\n".join(lines)
+
+
+def _trigger_profile(base_url):
+    """GET /profile on the polled endpoint (the `p` key). Returns a
+    one-line status for the frame footer."""
+    import urllib.error
+    import urllib.request
+    url = base_url.rsplit("/", 1)[0] + "/profile"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        return "profile captured: %s" % body.get("path")
+    except urllib.error.HTTPError as exc:
+        if exc.code == 429:
+            return "profile rate-limited (min interval not elapsed)"
+        return "profile failed: HTTP %d" % exc.code
+    except Exception as exc:  # noqa: BLE001 — footer status, not control flow
+        return "profile failed: %s" % exc
+
+
+def _wait_for_key(interval):
+    """Sleep `interval` seconds, returning early with the line if the user
+    typed one (the profile trigger). Falls back to a plain sleep when
+    stdin is not selectable (tests piping /dev/null, Windows files)."""
+    import select
+    try:
+        ready, _, _ = select.select([sys.stdin], [], [], interval)
+    except (OSError, ValueError):
+        time.sleep(interval)
+        return None
+    if ready:
+        return sys.stdin.readline().strip().lower()
+    return None
+
+
 # the sparse-bucket quantile math lives in parse_log (same directory, so
 # it resolves both run-as-script and with tools/ on sys.path): ONE stdlib
 # re-derivation of telemetry.export.histogram_quantiles, not two copies
@@ -328,6 +439,11 @@ def main(argv=None):
                         help="serving view (tokens/s, queue, batch, shed, "
                              "TTFT/TPOT); understands both /snapshot and "
                              "/fleet/snapshot payloads")
+    parser.add_argument("--mem", action="store_true",
+                        help="memory view: HBM-ledger scope bytes with "
+                             "per-poll deltas, per-program static "
+                             "footprints, reconcile residual, recent "
+                             "profile captures")
     parser.add_argument("--interval", type=float, default=2.0)
     parser.add_argument("--once", action="store_true",
                         help="render one frame and exit")
@@ -343,6 +459,7 @@ def main(argv=None):
 
     prev = None
     prev_t = None
+    status = None
     while True:
         try:
             payload = fetch()
@@ -357,15 +474,23 @@ def main(argv=None):
             continue
         now = time.monotonic()
         dt = (now - prev_t) if prev_t is not None else None
-        renderer = render_serve if args.serve else render
+        renderer = (render_mem if args.mem
+                    else render_serve if args.serve else render)
         frame = renderer(payload, prev, dt, source=source)
+        if status:
+            frame += "\n" + BOLD + status + RESET
+            status = None
         if args.once:
             print(frame)
             return 0
         sys.stdout.write(CLEAR + frame + "\n")
         sys.stdout.flush()
         prev, prev_t = payload, now
-        time.sleep(args.interval)
+        # p+Enter during the poll wait triggers an on-device profile
+        # capture on the polled endpoint (HTTP sources only)
+        key = _wait_for_key(args.interval)
+        if key == "p" and not args.stream:
+            status = _trigger_profile(source)
 
 
 if __name__ == "__main__":

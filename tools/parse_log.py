@@ -775,6 +775,108 @@ def _print_lint(rows, fmt):
         print("| %s | %s | %d |" % (code, sev, by_rule[(code, sev)]))
 
 
+_OVERLAY_SCOPES = ("prefix_cache",)   # bytes shared with another scope
+
+
+def _mem_fmt_bytes(n):
+    n = float(n)
+    sign = "-" if n < 0 else ""
+    n = abs(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return ("%s%.1f%s" % (sign, n, unit) if unit != "B"
+                    else "%s%d%s" % (sign, int(n), unit))
+        n /= 1024.0
+
+
+def parse_mem(obj):
+    """Extract the HBM-ledger story from a `/snapshot` payload (its
+    ``memory`` block: per-scope bytes, per-program static footprints, the
+    last reconcile) or from a bare telemetry snapshot (the
+    ``memory.scope.<name>.bytes`` gauges). Returns
+    ``(scope_rows, program_rows, reconcile_dict_or_None)`` where
+    scope_rows = [(scope, bytes, note)] largest first and program_rows =
+    [(label, origin, bytes, temp, code, args, out)]."""
+    mem = obj.get("memory") if isinstance(obj.get("memory"), dict) else None
+    scopes, programs, reconcile = {}, [], None
+    if mem:
+        scopes = {k: v for k, v in (mem.get("scopes") or {}).items()
+                  if isinstance(v, (int, float))}
+        programs = [p for p in (mem.get("programs") or [])
+                    if isinstance(p, dict)]
+        reconcile = mem.get("reconcile") or None
+    else:
+        tel = obj.get("telemetry") if isinstance(obj.get("telemetry"),
+                                                 dict) else obj
+        gauges = tel.get("gauges", {}) if isinstance(tel, dict) else {}
+        for name, g in gauges.items():
+            if (name.startswith("memory.scope.")
+                    and name.endswith(".bytes")):
+                scope = name[len("memory.scope."):-len(".bytes")]
+                val = g.get("value") if isinstance(g, dict) else g
+                if isinstance(val, (int, float)):
+                    scopes[scope] = val
+    scope_rows = []
+    for name, val in sorted(scopes.items(), key=lambda kv: -abs(kv[1])):
+        note = ""
+        if name in _OVERLAY_SCOPES:
+            note = "overlay (inside kv_pool)"
+        elif name == "unattributed":
+            note = "reconcile residual"
+        scope_rows.append((name, int(val), note))
+    program_rows = []
+    for p in programs:
+        program_rows.append((p.get("label", "?"),
+                             "cache" if p.get("cached") else "compile",
+                             int(p.get("bytes", 0)),
+                             int(p.get("temp_bytes", 0)),
+                             int(p.get("code_bytes", 0)),
+                             int(p.get("argument_bytes", 0)),
+                             int(p.get("output_bytes", 0))))
+    program_rows.sort(key=lambda r: -r[2])
+    return scope_rows, program_rows, reconcile
+
+
+def _print_mem(parsed, fmt):
+    scope_rows, program_rows, reconcile = parsed
+    if not scope_rows and not program_rows:
+        print("no memory-ledger data in this dump (ledger disabled, or "
+              "not a /snapshot payload)", file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| scope | bytes | size | note |")
+        print("| --- | --- | --- | --- |")
+        line = "| %s | %d | %s | %s |"
+    else:
+        print("scope,bytes,size,note")
+        line = "%s,%d,%s,%s"
+    for name, val, note in scope_rows:
+        print(line % (name, val, _mem_fmt_bytes(val), note))
+    if reconcile and fmt == "markdown":
+        print()
+        print("reconcile: device=%s scoped=%s residual=%s (source: %s, "
+              "%s device(s))"
+              % (_mem_fmt_bytes(reconcile.get("device_bytes", 0)),
+                 _mem_fmt_bytes(reconcile.get("scoped_bytes", 0)),
+                 _mem_fmt_bytes(reconcile.get("residual_bytes", 0)),
+                 reconcile.get("source", "?"),
+                 reconcile.get("device_count", "?")))
+    if not program_rows:
+        return
+    if fmt == "markdown":
+        print()
+        print("| program | origin | bytes | temp | code | args | out |")
+        print("| --- | --- | --- | --- | --- | --- | --- |")
+        pline = "| %s | %s | %s | %s | %s | %s | %s |"
+    else:
+        print("program,origin,bytes,temp,code,args,out")
+        pline = "%s,%s,%s,%s,%s,%s,%s"
+    for label, origin, total, temp, code, argb, outb in program_rows:
+        print(pline % (label, origin, _mem_fmt_bytes(total),
+                       _mem_fmt_bytes(temp), _mem_fmt_bytes(code),
+                       _mem_fmt_bytes(argb), _mem_fmt_bytes(outb)))
+
+
 def _load_json(path):
     try:
         with open(path) as f:
@@ -845,6 +947,12 @@ def main():
     parser.add_argument("--site", default=None,
                         help="with --overlap: only decompose step spans "
                              "with this name (e.g. serve.step)")
+    parser.add_argument("--mem", action="store_true",
+                        help="memory-ledger mode: per-scope HBM bytes, "
+                             "per-program static footprints (compile vs "
+                             "AOT-cache restore), and the device/scoped "
+                             "reconcile from a /snapshot payload or a "
+                             "telemetry JSON dump's memory.scope.* gauges")
     parser.add_argument("--anomalies", action="store_true",
                         help="anomaly mode: telemetry.anomaly.* counters + "
                              "step-time histograms from a telemetry JSON "
@@ -881,6 +989,11 @@ def main():
         if obj is None:
             sys.exit("--serve input is not a JSON object: %s" % args.logfile)
         _print_serve(parse_serve(obj), args.format)
+        return
+    if args.mem:
+        if obj is None:
+            sys.exit("--mem input is not a JSON object: %s" % args.logfile)
+        _print_mem(parse_mem(obj), args.format)
         return
     if args.flight:
         if obj is None:
