@@ -952,6 +952,109 @@ def bench_serve(on_accel):
     }
 
 
+def bench_sparse(on_accel):
+    """BENCH=sparse (ISSUE 17): embedding-gradient sync A/B — unique-rows
+    sparse comm vs the densified-allreduce baseline, on the SAME id
+    traffic. A vocab-sharded `ShardedEmbedding` trains through the
+    kvstore sparse push path (row dedup -> Pallas segment-sum ->
+    in-place row update) while the served lookup path answers
+    row_sparse_pulls from the warmed fixed-bucket gather.
+
+    Wire bytes are MODELED from the measured traffic (the single-process
+    smoke row has no wire; the models are the exact byte accounting the
+    dist store's `_sparse_sync` counters use): per step the batch's ids
+    split across `world` model ranks, then
+
+      sparse = slab x (4 + dim*4) x world      (padded all-gather slab,
+                                                slab = max rank nnz)
+      dense  = vocab x 4 + union x dim*4       (mask allreduce + dense
+                                                union allreduce — the
+                                                MXNET_TPU_SPARSE_DENSE_PUSH
+                                                leg)
+
+    so `comm_bytes_saved` is the per-run total the sparse path keeps off
+    the wire — strictly positive whenever the touched fraction is small
+    (the acceptance bar). value = pushed rows/s through the REAL sparse
+    path; vs_baseline = dense/sparse modeled byte ratio (>1 = sparse
+    wins). `lookup_ms_p50/p99` time the REAL served gather; the
+    segment-sum dispatch/fallback counters prove which kernel ran."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, telemetry
+    from mxnet_tpu.embedding import ShardedEmbedding
+    from mxnet_tpu.ndarray import sparse as sp
+
+    vocab, dim = (1_000_000, 64) if on_accel else (50_000, 32)
+    nnz, steps, world = (8192, 20, 4) if on_accel else (1024, 6, 4)
+    rng = np.random.RandomState(0)
+
+    table = ShardedEmbedding(vocab, dim, optimizer="sgd",
+                             learning_rate=0.1, name="bench.sparse")
+    kv = mx.kv.create("local")
+    svc = kv.init_embedding(0, table, max_batch=nnz)
+
+    # zipf-skewed traffic: the hot-row regime sparse comm exists for
+    raw = rng.zipf(1.3, size=(steps, nnz)).astype(np.int64) % vocab
+    batches = [np.unique(b).astype(np.int32) for b in raw]
+
+    telemetry.reset()
+    row_nb = dim * 4
+    sparse_bytes = dense_bytes = pushed = 0
+    union_rows = []
+    lookup_ms = []
+    t0 = time.perf_counter()
+    for ids in batches:
+        grads = rng.randn(len(ids), dim).astype(np.float32)
+        kv.push(0, sp.RowSparseNDArray(grads, sp.jnp.asarray(ids),
+                                       (vocab, dim)))
+        # model the wire for the same traffic spread over `world` ranks
+        per_rank = np.array_split(ids, world)
+        slab = max(len(r) for r in per_rank)
+        sparse_bytes += slab * (4 + row_nb) * world
+        dense_bytes += vocab * 4 + len(ids) * row_nb
+        union_rows.append(len(ids))
+        pushed += len(ids)
+        # served read-back of a hot subset through the compiled gather
+        hot = sp.jnp.asarray(ids[:min(256, len(ids))])
+        tmp = sp.zeros("row_sparse", (vocab, dim))
+        t1 = time.perf_counter()
+        kv.row_sparse_pull(0, out=tmp, row_ids=nd.array(hot))
+        _sync(tmp._values)
+        lookup_ms.append((time.perf_counter() - t1) * 1e3)
+    _sync(table.weight)
+    dt = time.perf_counter() - t0
+
+    lookup_ms.sort()
+    snap = telemetry.snapshot()["counters"]
+    pct = 100.0 * float(np.mean(union_rows)) / vocab
+    return {
+        "metric": ("sparse_embed_push_rows_per_sec" if on_accel
+                   else "sparse_embed_cpu_push_rows_per_sec"),
+        "value": round(pushed / dt, 2),
+        "unit": "rows/s",
+        "vs_baseline": round(dense_bytes / sparse_bytes, 4),
+        "vocab": vocab,
+        "dim": dim,
+        "world_model": world,
+        "sparse_rows_pct": round(pct, 4),
+        "comm_bytes_sparse": int(sparse_bytes),
+        "comm_bytes_dense": int(dense_bytes),
+        "comm_bytes_saved": int(dense_bytes - sparse_bytes),
+        "lookup_ms_p50": round(lookup_ms[len(lookup_ms) // 2], 3),
+        "lookup_ms_p99": round(
+            lookup_ms[min(len(lookup_ms) - 1,
+                          int(0.99 * len(lookup_ms)))], 3),
+        "segment_sum_pallas":
+            snap.get("ops.pallas.dispatch.segment_sum", 0),
+        "segment_sum_fallback": sum(
+            v for k, v in snap.items()
+            if k.startswith("ops.pallas.fallback.segment_sum.")),
+        "serve_retraces": snap.get("serve.retrace", 0),
+        "unique_rows": snap.get("embedding.push.unique_rows", 0),
+    }
+
+
 def bench_obs(on_accel):
     """BENCH=obs: observability-plane microbench. A small Gluon MLP trains
     under the live /metrics endpoint while the bench scrapes it, measuring
@@ -1400,6 +1503,12 @@ def main():
         return
     if which == "zero":
         _emit(bench_zero(on_accel))
+        return
+    if which == "sparse":
+        os.environ.setdefault("MXNET_TPU_USE_PALLAS", "1")
+        if not on_accel:
+            os.environ.setdefault("MXNET_FLASH_INTERPRET", "1")
+        _emit(bench_sparse(on_accel))
         return
     if which == "resilience":
         _emit(bench_resilience(on_accel))

@@ -1,9 +1,16 @@
-"""Factorization machine on libsvm data with rowsparse updates.
+"""Factorization machine on libsvm data over vocab-sharded embedding tables.
 
 reference: example/sparse/factorization_machine/ — CSR batches through
 LibSVMIter, autograd through the differentiable sparse dot, rowsparse
-gradients pushed to a kvstore with a server-side optimizer (only the rows
-each batch touched travel), lazy adagrad updates.
+gradients pushed to a kvstore (only the rows each batch touched travel).
+
+Upgraded to the mx.embedding sharded path (ISSUE 17): the FM's linear and
+factor tables live in `ShardedEmbedding` instances registered with the
+kvstore via `kv.init_embedding`. Pushing a RowSparseNDArray gradient
+dedups rows, runs the Pallas segment-sum scatter-add, and applies the
+optimizer in place beside the owned rows; `kv.row_sparse_pull` reads the
+touched rows back through the warmed `EmbeddingLookupService` — a
+compiled fixed-bucket gather, zero retraces after the first epoch.
 
   python examples/sparse_fm.py --epochs 10 --dim 100
 Uses a synthetic libsvm file unless --data points at a real one.
@@ -22,6 +29,7 @@ honor_jax_platforms_env()
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, nd
+from mxnet_tpu.embedding import ShardedEmbedding
 from mxnet_tpu.ndarray import sparse as sp
 
 
@@ -46,7 +54,8 @@ def main():
     p.add_argument("--factor-size", type=int, default=8)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--epochs", type=int, default=10)
-    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--optimizer", default="adam", choices=("sgd", "adam"))
     p.add_argument("--samples", type=int, default=2000)
     args = p.parse_args()
 
@@ -58,17 +67,26 @@ def main():
         print("synthetic libsvm:", path)
 
     dim, k, bs = args.dim, args.factor_size, args.batch_size
-    w = nd.array(np.zeros((dim, 1), np.float32))
-    v = nd.array((rng.randn(dim, k) * 0.05).astype(np.float32))
+
+    # sharded master tables: the linear weights (dim, 1) and the FM
+    # factors (dim, k). Optimizer state lives row-aligned beside the
+    # owned rows (ZeRO pattern); local dense replicas below only mirror
+    # the rows each batch touches.
+    table_w = ShardedEmbedding(dim, 1, optimizer=args.optimizer,
+                               learning_rate=args.lr, name="fm.linear")
+    table_v = ShardedEmbedding(dim, k, optimizer=args.optimizer,
+                               learning_rate=args.lr, seed=1,
+                               name="fm.factors")
+
+    kv = mx.kv.create("local")
+    kv.init_embedding(0, table_w, max_batch=dim)
+    kv.init_embedding(1, table_v, max_batch=dim)
+
+    w = nd.array(np.asarray(table_w.gathered_weight()))
+    v = nd.array(np.asarray(table_v.gathered_weight()))
     b = nd.array(np.zeros((1,), np.float32))
     for t in (w, v, b):
         t.attach_grad()
-
-    kv = mx.kv.create("local")
-    kv.init(0, w)
-    kv.init(1, v)
-    kv.set_optimizer(mx.optimizer.create(
-        "adagrad", learning_rate=args.lr, rescale_grad=1.0 / bs))
 
     def forward(csr, csr_sq):
         lin = sp.dot(csr, w)
@@ -94,12 +112,13 @@ def main():
             b -= args.lr * b.grad
             touched = np.unique(np.asarray(csr._sp_indices))
             rows = sp.jnp.asarray(touched.astype(np.int32))
-            kv.push(0, sp.RowSparseNDArray(w.grad._read()[rows] * bs,
+            scale = 1.0 / bs
+            kv.push(0, sp.RowSparseNDArray(w.grad._read()[rows] * scale,
                                            rows, w.shape))
-            kv.push(1, sp.RowSparseNDArray(v.grad._read()[rows] * bs,
+            kv.push(1, sp.RowSparseNDArray(v.grad._read()[rows] * scale,
                                            rows, v.shape))
-            # pull only touched rows back into the local dense replicas
-            # (reference: Parameter.row_sparse_data path)
+            # pull only touched rows back into the local dense replicas —
+            # a warmed compiled gather (reference: Parameter.row_sparse_data)
             for key, param in ((0, w), (1, v)):
                 tmp = sp.zeros("row_sparse", param.shape)
                 kv.row_sparse_pull(key, out=tmp, row_ids=nd.array(touched))
@@ -113,6 +132,12 @@ def main():
                             (y.asnumpy() > 0.5)).sum())
         print("epoch %2d  logloss %.4f  acc %.3f"
               % (epoch, total / count, correct / count))
+    snap = mx.telemetry.snapshot()["counters"]
+    print("sparse pushes %d  unique rows %d / %d  serve lookups %d"
+          % (snap.get("embedding.push", 0),
+             snap.get("embedding.push.unique_rows", 0),
+             snap.get("embedding.push.rows", 0),
+             snap.get("embedding.serve.lookup", 0)))
 
 
 if __name__ == "__main__":

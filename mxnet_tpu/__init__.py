@@ -58,6 +58,7 @@ from . import contrib
 from . import native
 from . import resilience
 from . import analysis
+from . import embedding
 from . import serve
 from . import compiler
 from . import numpy as np  # noqa: F401 — mx.np numpy-compat namespace

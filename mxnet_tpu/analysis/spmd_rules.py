@@ -37,6 +37,7 @@ _COLLECTIVE_NAMES = frozenset({
     "reduce_scatter", "ppermute", "pshuffle", "all_to_all",
     "all_reduce", "psum_bucketed", "all_reduce_multi", "barrier",
     "reduce_scatter_multi", "all_gather_multi",
+    "all_gather_rows", "psum_unique_rows",
 })
 
 # everything whose axis_name argument must resolve against a declared
@@ -51,6 +52,8 @@ _AXIS_ARG_POS = {
     "psum_bucketed": 1,
     "reduce_scatter_multi": 1,   # (xs, axis_name, ...)
     "all_gather_multi": 2,       # (shards, layout, axis_name)
+    "all_gather_rows": 2,        # (ids, vals, axis_name)
+    "psum_unique_rows": 2,       # (ids, vals, axis_name, pad_id=...)
 }
 _AXIS_KWARGS = ("axis_name", "axis")
 _DEFAULT_AXIS_POS = 1   # psum(x, axis_name), all_gather(x, axis_name), ...

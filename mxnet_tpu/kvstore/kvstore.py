@@ -182,6 +182,8 @@ class KVStoreLocal(KVStore):
         self._type = type_name
         self._store = {}          # key -> merged NDArray (master copy)
         self._updater = None
+        self._embeddings = {}     # key -> ShardedEmbedding (vocab-sharded)
+        self._embed_services = {}  # key -> EmbeddingLookupService
 
     @property
     def type(self):
@@ -198,9 +200,28 @@ class KVStoreLocal(KVStore):
                 raise ValueError("duplicate init of key " + str(k))
             self._store[str(k)] = v.copy()
 
+    def init_embedding(self, key, table, max_batch=1024, warmup=True):
+        """Register a vocab-sharded `embedding.ShardedEmbedding` under
+        `key`: pushes of `row_sparse` gradients route to the table's
+        owned-row update, and `row_sparse_pull` becomes a compiled
+        cross-shard gather through an `EmbeddingLookupService` (warmed
+        here, so steady pull traffic never compiles — the serve
+        contract)."""
+        from ..embedding.serving import EmbeddingLookupService
+        k = str(key)
+        if k in self._store or k in self._embeddings:
+            raise ValueError("duplicate init of key " + k)
+        self._embeddings[k] = table
+        svc = EmbeddingLookupService(table, max_batch=max_batch)
+        if warmup:
+            svc.warmup()
+        self._embed_services[k] = svc
+        return svc
+
     def _check_keys(self, keys):
         for k in keys:
-            if str(k) not in self._store:
+            if str(k) not in self._store and \
+                    str(k) not in self._embeddings:
                 raise MXNetError("key %s has not been initialized" % str(k))
 
     def _merge(self, vals):
@@ -244,6 +265,10 @@ class KVStoreLocal(KVStore):
         self._check_keys(keys)
         if _telem.ENABLED:
             _record_comm("push", values)
+        if self._embeddings:
+            keys, values = self._push_embeddings(keys, values)
+            if not keys:
+                return
         if self._maybe_push_zero(keys, values):
             return
         cap = _engine.bucket_bytes()
@@ -251,6 +276,10 @@ class KVStoreLocal(KVStore):
             entries = self._bucketable_entries(keys, values)
             if entries is not None:
                 self._push_bucketed(entries, cap)
+                return
+            sentries = self._sparse_entries(keys, values)
+            if sentries is not None:
+                self._push_sparse_bucketed(sentries, cap)
                 return
         inject = _faults.active_plan() is not None
         for k, v in zip(keys, values):
@@ -324,6 +353,126 @@ class KVStoreLocal(KVStore):
                 return None
             entries.append((str(k), vals))
         return entries
+
+    # -- sparse (row_sparse) bucketed path ------------------------------
+    def _sparse_entries(self, keys, values):
+        """[(str key, [RowSparseNDArray replicas])] when every key is
+        row_sparse and none is a registered embedding — the precondition
+        for the sparse bucketed path; None otherwise."""
+        from ..ndarray import sparse as _sp
+        entries = []
+        for k, v in zip(keys, values):
+            if str(k) in self._embeddings:
+                return None
+            vals = list(v) if isinstance(v, (list, tuple)) else [v]
+            if not vals or any(not isinstance(x, _sp.RowSparseNDArray)
+                               for x in vals):
+                return None
+            entries.append((str(k), vals))
+        return entries
+
+    def _sparse_sync(self, key, ids, vals, shape):
+        """Cross-worker completion of a locally-merged sparse push —
+        identity on the local store (one worker owns every replica). The
+        dist store overrides this with the unique-rows exchange. Returns
+        the (ids, vals) of the globally-merged rows."""
+        return ids, vals
+
+    def _apply_sparse(self, k, ids, vals, shape):
+        """Updater/store-write leg for one globally-merged sparse key."""
+        from ..ndarray import sparse as _sp
+        stored = self._store[k]
+        merged = _sp.RowSparseNDArray(vals, ids, shape, ctx=stored.context)
+        if self._updater is not None:
+            idx = int(k) if k.isdigit() else k
+            self._updater(idx, merged, stored)
+        else:
+            stored._write(merged.as_in_context(
+                stored.context)._read().astype(stored.dtype))
+
+    def _push_sparse_bucketed(self, entries, cap):
+        """Bucketed sparse push (ISSUE 17 tentpole part 3): per-key local
+        replica merge (dedup — the `merge_rows` canonicalization), then
+        size-capped `SparseGradBucketer` buckets launched as they fill,
+        each retried AS A UNIT in store-replace mode with the existing
+        `kvstore.push` fault sites firing per key. Bucket bytes count
+        TOUCHED rows, not table rows; `comm.sparse.*` counters feed
+        `parse_log --sparse` and `BENCH=sparse`."""
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        use_faults = _faults.active_plan() is not None
+        shapes = {}
+
+        def apply_bucket(bucket):
+            ts = _telem.span_clock()
+            t0 = time.perf_counter()
+            for k, ids, vals in zip(bucket.keys, bucket.ids, bucket.vals):
+                if use_faults:
+                    _faults.check(
+                        "kvstore.push",
+                        context="key=%s bucket=[%s] sparse"
+                        % (k, bucket.key_range()))
+                gids, gvals = self._sparse_sync(k, ids, vals, shapes[k])
+                self._apply_sparse(k, gids, gvals, shapes[k])
+            _telem.record_span(bucket.span_name(), _engine.SPAN_CAT_COMM,
+                               ts, time.perf_counter() - t0)
+
+        retriable = self._updater is None and use_faults
+
+        def dispatch(bucket):
+            if not retriable:
+                return apply_bucket(bucket)
+            call_with_retry(
+                apply_bucket, bucket, site="kvstore.push",
+                context="sparse bucket keys=[%s] %dB"
+                % (",".join(bucket.keys), bucket.nbytes))
+
+        bucketer = _engine.SparseGradBucketer(cap)
+        for k, vals in entries:
+            merged = self._merge(vals)
+            shapes[k] = merged.shape
+            if _telem.ENABLED:
+                _telem.inc("comm.sparse.push")
+                _telem.inc("comm.sparse.rows",
+                           sum(int(v._indices.shape[0]) for v in vals))
+                _telem.inc("comm.sparse.unique_rows",
+                           int(merged._indices.shape[0]))
+            for bucket in bucketer.add(k, merged._indices, merged._values):
+                dispatch(bucket)
+        tail = bucketer.flush()
+        if tail is not None:
+            dispatch(tail)
+
+    # -- sharded-embedding routing --------------------------------------
+    def _push_embeddings(self, keys, values):
+        """Apply pushes destined for registered sharded tables (row_sparse
+        grads -> `ShardedEmbedding.apply_grads` on the owned rows) and
+        return the remaining (keys, values) for the normal path."""
+        from ..ndarray import sparse as _sp
+        rest_k, rest_v = [], []
+        for k, v in zip(keys, values):
+            table = self._embeddings.get(str(k))
+            if table is None:
+                rest_k.append(k)
+                rest_v.append(v)
+                continue
+            vals = list(v) if isinstance(v, (list, tuple)) else [v]
+            if any(not isinstance(x, _sp.RowSparseNDArray) for x in vals):
+                raise MXNetError(
+                    "push to sharded embedding key %s requires row_sparse "
+                    "gradients" % k)
+            merged = self._merge(vals)
+            if _telem.ENABLED:
+                _telem.inc("comm.sparse.push")
+                _telem.inc("comm.sparse.rows",
+                           sum(int(x._indices.shape[0]) for x in vals))
+                _telem.inc("comm.sparse.unique_rows",
+                           int(merged._indices.shape[0]))
+            table.apply_grads(merged._indices, merged._values)
+            svc = self._embed_services.get(str(k))
+            if svc is not None:
+                svc.refresh()   # serve reads a consistent post-step snapshot
+        return rest_k, rest_v
 
     def _launch_bucket_merge(self, bucket, raw_slots, nrep):
         """ONE fused flatten->sum(replicas)->unflatten program for the
@@ -493,8 +642,25 @@ class KVStoreLocal(KVStore):
         self._check_keys(keys)
         from ..ndarray import sparse as _sp
         for k, o, r in zip(keys, outs, rids):
-            src = self._store[str(k)]
+            svc = self._embed_services.get(str(k))
             targets = o if isinstance(o, (list, tuple)) else [o]
+            if svc is not None:
+                # sharded table: the pull is a compiled cross-shard gather
+                # (fixed-bucket jit, warmed at init_embedding — steady
+                # traffic never compiles)
+                for t in targets:
+                    rows = r.data_jax.astype("int32") if isinstance(
+                        r, nd.NDArray) else _sp.jnp.asarray(r, dtype="int32")
+                    rows = _sp.jnp.unique(rows)
+                    if not isinstance(t, _sp.RowSparseNDArray):
+                        raise ValueError(
+                            "row_sparse_pull requires row_sparse outs "
+                            "(reference kvstore restriction); got stype %s"
+                            % t.stype)
+                    t._values = svc.lookup(rows).astype(t.dtype)
+                    t._indices = rows
+                continue
+            src = self._store[str(k)]
             for t in targets:
                 rows = r.data_jax.astype("int32") if isinstance(
                     r, nd.NDArray) else _sp.jnp.asarray(r, dtype="int32")

@@ -47,6 +47,10 @@ def _sum0(x):
     return jnp.sum(x, axis=0)
 
 
+def _max0(x):
+    return jnp.max(x, axis=0)
+
+
 def _concat0(x):
     # (world, S) worker-sharded -> (world*S,) replicated: XLA inserts the
     # all-gather (stable fn identity keeps the jit cache warm)
@@ -364,15 +368,9 @@ class KVStoreDist(KVStoreLocal):
         from ..ndarray import sparse as _sp
         context = "key=%s shard=%s" % (k, tuple(merged.shape))
         if isinstance(merged, _sp.RowSparseNDArray):
-            # union of touched rows across workers, dense over the union
-            local_rows = _np.zeros((merged.shape[0],), _np.bool_)
-            local_rows[_np.asarray(merged._indices)] = True
-            all_rows = _np.asarray(self._allreduce(
-                jnp.asarray(local_rows, jnp.int32), context=context)) > 0
-            rows = jnp.asarray(_np.nonzero(all_rows)[0].astype(_np.int32))
-            dense_rows = merged._read()[rows]
-            summed = self._allreduce(dense_rows, context=context)
-            merged = _sp.RowSparseNDArray(summed, rows, merged.shape,
+            ids, vals = self._sparse_sync(k, merged._indices,
+                                          merged._values, merged.shape)
+            merged = _sp.RowSparseNDArray(vals, ids, merged.shape,
                                           ctx=stored.context)
         else:
             raw = merged._read()
@@ -387,6 +385,124 @@ class KVStoreDist(KVStoreLocal):
         else:
             stored._write(merged.as_in_context(
                 stored.context)._read().astype(stored.dtype))
+
+    # -- sparse (row_sparse) cross-worker sync --------------------------
+    def _sparse_dense_push(self):
+        """The densified baseline (full-vocab mask allreduce + dense
+        allreduce over the union rows), kept behind
+        ``MXNET_TPU_SPARSE_DENSE_PUSH=1`` for A/B benchmarking — the
+        `BENCH=sparse` baseline leg."""
+        import os
+        return os.environ.get("MXNET_TPU_SPARSE_DENSE_PUSH", "0") == "1"
+
+    def _sparse_sync(self, key, ids, vals, shape):
+        """Cross-worker sum of a locally-merged row_sparse push as a
+        UNIQUE-ROWS exchange (overrides the local identity): one tiny
+        max-nnz allreduce sizes a fixed slab, every worker contributes its
+        (ids, rows) padded to the slab, and one in-trace
+        `psum_unique_rows` (allgather + stable-sort dedup riding the
+        sparse kernel) replaces the full-vocab mask allreduce + dense
+        union allreduce of the densified path. Bytes on the wire scale
+        with touched rows, not table rows — `comm.sparse.bytes` vs
+        `comm.sparse.bytes_dense_equiv` quantifies the win per push."""
+        from .. import telemetry as _telem
+        from ..ndarray import sparse as _sp
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        context = "key=%s rows=%d sparse" % (key, int(ids.shape[0]))
+        if self._sparse_dense_push():
+            # densified baseline: union of touched rows, dense over them
+            local_rows = _np.zeros((shape[0],), _np.bool_)
+            local_rows[_np.asarray(ids)] = True
+            all_rows = _np.asarray(self._allreduce(
+                jnp.asarray(local_rows, jnp.int32), context=context)) > 0
+            rows = jnp.asarray(_np.nonzero(all_rows)[0].astype(_np.int32))
+            dense = jnp.zeros((shape[0],) + tuple(vals.shape[1:]),
+                              vals.dtype).at[ids].set(vals)[rows]
+            summed = self._allreduce(dense, context=context)
+            if _telem.ENABLED:
+                row_nb = int(_np.prod(vals.shape[1:], dtype=_np.int64)
+                             ) * vals.dtype.itemsize
+                _telem.inc("comm.sparse.bytes",
+                           int(shape[0]) * 4 + int(rows.shape[0]) * row_nb)
+            return rows, summed
+        if dist.num_workers() == 1:
+            return ids, vals
+        nnz = int(ids.shape[0])
+        row_nb = int(_np.prod(vals.shape[1:], dtype=_np.int64)
+                     ) * vals.dtype.itemsize
+
+        def dispatch():
+            _faults.check("kvstore.push", context=context)
+            slab = int(_np.asarray(self._cross_worker(
+                jnp.asarray([nnz], jnp.int32), _max0))[0])
+            pad = slab - nnz
+            ids_p = jnp.pad(jnp.asarray(ids).astype(jnp.int32), (0, pad),
+                            constant_values=-1)
+            vals_p = jnp.pad(jnp.asarray(vals),
+                             ((0, pad),) + ((0, 0),) * (vals.ndim - 1))
+            return slab, self._cross_worker_unique_rows(ids_p, vals_p)
+
+        _telem.inc("comm.collectives")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        slab, (gids, gvals) = call_with_retry(dispatch, site="kvstore.push",
+                                              context=context)
+        _telem.record_span(_engine.comm_span_name(key, "sparse"),
+                           _engine.SPAN_CAT_COMM, ts,
+                           time.perf_counter() - t0)
+        gids_np = _np.asarray(gids)
+        n_union = int((gids_np >= 0).sum())
+        if _telem.ENABLED:
+            _telem.inc("comm.sparse.sync")
+            _telem.inc("comm.sparse.bytes",
+                       slab * (4 + row_nb) * dist.num_workers())
+            _telem.inc("comm.sparse.bytes_dense_equiv",
+                       int(shape[0]) * 4 + n_union * row_nb)
+        rows = jnp.asarray(gids_np[:n_union])
+        return rows, gvals[:n_union]
+
+    def _cross_worker_unique_rows(self, ids_p, vals_p):
+        """ONE on-device program over the worker mesh: shard_map'd
+        `psum_unique_rows` (unique-rows allgather + in-trace dedup),
+        replicated result — the sparse analog of `_cross_worker`'s
+        allreduce placement."""
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.collectives import psum_unique_rows
+        mesh = self._worker_mesh()
+        n = dist.num_workers()
+        key = ("rows", tuple(ids_p.shape), tuple(vals_p.shape),
+               str(vals_p.dtype))
+        fn = self._zero_fns.get(key)
+        if fn is None:
+            # check_rep off: the dedup's sort/scatter obscures the (true)
+            # replication of the allgathered slabs from the static checker
+            try:
+                sm = shard_map(
+                    lambda i, v: psum_unique_rows(i[0], v[0], "worker"),
+                    mesh=mesh, in_specs=(P("worker"), P("worker")),
+                    out_specs=(P(), P()), check_rep=False)
+            except TypeError:  # pragma: no cover - jax >= 0.8 renamed it
+                sm = shard_map(
+                    lambda i, v: psum_unique_rows(i[0], v[0], "worker"),
+                    mesh=mesh, in_specs=(P("worker"), P("worker")),
+                    out_specs=(P(), P()), check_vma=False)
+            fn = jax.jit(sm)
+            self._zero_fns[key] = fn
+        dev = mesh.devices.ravel()[dist.rank()]
+        gids = jax.make_array_from_single_device_arrays(
+            (n,) + tuple(ids_p.shape), NamedSharding(mesh, P("worker")),
+            [jax.device_put(ids_p[None], dev)])
+        gvals = jax.make_array_from_single_device_arrays(
+            (n,) + tuple(vals_p.shape), NamedSharding(mesh, P("worker")),
+            [jax.device_put(vals_p[None], dev)])
+        out_ids, out_vals = fn(gids, gvals)
+        return (jnp.asarray(out_ids.addressable_data(0)),
+                jnp.asarray(out_vals.addressable_data(0)))
 
     def _push_bucketed_compressed(self, entries):
         """2-bit gradient compression at bucket granularity (the carried

@@ -11,17 +11,20 @@ attention, fused train step).
 from . import llama
 from . import bert
 from . import resnet
+from . import dlrm
 from .llama import (LlamaConfig, llama_init, llama_forward, llama_loss,
                     llama_prefill_paged, llama_decode_paged,
                     llama_chunk_paged, llama_draft_loop, init_kv_pools)
 from .bert import BertConfig, bert_init, bert_forward, bert_mlm_loss
 from .resnet import ResNetConfig, resnet_init, resnet_forward, resnet_loss
+from .dlrm import DLRMConfig, dlrm_init, dlrm_forward, dlrm_loss
 
 __all__ = [
-    "llama", "bert", "resnet",
+    "llama", "bert", "resnet", "dlrm",
     "LlamaConfig", "llama_init", "llama_forward", "llama_loss",
     "llama_prefill_paged", "llama_decode_paged", "llama_chunk_paged",
     "llama_draft_loop", "init_kv_pools",
     "BertConfig", "bert_init", "bert_forward", "bert_mlm_loss",
     "ResNetConfig", "resnet_init", "resnet_forward", "resnet_loss",
+    "DLRMConfig", "dlrm_init", "dlrm_forward", "dlrm_loss",
 ]

@@ -1,0 +1,125 @@
+"""DLRM-style recsys model: two towers + factorization-machine interaction.
+
+The embedding-heavy workload class (PAPER.md §"Sparse DP") as a functional
+JAX model in the house style (`LlamaConfig`/`llama_init`/`llama_forward`):
+
+* a **dense tower** (bottom MLP) embeds the continuous features into the
+  same space as the sparse embeddings;
+* each **sparse field** looks up one row per example from its embedding
+  table — by default a dense gather from the param tree, but `embed_fn`
+  injects any other lookup (a vocab-sharded `embedding.ShardedEmbedding`,
+  a kvstore-served `row_sparse_pull`) without touching the model;
+* the **FM interaction** takes all pairwise dot products between the
+  per-field embeddings and the dense tower's output (the DLRM "dot"
+  interaction — a factorization machine over the field embeddings);
+* the **top MLP** maps [dense tower output ‖ pairwise terms] to one
+  logit; `dlrm_loss` is the sigmoid log-loss.
+
+The split matters for ISSUE 17: `dlrm_forward(..., embed_fn=...)` is the
+seam the serving path uses — the scheduler batch calls the compiled
+cross-shard gather for rows and this pure function for the rest.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DLRMConfig", "dlrm_init", "dlrm_forward", "dlrm_loss"]
+
+
+@dataclass(frozen=True)
+class DLRMConfig:
+    vocab_sizes: tuple = (100, 100, 100, 100)  # rows per sparse field
+    embed_dim: int = 16
+    dense_dim: int = 13
+    bottom_dims: tuple = (64, 32)    # hidden widths; output is embed_dim
+    top_dims: tuple = (64, 32)       # hidden widths; output is 1 logit
+
+    @property
+    def n_fields(self):
+        return len(self.vocab_sizes)
+
+
+def _mlp_init(key, dims):
+    params = []
+    for i in range(len(dims) - 1):
+        key, k1 = jax.random.split(key)
+        scale = 1.0 / jnp.sqrt(dims[i])
+        params.append({
+            "w": jax.random.normal(k1, (dims[i], dims[i + 1]),
+                                   jnp.float32) * scale,
+            "b": jnp.zeros((dims[i + 1],), jnp.float32),
+        })
+    return params
+
+
+def _mlp_forward(layers, x, final_relu=True):
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if final_relu or i + 1 < len(layers):
+            x = jax.nn.relu(x)
+    return x
+
+
+def dlrm_init(cfg, key=None, with_tables=True):
+    """Param tree. `with_tables=False` leaves the embedding tables out —
+    the sharded-table path owns them (ZeRO rows) and injects lookups via
+    `embed_fn`."""
+    key = jax.random.PRNGKey(0) if key is None else key
+    key, kb, kt = jax.random.split(key, 3)
+    params = {
+        "bottom": _mlp_init(
+            kb, (cfg.dense_dim,) + tuple(cfg.bottom_dims) + (cfg.embed_dim,)),
+        "top": _mlp_init(
+            kt,
+            (_interaction_dim(cfg),) + tuple(cfg.top_dims) + (1,)),
+    }
+    if with_tables:
+        tables = []
+        for v in cfg.vocab_sizes:
+            key, k1 = jax.random.split(key)
+            tables.append(jax.random.normal(k1, (v, cfg.embed_dim),
+                                            jnp.float32)
+                          / jnp.sqrt(cfg.embed_dim))
+        params["tables"] = tables
+    return params
+
+
+def _interaction_dim(cfg):
+    # dense-tower vector + upper-triangle pairwise dots over
+    # (n_fields sparse + 1 dense) vectors
+    n = cfg.n_fields + 1
+    return cfg.embed_dim + n * (n - 1) // 2
+
+
+def dlrm_forward(params, dense, sparse_ids, cfg, embed_fn=None):
+    """Logits for a batch. `dense` is (batch, dense_dim) float,
+    `sparse_ids` is (batch, n_fields) int32. `embed_fn(field, ids)`
+    overrides the param-tree gather (sharded/served lookups)."""
+    if embed_fn is None:
+        tables = params["tables"]
+
+        def embed_fn(f, ids):
+            return tables[f][ids]
+
+    bottom = _mlp_forward(params["bottom"], dense)        # (b, d)
+    vecs = [bottom] + [
+        jnp.asarray(embed_fn(f, sparse_ids[:, f]))
+        for f in range(cfg.n_fields)]                      # each (b, d)
+    stack = jnp.stack(vecs, axis=1)                        # (b, n, d)
+    gram = jnp.einsum("bnd,bmd->bnm", stack, stack)        # (b, n, n)
+    n = stack.shape[1]
+    iu, ju = jnp.triu_indices(n, k=1)
+    pairs = gram[:, iu, ju]                                # (b, n(n-1)/2)
+    z = jnp.concatenate([bottom, pairs], axis=1)
+    return _mlp_forward(params["top"], z, final_relu=False)[:, 0]
+
+
+def dlrm_loss(params, dense, sparse_ids, labels, cfg, embed_fn=None):
+    """Mean sigmoid log-loss over {0,1} labels."""
+    logits = dlrm_forward(params, dense, sparse_ids, cfg, embed_fn=embed_fn)
+    labels = jnp.asarray(labels, jnp.float32)
+    return jnp.mean(
+        jax.nn.softplus(logits) - labels * logits)

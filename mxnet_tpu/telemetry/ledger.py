@@ -62,7 +62,7 @@ __all__ = ["account", "adjust", "scopes", "programs", "note_program",
 # the canonical scope names (account() accepts others — a future subsystem
 # should not need a ledger edit to be accountable)
 SCOPES = ("params", "optimizer", "grad_buckets", "kv_pool", "kv_draft",
-          "prefix_cache", "programs", "unattributed")
+          "prefix_cache", "embedding", "programs", "unattributed")
 
 # overlay scopes annotate bytes that ALREADY belong to another scope's
 # allocation (prefix-cache blocks live inside kv_pool storage); they are

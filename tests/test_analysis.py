@@ -1048,6 +1048,46 @@ def test_tpu008_zero_collectives_axis_binding_checked():
     assert "worker" in hits[0].message
 
 
+def test_tpu008_knows_sparse_row_collectives():
+    """ISSUE 17 satellite: the unique-rows sparse collectives
+    (`all_gather_rows` / `psum_unique_rows`) rendezvous like psum —
+    divergent-branch placement flags, and the axis argument (positional
+    slot 2, after the ids/vals slabs) is checked against the declared
+    axes."""
+    f = lint("""
+    import jax
+    from mxnet_tpu.parallel.collectives import (all_gather_rows,
+                                                psum_unique_rows)
+    @jax.jit
+    def step(ids, vals):
+        if vals.sum() > 0:
+            ids, vals = psum_unique_rows(ids, vals, "data")
+        return all_gather_rows(ids, vals, "data")
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 1
+    assert "deadlock" in hits[0].message
+
+
+def test_tpu008_sparse_row_collectives_axis_binding_checked():
+    f = lint("""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.collectives import (all_gather_rows,
+                                                psum_unique_rows)
+    mesh = Mesh(None, ("data",))
+    @jax.jit
+    def gather(ids, vals):
+        return all_gather_rows(ids, vals, "rows")
+    @jax.jit
+    def merge(ids, vals):
+        return psum_unique_rows(ids, vals, "rows", pad_id=-1)
+    """)
+    hits = only(f, "TPU008")
+    assert len(hits) == 2
+    assert all("'rows'" in h.message for h in hits)
+
+
 def test_tpu008_passes_cond_with_collective_free_branches():
     f = lint("""
     import jax

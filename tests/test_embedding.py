@@ -1,0 +1,407 @@
+"""mx.embedding tests (ISSUE 17).
+
+Coverage per the issue: bit-exact sharded-vs-dense lookup/grad/update
+parity on a FakeFleet at world 2 and 4 with a non-divisible vocab (the
+padded tail rows), kernel-vs-XLA scatter-add bit parity through the
+Pallas interpreter, elastic world-4 -> world-2 checkpoint restore,
+fault-injected per-bucket retry on the sparse bucketed push, and the
+serve contract on the kvstore lookup path (zero post-warm-up retraces).
+
+The fleet fake mirrors `test_zero.FakeFleet` — a barrier'd mailbox that
+sums/concats contributions in rank order, so fp32 runs stay bit-exact
+against a dense reference that accumulates in the same order.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd, telemetry
+from mxnet_tpu.embedding import ShardedEmbedding
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.parallel.collectives import merge_unique_rows
+
+
+def _counters():
+    return dict(telemetry.snapshot()["counters"])
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+# ===========================================================================
+# injectable single-process fleet: the embedding comm contract is the
+# simple one (`all_reduce(x)` dense sum, `all_gather(x)` rank-order
+# axis-0 concat) — each simulated rank drives its ShardedEmbedding on its
+# own thread through a barrier'd mailbox
+# ===========================================================================
+class FakeFleet:
+    def __init__(self, world):
+        self.world = world
+        self.lock = threading.Lock()
+        self.barrier = threading.Barrier(world)
+        self.box = {}
+
+    def comm(self, rank):
+        return _FleetComm(self, rank)
+
+
+class _FleetComm:
+    def __init__(self, fleet, rank):
+        self._fleet = fleet
+        self.rank = rank
+        self.world = fleet.world
+        self._calls = 0
+
+    def _exchange(self, value):
+        # collective calls happen in lockstep on every rank, so the local
+        # call index is a globally-consistent tag
+        fleet = self._fleet
+        tag = self._calls
+        self._calls += 1
+        with fleet.lock:
+            fleet.box.setdefault(tag, {})[self.rank] = np.asarray(value)
+        fleet.barrier.wait()
+        with fleet.lock:
+            parts = [fleet.box[tag][r] for r in range(self.world)]
+        fleet.barrier.wait()
+        return parts
+
+    def all_reduce(self, x):
+        parts = self._exchange(x)
+        total = parts[0].copy()
+        for p in parts[1:]:
+            total = total + p   # rank order, matching the dense baseline
+        return jnp.asarray(total)
+
+    def all_gather(self, x):
+        return jnp.asarray(np.concatenate(self._exchange(x), axis=0))
+
+
+def _run_fleet(world, fn):
+    """Run fn(rank, comm) on `world` threads; re-raise the first error."""
+    fleet = FakeFleet(world)
+    errs = [None] * world
+
+    def wrap(rank):
+        try:
+            fn(rank, fleet.comm(rank))
+        except BaseException as e:  # noqa: BLE001 - test harness
+            errs[rank] = e
+            fleet.barrier.abort()
+
+    threads = [threading.Thread(target=wrap, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errs:
+        if e is not None:
+            raise e
+
+
+# a vocab no tested world size divides: both world 2 and 4 pad to 12 rows
+VOCAB, DIM = 11, 4
+
+
+def _batches(world, steps, batch=6, seed=0):
+    """[(step, rank) -> (ids, grads)] with repeated ids across and within
+    ranks, so dedup paths actually merge."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        per_rank = []
+        for _r in range(world):
+            ids = rng.randint(0, VOCAB, size=batch).astype(np.int32)
+            grads = rng.randn(batch, DIM).astype(np.float32)
+            per_rank.append((ids, grads))
+        out.append(per_rank)
+    return out
+
+
+def _dense_step(dense, per_rank):
+    """Apply one global step to the world-1 reference table through the
+    SAME merge sequence the sharded path sees: per-rank local dedup, then
+    the rank-order concat slab (the fleet all_gather), re-merged inside
+    apply_grads."""
+    slabs = [merge_unique_rows(jnp.asarray(ids), jnp.asarray(grads))
+             for ids, grads in per_rank]
+    cat_ids = jnp.concatenate([s[0] for s in slabs])
+    cat_vals = jnp.concatenate([s[1] for s in slabs])
+    dense.apply_grads(cat_ids, cat_vals)
+
+
+_OPTS = {
+    "sgd": dict(optimizer="sgd", learning_rate=0.1, momentum=0.9, wd=0.01),
+    "adam": dict(optimizer="adam", learning_rate=0.05),
+}
+
+
+# ===========================================================================
+# sharded vs dense bit-exact parity (lookup + grad + update)
+# ===========================================================================
+@pytest.mark.parametrize("world", [2, 4])
+@pytest.mark.parametrize("opt", sorted(_OPTS))
+def test_sharded_matches_dense_reference_bit_exact(world, opt):
+    steps = _batches(world, steps=3, seed=17 + world)
+    probe = jnp.asarray([3, 7, 3, -1, 10, 0], jnp.int32)
+
+    dense = ShardedEmbedding(VOCAB, DIM, seed=0, **_OPTS[opt])
+    for per_rank in steps:
+        _dense_step(dense, per_rank)
+    want_w = np.asarray(dense.gathered_weight())
+    want_rows = np.asarray(dense.lookup(probe))
+
+    gathered = [None] * world
+    looked = [None] * world
+
+    def run(rank, comm):
+        table = ShardedEmbedding(VOCAB, DIM, comm=comm, seed=0, **_OPTS[opt])
+        for per_rank in steps:
+            ids, grads = per_rank[rank]
+            table.apply_grads(ids, grads)
+        gathered[rank] = np.asarray(table.gathered_weight())
+        looked[rank] = np.asarray(table.lookup(probe))
+
+    _run_fleet(world, run)
+    for rank in range(world):
+        np.testing.assert_array_equal(gathered[rank], want_w)
+        np.testing.assert_array_equal(looked[rank], want_rows)
+    # the -1 probe slot is padding: exactly zero rows back
+    assert not looked[0][3].any()
+
+
+def test_lookup_masks_padded_tail_rows():
+    # padded vocab is 12 at world 4; ids never reach the pad rows, and a
+    # full-vocab lookup round-trips the init bytes exactly
+    table = ShardedEmbedding(VOCAB, DIM, seed=2)
+    out = [None]
+
+    def run(rank, comm):
+        t = ShardedEmbedding(VOCAB, DIM, comm=comm, seed=2)
+        if rank == 0:
+            out[0] = np.asarray(t.lookup(jnp.arange(VOCAB)))
+        else:
+            t.lookup(jnp.arange(VOCAB))
+
+    _run_fleet(4, run)
+    np.testing.assert_array_equal(out[0], np.asarray(table.gathered_weight()))
+
+
+# ===========================================================================
+# Pallas segment-sum: kernel vs XLA bit parity (interpreter on CPU)
+# ===========================================================================
+@pytest.mark.pallas
+def test_segment_sum_kernel_bit_identical_to_xla():
+    from mxnet_tpu.ops import sparse_ops
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 24, size=50), jnp.int32)
+    vals = jnp.asarray(rng.randn(50, 16), jnp.float32)
+    before = _counters()
+    out = sparse_ops.segment_sum(vals, ids, 24)
+    after = _counters()
+    ref = jnp.zeros((24, 16), jnp.float32).at[ids].add(vals)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert _delta(before, after, "ops.pallas.dispatch.segment_sum") == 1
+
+
+@pytest.mark.pallas
+def test_segment_sum_kernel_drops_negative_ids():
+    from mxnet_tpu.ops import sparse_ops
+    ids = jnp.asarray([-1, 3, -1, 3, 0], jnp.int32)
+    vals = jnp.ones((5, 4), jnp.float32)
+    out = sparse_ops.segment_sum(vals, ids, 6)
+    expect = np.zeros((6, 4), np.float32)
+    expect[3] = 2.0
+    expect[0] = 1.0
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+@pytest.mark.pallas
+def test_segment_sum_vmem_gate_falls_back_counted():
+    # a destination slab past the VMEM budget routes to XLA and is
+    # counted — never an error
+    from mxnet_tpu.ops import sparse_ops
+    ids = jnp.asarray([0, 1, 2, 3], jnp.int32)
+    vals = jnp.ones((4, 1), jnp.float32)
+    before = _counters()
+    out = sparse_ops.segment_sum(vals, ids, 20000)
+    after = _counters()
+    assert _delta(before, after,
+                  "ops.pallas.fallback.segment_sum.vmem") == 1
+    assert _delta(before, after, "ops.pallas.dispatch.segment_sum") == 0
+    assert float(np.asarray(out).sum()) == 4.0
+
+
+@pytest.mark.pallas
+def test_segment_sum_dtype_gate_falls_back_counted():
+    from mxnet_tpu.ops import sparse_ops
+    ids = jnp.asarray([0, 1], jnp.int32)
+    vals = jnp.ones((2, 4), jnp.int32)   # integer grads: XLA path
+    before = _counters()
+    out = sparse_ops.segment_sum(vals, ids, 4)
+    after = _counters()
+    assert _delta(before, after,
+                  "ops.pallas.fallback.segment_sum.dtype") == 1
+    assert int(np.asarray(out).sum()) == 8
+
+
+def test_merge_unique_rows_dedups_and_pads():
+    ids = jnp.asarray([5, 2, 5, -1, 2, 9], jnp.int32)
+    vals = jnp.arange(24, dtype=jnp.float32).reshape(6, 4)
+    uids, uvals = merge_unique_rows(ids, vals)
+    assert uids.shape == ids.shape and uvals.shape == vals.shape
+    np.testing.assert_array_equal(np.asarray(uids),
+                                  [2, 5, 9, -1, -1, -1])
+    want = np.asarray(vals)
+    np.testing.assert_array_equal(np.asarray(uvals[0]), want[1] + want[4])
+    np.testing.assert_array_equal(np.asarray(uvals[1]), want[0] + want[2])
+    np.testing.assert_array_equal(np.asarray(uvals[2]), want[5])
+    assert not np.asarray(uvals[3:]).any()
+
+
+# ===========================================================================
+# elastic checkpoints: world 4 -> world 2
+# ===========================================================================
+def test_elastic_checkpoint_world4_restores_onto_world2():
+    steps4 = _batches(4, steps=2, seed=31)
+    payloads = [None] * 4
+
+    def train4(rank, comm):
+        t = ShardedEmbedding(VOCAB, DIM, comm=comm, seed=0, **_OPTS["adam"])
+        for per_rank in steps4:
+            t.apply_grads(*per_rank[rank])
+        payloads[rank] = t.state_payload()   # collective: lockstep on all
+
+    _run_fleet(4, train4)
+    payload = payloads[0]
+    assert payload["layout"]["world"] == 4
+    assert payload["step"] == 2
+    assert set(payload["state"]) == {"mean", "var"}
+
+    # reference: a world-1 table restored from the same payload, stepped
+    # once with the world-2 merge structure
+    steps2 = _batches(2, steps=1, seed=77)
+    dense = ShardedEmbedding(VOCAB, DIM, seed=9, **_OPTS["adam"])
+    dense.load_state_payload(payload)
+    _dense_step(dense, steps2[0])
+    want = np.asarray(dense.gathered_weight())
+
+    gathered = [None] * 2
+
+    def resume2(rank, comm):
+        # seed differs on purpose: the payload must fully overwrite
+        t = ShardedEmbedding(VOCAB, DIM, comm=comm, seed=9, **_OPTS["adam"])
+        t.load_state_payload(payload)
+        t.apply_grads(*steps2[0][rank])
+        gathered[rank] = np.asarray(t.gathered_weight())
+
+    _run_fleet(2, resume2)
+    np.testing.assert_array_equal(gathered[0], want)
+    np.testing.assert_array_equal(gathered[1], want)
+
+
+def test_checkpoint_payload_geometry_is_validated():
+    table = ShardedEmbedding(VOCAB, DIM, seed=0)
+    payload = table.state_payload()
+    other = ShardedEmbedding(VOCAB + 1, DIM, seed=0)
+    with pytest.raises(ValueError):
+        other.load_state_payload(payload)
+    with pytest.raises(ValueError):
+        table.load_state_payload({"embed_format": 0})
+
+
+# ===========================================================================
+# sparse bucketed push: per-bucket retry under fault injection
+# ===========================================================================
+def test_sparse_bucketed_push_retries_per_bucket():
+    from mxnet_tpu.resilience import faults
+    vocab = 20
+    with engine.bucket_mb_scope(25):
+        kv = mx.kv.create("local")
+        keys = list(range(3))
+        for k in keys:
+            kv.init(k, nd.zeros((vocab, DIM)))
+        vals = []
+        for k in keys:
+            rows = jnp.asarray([1, 4, 7 + k], jnp.int32)
+            vals.append(sparse.RowSparseNDArray(
+                jnp.full((3, DIM), float(k + 1), jnp.float32),
+                rows, (vocab, DIM)))
+        before = _counters()
+        with faults.inject("kvstore.push:error:1"):
+            kv.push(keys, vals)
+        after = _counters()
+        assert _delta(before, after,
+                      "resilience.retries.kvstore.push") >= 1
+        assert _delta(before, after, "comm.sparse.push") == 3
+        assert _delta(before, after, "comm.sparse.bucket.count") >= 1
+        # the retry replayed the bucket: every key holds its push
+        for k in keys:
+            out = nd.zeros((vocab, DIM))
+            kv.pull(k, out=out)
+            expect = np.zeros((vocab, DIM), np.float32)
+            expect[[1, 4, 7 + k]] = float(k + 1)
+            np.testing.assert_array_equal(out.asnumpy(), expect)
+
+
+# ===========================================================================
+# kvstore-served lookups: the serve no-retrace contract
+# ===========================================================================
+def test_row_sparse_pull_zero_retraces_after_warmup():
+    kv = mx.kv.create("local")
+    table = ShardedEmbedding(37, 8, seed=3)
+    svc = kv.init_embedding("emb", table, max_batch=64)
+    full = np.asarray(table.gathered_weight())
+    before = _counters()
+    for n in (3, 17, 64, 5, 17):
+        rows = np.sort(np.random.RandomState(n).choice(
+            37, size=min(n, 37), replace=False)).astype(np.int32)
+        out = sparse.RowSparseNDArray(
+            jnp.zeros((len(rows), 8), jnp.float32),
+            jnp.asarray(rows), (37, 8))
+        kv.row_sparse_pull("emb", out=out, row_ids=jnp.asarray(rows))
+        np.testing.assert_array_equal(np.asarray(out._values), full[rows])
+    after = _counters()
+    assert _delta(before, after, "serve.retrace") == 0
+    assert _delta(before, after, "embedding.serve.lookup") == 5
+    # ...and an UN-warmed bucket after warm-up IS a retrace
+    svc._fns.pop(32)
+    svc.lookup(jnp.arange(20, dtype=jnp.int32))
+    final = _counters()
+    assert _delta(after, final, "serve.retrace") == 1
+
+
+def test_embedding_push_updates_table_and_serving_snapshot():
+    kv = mx.kv.create("local")
+    table = ShardedEmbedding(19, DIM, optimizer="sgd", learning_rate=1.0,
+                             seed=5)
+    kv.init_embedding(7, table, max_batch=16)
+    w0 = np.asarray(table.gathered_weight()).copy()
+    rows = jnp.asarray([2, 5, 11], jnp.int32)
+    kv.push(7, sparse.RowSparseNDArray(
+        jnp.ones((3, DIM), jnp.float32), rows, (19, DIM)))
+    w1 = np.asarray(table.gathered_weight())
+    expect = w0.copy()
+    expect[[2, 5, 11]] -= 1.0   # lr=1.0 sgd: exact fp32 subtraction
+    np.testing.assert_array_equal(w1, expect)
+    untouched = np.delete(np.arange(19), [2, 5, 11])
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    # the serve snapshot refreshed with the push
+    out = sparse.RowSparseNDArray(jnp.zeros((3, DIM), jnp.float32),
+                                  rows, (19, DIM))
+    kv.row_sparse_pull(7, out=out, row_ids=rows)
+    np.testing.assert_array_equal(np.asarray(out._values), w1[[2, 5, 11]])
+
+
+def test_table_bytes_land_in_embedding_ledger_scope():
+    from mxnet_tpu.telemetry import ledger
+    table = ShardedEmbedding(64, 8, optimizer="adam", seed=1)
+    # weight + mean + var for at least this table
+    assert ledger.scopes().get("embedding", 0) >= \
+        3 * table.weight.size * 4

@@ -48,11 +48,30 @@ _HIGHER_BETTER = ("per_sec", "per_s", "_tok_s", "_img_s", "_qps",
 _LOWER_BETTER = ("_ms", "_us", "_ns", "_s", "latency", "overhead_pct",
                  "_bytes")
 
+# names the suffix heuristics get WRONG or can't classify, pinned by
+# longest-prefix match (BENCH=sparse, ISSUE 17): `comm_bytes_saved` is a
+# savings (higher better, despite "bytes" in the name); the lookup
+# latency percentiles end in _p50/_p99, not a latency suffix;
+# `sparse_rows_pct` is a traffic property, not a perf axis — movement
+# either way means the workload changed, so keep the symmetric band.
+_DIRECTION_OVERRIDES = {
+    "comm_bytes_saved": "up",
+    "sparse_rows_pct": "both",
+    "lookup_ms_p50": "down",
+    "lookup_ms_p99": "down",
+}
+
 
 def direction_for(metric):
     """'up' (higher better), 'down' (lower better), or 'both' (unknown —
     regress on movement past the band in either direction)."""
     name = metric.lower()
+    best, best_len = None, -1
+    for prefix, d in _DIRECTION_OVERRIDES.items():
+        if name.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = d, len(prefix)
+    if best is not None:
+        return best
     for suf in _HIGHER_BETTER:
         if name.endswith(suf) or suf in name.split(".")[-1]:
             return "up"

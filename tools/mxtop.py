@@ -180,6 +180,53 @@ def render(payload, prev_payload=None, dt=None, source=""):
     lines.append("  " + ("  ".join(row) if row else DIM + "(none)" + RESET))
     lines.append("")
 
+    # --- sparse embeddings ---------------------------------------------
+    if any(n.startswith(("embedding.", "comm.sparse.")) for n in counters):
+        lines.append(BOLD + "sparse embeddings" + RESET)
+        pushed = counters.get("comm.sparse.rows",
+                              counters.get("embedding.push.rows", 0))
+        unique = counters.get("comm.sparse.unique_rows",
+                              counters.get("embedding.push.unique_rows", 0))
+        row = ["pushes=%d" % counters.get("embedding.push", 0)]
+        if pushed:
+            row.append("unique_rows=%d/%d (%.0f%%)"
+                       % (unique, pushed, 100.0 * unique / pushed))
+        disp = counters.get("ops.pallas.dispatch.segment_sum", 0)
+        fall = sum(v for n, v in counters.items()
+                   if n.startswith("ops.pallas.fallback.segment_sum."))
+        if disp or fall:
+            row.append("segsum=%d pallas/%d xla" % (disp, fall))
+        sp_bytes = counters.get("comm.sparse.bytes")
+        if sp_bytes is not None:
+            rate = _rate(counters, prev, "comm.sparse.bytes", dt or 0)
+            row.append("wire=%s%s"
+                       % (_fmt_bytes(sp_bytes),
+                          (" (%s/s)" % _fmt_bytes(rate))
+                          if rate is not None else ""))
+        dense_eq = counters.get("comm.sparse.bytes_dense_equiv")
+        if dense_eq:
+            row.append("saved=%s" % _fmt_bytes(dense_eq - (sp_bytes or 0)))
+        lines.append("  " + "  ".join(row))
+        row2 = []
+        lookups = counters.get("embedding.serve.lookup")
+        if lookups:
+            look_rate = _rate(counters, prev, "embedding.serve.lookup",
+                              dt or 0)
+            row2.append("serve_lookups=%d%s"
+                        % (lookups, (" (%.1f/s)" % look_rate)
+                           if look_rate is not None else ""))
+            h = snap.get("histograms", {}).get("embedding.serve.lookup_ms")
+            if h:
+                row2.append("lookup_ms p50/p99=%s/%s"
+                            % (_fmt_num(_hist_quantile(h, 0.5)),
+                               _fmt_num(_hist_quantile(h, 0.99))))
+        table_g = gauges.get("memory.scope.embedding.bytes") or {}
+        if table_g.get("value"):
+            row2.append("table=%s" % _fmt_bytes(table_g["value"]))
+        if row2:
+            lines.append("  " + "  ".join(row2))
+        lines.append("")
+
     # --- memory ---------------------------------------------------------
     mem_rows = [(n, g) for n, g in sorted(gauges.items())
                 if n.startswith("memory.") and n.endswith(".bytes_in_use")]

@@ -400,6 +400,83 @@ def _print_serve(rows, fmt):
         print(line % r)
 
 
+# the sparse-embedding headline, in data-path order: training pushes
+# (dedup ratio), the sparse wire (unique-rows comm vs the densified
+# equivalent), the scatter-add kernel, and the served lookup path
+_SPARSE_COUNTERS = ("embedding.push", "embedding.push.rows",
+                    "embedding.push.unique_rows", "embedding.lookup",
+                    "embedding.lookup.rows", "embedding.serve.lookup",
+                    "embedding.serve.rows", "comm.sparse.push",
+                    "comm.sparse.rows", "comm.sparse.unique_rows",
+                    "comm.sparse.sync", "comm.sparse.bytes",
+                    "comm.sparse.bytes_dense_equiv",
+                    "comm.sparse.all_gather_rows",
+                    "comm.sparse.psum_unique_rows",
+                    "comm.sparse.bucket.count", "comm.sparse.bucket.bytes",
+                    "comm.sparse.bucket.skipped")
+
+
+def parse_sparse(obj):
+    """Extract the sparse-embedding story (ISSUE 17) from a telemetry
+    snapshot: embedding.* / comm.sparse.* counters, the derived
+    unique-rows ratio (what fraction of pushed rows survived dedup),
+    modeled wire savings vs the densified-allreduce equivalent,
+    segment-sum kernel dispatch/fallback counts, served-lookup latency
+    quantiles, and the table's HBM-ledger bytes.
+    Returns [(metric, value)] rows."""
+    if "telemetry" in obj and isinstance(obj["telemetry"], dict):
+        obj = obj["telemetry"]
+    counters = obj.get("counters", {})
+    rows = []
+    for name in _SPARSE_COUNTERS:
+        if name in counters:
+            rows.append((name, counters[name]))
+    for name in sorted(counters):
+        if name.startswith("comm.sparse.bucket.flush_reason."):
+            rows.append((name, counters[name]))
+    pushed = counters.get("comm.sparse.rows",
+                          counters.get("embedding.push.rows", 0))
+    unique = counters.get("comm.sparse.unique_rows",
+                          counters.get("embedding.push.unique_rows", 0))
+    if pushed:
+        rows.append(("unique_rows_ratio", round(unique / pushed, 4)))
+    dense_eq = counters.get("comm.sparse.bytes_dense_equiv", 0)
+    sparse_b = counters.get("comm.sparse.bytes", 0)
+    if dense_eq:
+        rows.append(("comm_bytes_saved", dense_eq - sparse_b))
+    disp = counters.get("ops.pallas.dispatch.segment_sum", 0)
+    fall = sum(v for k, v in counters.items()
+               if k.startswith("ops.pallas.fallback.segment_sum."))
+    if disp or fall:
+        rows.append(("segment_sum_dispatch", disp))
+        rows.append(("segment_sum_fallback", fall))
+    h = obj.get("histograms", {}).get("embedding.serve.lookup_ms")
+    if isinstance(h, dict) and h.get("count"):
+        rows.append(("serve_lookup_ms_p50", _hist_quantile(h, 0.50)))
+        rows.append(("serve_lookup_ms_p99", _hist_quantile(h, 0.99)))
+    g = obj.get("gauges", {}).get("memory.scope.embedding.bytes")
+    if isinstance(g, dict) and g.get("value") is not None:
+        rows.append(("table_bytes", g["value"]))
+    return rows
+
+
+def _print_sparse(rows, fmt):
+    if not rows:
+        print("no embedding.*/comm.sparse.* counters in this dump (no "
+              "sparse embedding ran, or telemetry disabled)",
+              file=sys.stderr)
+        return
+    if fmt == "markdown":
+        print("| metric | value |")
+        print("| --- | --- |")
+        line = "| %s | %s |"
+    else:
+        print("metric,value")
+        line = "%s,%s"
+    for r in rows:
+        print(line % r)
+
+
 def parse_kernels(obj):
     """Extract the Pallas kernel-layer story (ISSUE 10): which stages ran
     fused (`ops.pallas.dispatch.<kernel>`), which calls fell back and WHY
@@ -917,6 +994,13 @@ def main():
                         help="serving mode: tokens/s, ttft/tpot quantiles, "
                              "queue/batch/KV pressure, shed and recovery "
                              "counts from a telemetry JSON dump")
+    parser.add_argument("--sparse", action="store_true",
+                        help="sparse-embedding mode: embedding.*/"
+                             "comm.sparse.* counters, unique-rows ratio, "
+                             "modeled wire savings vs densified allreduce, "
+                             "segment-sum dispatch/fallback counts, and "
+                             "served-lookup latency quantiles from a "
+                             "telemetry JSON dump")
     parser.add_argument("--kernels", action="store_true",
                         help="Pallas kernel-layer mode: dispatch/fallback "
                              "counts by kernel/reason, per-program fused-"
@@ -1012,6 +1096,12 @@ def main():
             sys.exit("--kernels input is not a JSON object: %s"
                      % args.logfile)
         _print_kernels(parse_kernels(obj), args.format)
+        return
+    if args.sparse:
+        if obj is None:
+            sys.exit("--sparse input is not a JSON object: %s"
+                     % args.logfile)
+        _print_sparse(parse_sparse(obj), args.format)
         return
     if args.comm:
         if obj is None:
