@@ -23,8 +23,10 @@ TPU004 retrace-hazard               warning  loop-varying scalars & dict/
                                              static_argnums
 TPU005 host-rng-under-trace         error    random.*/np.random.* baked
                                              in at trace time
-TPU006 thread-shared-state          warning  module-level mutable state
-                                             touched from threads lock-free
+TPU006 thread-shared-state          warning  shared mutable state mutated
+                                             from threads without the lock
+                                             that guards it elsewhere
+                                             (majority-usage inference)
 TPU007 sharding-annotation          error    PartitionSpec axes no mesh
                                              declares, in_/out_shardings
                                              arity mismatches, dead
@@ -33,13 +35,21 @@ TPU008 collective-safety            error    collectives under rank-
                                              divergent control flow,
                                              unbound axis_name, padded
                                              all_reduce_multi dims
+TPU009 lock-order-inversion         error    cycles in the project-wide
+                                             lock-order graph (A->B in one
+                                             function, B->A in another)
+TPU010 blocking-under-lock          warning  collectives/host syncs/HTTP/
+                                             sleep/subprocess/unbounded
+                                             queue waits while holding a
+                                             lock
 ====== ============================ ======== =========================
 
-Directory linting is *whole-program*: one level of project imports is
-resolved (`analysis.project.ProjectContext`), so a helper that
-`.asnumpy()`s in another module is flagged at its traced call site, and
-the mesh-axis universe TPU007/TPU008 validate against spans the whole
-tree.
+Directory linting is *whole-program*: project imports are resolved up to
+``MXNET_TPU_TRACELINT_IMPORT_DEPTH`` hops (default 2, see
+`analysis.project.ProjectContext`), so a helper that `.asnumpy()`s or
+branches on its argument two modules away is flagged at its traced call
+site; the mesh-axis universe TPU007/TPU008 validate against and the
+lock-order graph TPU009 walks both span the whole tree.
 
 Use:
 
@@ -53,7 +63,13 @@ Use:
   under trace raise `TraceGuardError` (counter
   ``analysis.guard.host_sync``) and retrace churn past
   ``MXNET_TPU_TRACE_GUARD_RETRACE_LIMIT`` is surfaced with the
-  changed-signature reason (``analysis.guard.retrace``).
+  changed-signature reason (``analysis.guard.retrace``);
+* ``MXNET_TPU_LOCK_GUARD=1`` arms the runtime lock-order guard
+  (`analysis.lockguard`): per-thread acquisition order is recorded on
+  the processes' guarded locks and a cross-thread inversion raises a
+  structured `LockOrderError` carrying both threads' acquisition stacks
+  (counter ``analysis.guard.lock_order``, flight-recorder event
+  ``lock_order_inversion``); ``=warn`` logs once per edge instead.
 """
 from __future__ import annotations
 
@@ -63,10 +79,12 @@ from .engine import (build_project, check, check_source, lint_file,
 from .rules import RULES, LINT_VERSION, rule_table
 from .guard import TraceGuardError, set_mode as set_guard_mode, \
     mode as guard_mode, active as guard_active
-from . import engine, guard, project
+from .lockguard import LockOrderError
+from . import engine, guard, lockguard, project
 
 __all__ = ["Finding", "Severity", "SEVERITY_ORDER", "max_severity",
            "build_project", "check", "check_source", "lint_file",
            "lint_paths", "lint_source", "RULES", "LINT_VERSION",
            "rule_table", "TraceGuardError", "set_guard_mode",
-           "guard_mode", "guard_active", "engine", "guard", "project"]
+           "guard_mode", "guard_active", "LockOrderError", "engine",
+           "guard", "lockguard", "project"]

@@ -1,0 +1,437 @@
+"""Concurrency rules — the whole-program lock passes (TPU006/009/010).
+
+All three ride the static lock model (`analysis.locks`): per-function
+held-lock walks, "acquired B while holding A" order edges, and
+blocking-call classification.  With a `ProjectContext` the edges stitch
+across files through the summaries' lock facts; file-local linting
+(`check_source` fixtures) degrades to the module's own graph.
+
+* **TPU009 lock-order inversion** — a cycle in the lock-order graph
+  means two threads interleaving those acquisition chains deadlock.
+  Every cycle is reported exactly once, anchored at its first acquisition
+  site (smallest file:line), with each chain named file/line-by-line.
+* **TPU010 blocking-under-lock** — holding a lock across a collective,
+  host sync, HTTP fetch, timeout-less ``queue.get``/``wait``, ``sleep``
+  or subprocess stalls every thread contending for that lock; on a TPU
+  fleet a collective under a lock escalates to a cross-replica stall.
+  Flagged at the call site, including one call hop away.
+* **TPU006 thread-shared-state v2** — infers which lock guards each
+  shared field from majority usage (≥2 guarded sites and more guarded
+  than not) and flags the minority accesses from thread-reachable
+  functions — including mutations under the *wrong* lock, which the v1
+  any-lock heuristic waved through.  Falls back to v1's no-lock-anywhere
+  check when no guard can be inferred.  Instance fields are only
+  reported when a guard was inferred — intentionally lock-free designs
+  (single-writer flags, signal-handler state) stay quiet.
+
+Registered exactly like spmd_rules: importing this module (from the end
+of rules.py) adds the rules to the registry.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Severity
+from . import locks as _locks
+from .rules import Rule, register, dotted, _target_names
+
+__all__ = ["ThreadSharedStateLint", "LockOrderInversion",
+           "BlockingUnderLock"]
+
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter"}
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "appendleft"}
+
+
+class _Site:
+    """Line anchor for findings derived from summary facts."""
+
+    __slots__ = ("lineno", "col_offset")
+
+    def __init__(self, lineno, col=0):
+        self.lineno = lineno
+        self.col_offset = col
+
+
+def _disp(lock_id):
+    """Human form of a lock id: strip the unverified-ctor marker."""
+    return lock_id.replace("~", "")
+
+
+# --------------------------------------------------------------------------
+# TPU009 — lock-order inversion (deadlock by interleaving)
+# --------------------------------------------------------------------------
+@register
+class LockOrderInversion(Rule):
+    code = "TPU009"
+    name = "lock-order-inversion"
+    severity = Severity.ERROR
+    scope = "module"
+    description = ("a cycle in the lock-order graph — somewhere lock B is "
+                   "acquired while holding A, elsewhere A while holding B "
+                   "(directly or through a called helper, one import hop "
+                   "included). Two threads interleaving those chains "
+                   "deadlock; under a collective the whole fleet follows.")
+    hint = ("pick one global acquisition order and restructure the "
+            "out-of-order chain (release before calling, or hoist the "
+            "second acquisition); the runtime guard "
+            "(MXNET_TPU_LOCK_GUARD=1) catches orders the AST can't see")
+
+    def check_module(self, mod):
+        if mod.project is not None and mod.module_name:
+            cycles = mod.project.lock_cycles()
+            here = os.path.abspath(mod.filename)
+        else:
+            model, facts = mod.lock_model
+            edges = []
+            for qual in sorted(facts):
+                for a, b, a_line, b_line in facts[qual].edges:
+                    edges.append((a, b, {"file": mod.filename,
+                                         "line": b_line, "fn": qual,
+                                         "held_line": a_line,
+                                         "via": None}))
+            cycles = _locks.find_cycles(edges)
+            here = mod.filename
+        for cycle in cycles:
+            # each cycle is reported once, anchored at its first
+            # acquisition site; a whole-tree run lands it in one file
+            anchor = min(cycle, key=lambda e: (e[2]["file"], e[2]["line"],
+                                               e[0], e[1]))
+            if os.path.abspath(anchor[2]["file"]) != \
+                    os.path.abspath(here):
+                continue
+            ring = " -> ".join([_disp(cycle[0][0])] +
+                               [_disp(b) for _, b, _i in cycle])
+            chains = "; ".join(self._edge_desc(e) for e in cycle)
+            yield self._finding(
+                mod, _Site(anchor[2]["line"]),
+                "lock-order inversion %s: %s — threads interleaving "
+                "these chains deadlock" % (ring, chains),
+                symbol=anchor[2]["fn"])
+
+    @staticmethod
+    def _edge_desc(edge):
+        a, b, info = edge
+        desc = "%s() acquires %s at %s:%d while holding %s (held since " \
+               "line %d)" % (info["fn"], _disp(b),
+                             os.path.basename(info["file"]), info["line"],
+                             _disp(a), info["held_line"])
+        if info.get("via"):
+            desc += " [via %s]" % info["via"]
+        return desc
+
+
+# --------------------------------------------------------------------------
+# TPU010 — blocking operation while holding a lock
+# --------------------------------------------------------------------------
+@register
+class BlockingUnderLock(Rule):
+    code = "TPU010"
+    name = "blocking-under-lock"
+    severity = Severity.WARNING
+    scope = "module"
+    description = ("a lock held across a blocking operation (collective/"
+                   "psum*, .asnumpy()/device sync, HTTP fetch, timeout-"
+                   "less queue.get()/wait(), sleep, subprocess) stalls "
+                   "every thread contending for it — and a collective "
+                   "under a lock can park the whole replica fleet behind "
+                   "one thread's mutex.")
+    hint = ("move the blocking call outside the `with lock:` region — "
+            "snapshot state under the lock, do the slow work after "
+            "releasing (see telemetry.federation._fetch_all)")
+
+    def check_module(self, mod):
+        model, facts = mod.lock_model
+        for qual in sorted(facts):
+            f = facts[qual]
+            for held, line, kind, detail in f.held_blocking:
+                yield self._finding(
+                    mod, _Site(line),
+                    "%s (%s) while holding %s — a blocked holder stalls "
+                    "every thread contending for the lock"
+                    % (_locks.BLOCKING_KINDS.get(kind, kind), detail,
+                       "/".join(_disp(h) for h in held)),
+                    symbol=qual)
+            yield from self._cross_function(mod, facts, qual, f)
+
+    def _cross_function(self, mod, facts, qual, f):
+        """A call made under a held lock into a helper that blocks —
+        same module, same class, or one import hop away."""
+        for chain_str, line, held in f.held_calls:
+            blocking = self._callee_blocking(mod, facts, qual, chain_str)
+            if not blocking:
+                continue
+            b_line, kind, detail = blocking[0]
+            yield self._finding(
+                mod, _Site(line),
+                "call into %s() reaches %s (%s at line %d) while "
+                "holding %s" % (chain_str,
+                                _locks.BLOCKING_KINDS.get(kind, kind),
+                                detail, b_line,
+                                "/".join(_disp(h) for h in held)),
+                symbol=qual)
+
+    @staticmethod
+    def _callee_blocking(mod, facts, caller_qual, chain_str):
+        chain = chain_str.split(".")
+        if chain[0] == "self" and len(chain) == 2 and "." in caller_qual:
+            target = facts.get("%s.%s"
+                               % (caller_qual.split(".")[0], chain[1]))
+            return target.blocking if target else None
+        if len(chain) == 1 and chain[0] in facts:
+            return facts[chain[0]].blocking
+        if mod.project is None:
+            return None
+        res = mod.resolve_callee(chain)
+        if res is None:
+            return None
+        callee = mod.project.function_lock_facts(res[0], res[1])
+        return callee.get("blocking") if callee else None
+
+
+# --------------------------------------------------------------------------
+# TPU006 v2 — shared state guarded-lock inference
+# --------------------------------------------------------------------------
+@register
+class ThreadSharedStateLint(Rule):
+    code = "TPU006"
+    name = "thread-shared-state"
+    severity = Severity.WARNING
+    scope = "module"
+    description = ("shared state mutated from a thread-reachable function "
+                   "without the lock that guards it. The guard is "
+                   "inferred from majority usage (which also catches "
+                   "mutations under the WRONG lock); with no inferable "
+                   "guard, module-level mutables fall back to the "
+                   "no-lock-anywhere check.")
+    hint = ("wrap the mutation in `with <lock>:` (see telemetry.metrics."
+            "Registry) or hand the update to the owning thread")
+
+    def check_module(self, mod):
+        entries = self._thread_entries(mod)
+        if not entries:
+            return
+        model, facts = mod.lock_model
+        reachable = self._thread_reachable(mod, entries)
+        mutables = self._module_mutables(mod.tree)
+        global_sites = {}   # var -> [site]
+        attr_sites = {}     # (cls, attr) -> [site]
+        for qual, func, cls in self._functions(mod, model, facts):
+            fl = facts.get(qual)
+            if fl is None or fl.stmt_held is None:
+                fl = _locks.function_lock_facts(func, model, cls_name=cls,
+                                                qualname=qual)
+            in_init = func.name in ("__init__", "__new__")
+            for stmt, held in fl.stmt_held:
+                for var in self._global_mutations(stmt, mutables):
+                    global_sites.setdefault(var, []).append(
+                        (func, stmt, held))
+                if cls and not in_init:
+                    lock_attrs = model.class_locks.get(cls, {})
+                    for attr in self._attr_mutations(stmt):
+                        if attr in lock_attrs:
+                            continue
+                        attr_sites.setdefault((cls, attr), []).append(
+                            (func, stmt, held))
+        for var in sorted(global_sites):
+            yield from self._judge(mod, var, None, global_sites[var],
+                                   reachable)
+        for cls, attr in sorted(attr_sites):
+            yield from self._judge(mod, attr, cls,
+                                   attr_sites[(cls, attr)], reachable)
+
+    # ----------------------------------------------------------- inference
+    def _judge(self, mod, var, cls, sites, reachable):
+        counts = {}
+        for _func, _stmt, held in sites:
+            for lock in held:
+                counts[lock] = counts.get(lock, 0) + 1
+        inferred = None
+        if counts:
+            lock, n = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+            if n >= 2 and n > len(sites) - n:
+                inferred = (lock, n)
+        for func, stmt, held in sites:
+            if func not in reachable:
+                continue
+            if inferred is not None:
+                lock, n = inferred
+                if lock in held:
+                    continue
+                wrong = " (holds %s instead)" % \
+                    "/".join(_disp(h) for h in held) if held else ""
+                target = ("self.%s" % var) if cls else \
+                    ("module-level mutable %r" % var)
+                yield self._finding(
+                    mod, stmt,
+                    "%s mutated from thread-reachable %s() without "
+                    "holding %r — the lock guarding it at %d of %d "
+                    "mutation sites%s"
+                    % (target, func.name, _disp(lock), n, len(sites),
+                       wrong),
+                    symbol=func.name)
+            elif cls is None and not held:
+                # v1 fallback: module-level mutable, no lock anywhere
+                yield self._finding(
+                    mod, stmt,
+                    "module-level mutable %r mutated from "
+                    "thread-reachable %s() without holding a lock"
+                    % (var, func.name),
+                    symbol=func.name)
+
+    # ------------------------------------------------------ site discovery
+    @staticmethod
+    def _functions(mod, model, facts):
+        """(qualname, func node, class name|None) for every function —
+        top-level, methods, and nested thread-target closures."""
+        seen = set()
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                seen.add(node)
+                yield node.name, node, None
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        seen.add(sub)
+                        yield "%s.%s" % (node.name, sub.name), sub, \
+                            node.name
+        for func in mod.all_functions:
+            if func not in seen:
+                yield func.name, func, None
+
+    @staticmethod
+    def _global_mutations(stmt, mutables):
+        out = []
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in mutables:
+                    out.append(t.value.id)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in mutables:
+                    out.append(t.value.id)
+        elif isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Call):
+            callee = stmt.value.func
+            if isinstance(callee, ast.Attribute) and \
+                    callee.attr in _MUTATORS and \
+                    isinstance(callee.value, ast.Name) and \
+                    callee.value.id in mutables:
+                out.append(callee.value.id)
+        return out
+
+    @staticmethod
+    def _attr_mutations(stmt):
+        """Instance attrs this statement writes: `self.x = / self.x[k] =
+        / self.x.append(...)`."""
+
+        def self_attr(node):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            return None
+
+        out = []
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                attr = self_attr(t)
+                if attr is None and isinstance(t, ast.Subscript):
+                    attr = self_attr(t.value)
+                if attr is not None:
+                    out.append(attr)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = self_attr(t.value)
+                    if attr is not None:
+                        out.append(attr)
+        elif isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Call):
+            callee = stmt.value.func
+            if isinstance(callee, ast.Attribute) and \
+                    callee.attr in _MUTATORS:
+                attr = self_attr(callee.value)
+                if attr is not None:
+                    out.append(attr)
+        return out
+
+    @staticmethod
+    def _module_mutables(tree):
+        out = set()
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+            if isinstance(value, ast.Call):
+                chain = dotted(value.func) or []
+                mutable = bool(chain) and chain[-1] in _MUTABLE_CTORS
+            if mutable:
+                for t in targets:
+                    out |= _target_names(t)
+        return out
+
+    @staticmethod
+    def _thread_entries(mod):
+        entries = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or []
+            if not chain or chain[-1] != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                tchain = dotted(kw.value)
+                if tchain:
+                    entries.add(tchain[-1])
+        # Thread subclasses: their run() is the entry
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    (dotted(b) or [""])[-1] == "Thread" for b in node.bases):
+                entries.add("run")
+        return entries
+
+    @staticmethod
+    def _thread_reachable(mod, entries):
+        by_name = {}
+        for func in mod.all_functions:
+            by_name.setdefault(func.name, []).append(func)
+        seen = set()
+        work = sorted(entries)
+        for _ in range(3):  # bounded transitive closure
+            nxt = []
+            for name in work:
+                if name in seen or name not in by_name:
+                    continue
+                seen.add(name)
+                for func in by_name[name]:
+                    for node in ast.walk(func):
+                        if isinstance(node, ast.Call):
+                            chain = dotted(node.func)
+                            if chain:
+                                nxt.append(chain[-1])
+            work = nxt
+        out = set()
+        for name in seen:
+            out.update(by_name.get(name, []))
+        return out

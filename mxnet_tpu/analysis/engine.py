@@ -33,6 +33,7 @@ import os
 import re
 
 from .findings import Finding, Severity
+from . import locks as _locks
 from .rules import RULES, dotted
 from .taint import TaintTracker
 
@@ -85,6 +86,15 @@ class ModuleInfo:
         self.jit_wrapped_names = self._jit_wrapped_names()
         self.traced = self._find_traced()
         self.line_suppress, self.file_suppress = self._collect_suppressions()
+        self._lock_model = None
+
+    @property
+    def lock_model(self):
+        """(locks.LockModel, {qualname: FnLockFacts}) for this file —
+        computed once, shared by the concurrency rules."""
+        if self._lock_model is None:
+            self._lock_model = _locks.module_lock_facts(self.tree)
+        return self._lock_model
 
     # ------------------------------------------------------------- helpers
     def source_line(self, lineno):

@@ -1,0 +1,266 @@
+"""Runtime lock-order guard — the dynamic sibling of tracelint TPU009.
+
+The static pass (`analysis.locks` + TPU009) proves lock-order safety for
+the acquisition chains it can *see*; it cannot see an order established
+through dynamic dispatch, a callback, or a lock handed across modules at
+run time.  This guard closes that gap the same way the trace guard closes
+TPU001's: instrumented locks record each thread's acquisition order,
+fold every "acquired B while holding A" pair into one process-wide
+order graph, and the first acquisition that *inverts* an observed edge —
+the classic A→B vs B→A deadlock — is reported **before** the process can
+actually deadlock, with both threads' acquisition stacks side by side.
+
+Adoption: the telemetry registry, the serve request queue and KV block
+pool, and the resilience watchdog create their locks through the
+`lock`/`rlock`/`condition` factories below.  Lock identity is the
+*name* handed to the factory (an order class like ``"serve.kv_pool"``),
+not the object — two pool instances share ordering, which is how the
+bugs are written; same-name nesting is therefore deliberately ignored.
+
+Modes (``MXNET_TPU_LOCK_GUARD``): unset/``0`` = off, ``1``/``raise``/
+``error`` = raise `LockOrderError`, ``warn`` = warn once per inverted
+edge and continue.  Gating happens at *creation* time: when the guard is
+off the factories return raw ``threading`` primitives, so the steady
+state has literally zero wrapper overhead (the acceptance bar shared
+with ``MXNET_TPU_TELEMETRY=0``).  Flip the mode *before* constructing
+the objects whose locks you want watched.
+
+On an inversion the guard also counts ``analysis.guard.lock_order`` (and
+a per-edge sub-counter) and drops a ``lock_order_inversion`` event into
+the crash flight ring, so a warn-mode fleet still leaves a post-mortem
+trail.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+import warnings
+
+from ..base import MXNetError
+
+__all__ = ["LockOrderError", "GuardedLock", "lock", "rlock", "condition",
+           "mode", "set_mode", "active", "reset"]
+
+_MODE_OFF = "off"
+_MODE_WARN = "warn"
+_MODE_RAISE = "raise"
+
+
+def _parse_mode(raw):
+    raw = str(raw).strip().lower()
+    if raw in ("", "0", "false", "off", "no", "none"):
+        return _MODE_OFF
+    if raw == "warn":
+        return _MODE_WARN
+    return _MODE_RAISE  # "1", "raise", "error", anything affirmative
+
+
+_mode = _parse_mode(os.environ.get("MXNET_TPU_LOCK_GUARD", ""))
+ACTIVE = _mode != _MODE_OFF
+
+
+class LockOrderError(MXNetError):
+    """A lock-order inversion caught at run time.
+
+    Carries the full picture a deadlock post-mortem needs: the inverted
+    ``edge`` ``(held, acquiring)``, this thread's name/held-chain/stack,
+    and the name/held-chain/stack recorded when the *opposite* order was
+    first observed."""
+
+    def __init__(self, message, edge=None, this_thread=None,
+                 this_chain=None, this_stack=None, other_thread=None,
+                 other_chain=None, other_stack=None):
+        super().__init__(message)
+        self.edge = edge
+        self.this_thread = this_thread
+        self.this_chain = this_chain
+        self.this_stack = this_stack
+        self.other_thread = other_thread
+        self.other_chain = other_chain
+        self.other_stack = other_stack
+
+
+# process-wide observed-order graph: (a, b) -> first-observation record
+_GRAPH_LOCK = threading.Lock()
+_EDGES = {}
+_warned_edges = set()
+_TLS = threading.local()
+
+
+def mode():
+    return _mode
+
+
+def active():
+    return ACTIVE
+
+
+def set_mode(value):
+    """'off' | 'warn' | 'raise' (same parser as the env var).  Returns
+    the previous mode.  Affects locks created *after* the call — the
+    factories gate at creation time."""
+    global _mode, ACTIVE
+    prev = _mode
+    _mode = _parse_mode(value)
+    ACTIVE = _mode != _MODE_OFF
+    return prev
+
+
+def reset():
+    """Forget the observed order graph (tests)."""
+    with _GRAPH_LOCK:
+        _EDGES.clear()
+        _warned_edges.clear()
+
+
+def _held():
+    chain = getattr(_TLS, "held", None)
+    if chain is None:
+        chain = _TLS.held = []
+    return chain
+
+
+def _stack():
+    # drop the two guard-internal frames so the stack ends at user code
+    return traceback.format_stack(limit=16)[:-2]
+
+
+def _find_path(src, dst):
+    """Edge path src -> ... -> dst in the observed graph (caller holds
+    _GRAPH_LOCK), else None."""
+    stack = [(src, [])]
+    visited = {src}
+    while stack:
+        node, path = stack.pop()
+        for (a, b) in _EDGES:
+            if a != node or b in visited:
+                continue
+            nxt = path + [(a, b)]
+            if b == dst:
+                return nxt
+            visited.add(b)
+            stack.append((b, nxt))
+    return None
+
+
+class GuardedLock:
+    """Order-checking lock wrapper.  Exposes the ``acquire(blocking,
+    timeout)/release`` protocol, so ``threading.Condition`` accepts it as
+    its underlying lock (the Condition fallbacks probe with
+    ``acquire(False)`` — held-state is only recorded on a *successful*
+    acquire, keeping the probe invisible)."""
+
+    def __init__(self, name, reentrant=False):
+        self.name = name
+        self._reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking=True, timeout=-1):
+        held = _held()
+        if self.name not in (h[0] for h in held):
+            self._check_order(held)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            held.append((self.name, _stack()))
+        return ok
+
+    def release(self):
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == self.name:
+                del held[i]
+                break
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<GuardedLock %r %s>" % (self.name, self._lock)
+
+    # ------------------------------------------------------------ checking
+    def _check_order(self, held):
+        if not held:
+            return
+        me = threading.current_thread().name
+        chain = [h[0] for h in held]
+        with _GRAPH_LOCK:
+            path = inverted = None
+            for h in reversed(chain):
+                if h == self.name:
+                    continue
+                path = _find_path(self.name, h)
+                if path is not None:
+                    inverted = h
+                    break
+            if path is None:
+                for other in chain:
+                    if other != self.name:
+                        _EDGES.setdefault(
+                            (other, self.name),
+                            {"thread": me, "chain": list(chain),
+                             "stack": _stack()})
+                return
+            other = _EDGES[path[0]]
+            edge = (inverted, self.name)
+            first_warn = edge not in _warned_edges
+            _warned_edges.add(edge)
+        via = " -> ".join([path[0][0]] + [b for _, b in path])
+        message = (
+            "lock-order inversion: thread %r acquires %r while holding %s"
+            " (chain %s), but thread %r previously acquired them in the"
+            " opposite order (%s).  Interleaved, these two chains"
+            " deadlock.\n--- this thread (%s) ---\n%s"
+            "--- first-observed order (thread %s, chain %s) ---\n%s"
+            % (me, self.name, inverted, " -> ".join(chain), other["thread"],
+               via, me, "".join(_stack()), other["thread"],
+               " -> ".join(other["chain"]), "".join(other["stack"])))
+        self._note(edge, message)
+        if _mode == _MODE_RAISE:
+            raise LockOrderError(
+                message, edge=edge, this_thread=me, this_chain=chain,
+                this_stack=_stack(), other_thread=other["thread"],
+                other_chain=other["chain"], other_stack=other["stack"])
+        if first_warn:
+            warnings.warn(message, RuntimeWarning, stacklevel=4)
+
+    @staticmethod
+    def _note(edge, message):
+        from .. import telemetry as _telem
+        from ..telemetry import flight as _flight
+        _telem.inc("analysis.guard.lock_order")
+        _telem.inc("analysis.guard.lock_order.%s__%s" % edge)
+        _flight.note_event("lock_order_inversion",
+                           "%s vs %s" % (edge[1], edge[0]))
+
+
+# ---------------------------------------------------------------------------
+# factories — the adoption surface.  Creation-time gating: off -> raw
+# threading primitives, zero overhead.
+# ---------------------------------------------------------------------------
+def lock(name):
+    """A mutex participating in lock-order checking under the given
+    order-class name (raw ``threading.Lock`` when the guard is off)."""
+    if not ACTIVE:
+        return threading.Lock()
+    return GuardedLock(name)
+
+
+def rlock(name):
+    if not ACTIVE:
+        return threading.RLock()
+    return GuardedLock(name, reentrant=True)
+
+
+def condition(name):
+    """A ``threading.Condition`` whose underlying mutex is order-checked
+    (raw Condition when the guard is off)."""
+    if not ACTIVE:
+        return threading.Condition()
+    return threading.Condition(GuardedLock(name))

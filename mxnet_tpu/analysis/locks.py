@@ -1,0 +1,441 @@
+"""Static lock model — the shared substrate of the concurrency rules.
+
+The model answers three questions about a module, from the AST alone:
+
+* **which locks exist** — module globals bound to a ``threading.Lock/
+  RLock/Condition/Semaphore`` constructor (or an ``analysis.lockguard``
+  factory), and ``self._lock``-style instance attributes assigned one in
+  any method.  A module-global lock is identified as ``NAME``; an
+  instance lock as ``Class.attr`` — the *order class*, not the object:
+  two instances of the same class share the id, which matches how
+  lock-order bugs are actually written (and how the runtime guard names
+  its locks).  A ``with``-target that merely *looks* lockish
+  (``self._mu``, ``cache_lock``) but whose constructor was not seen is
+  kept as a fallback id so held-state is still tracked.
+* **what each function does while holding them** — a linear walk over
+  each function body tracking the ordered held set through ``with
+  lock:`` blocks and ``lock.acquire()``/``release()`` statements.  The
+  walk records acquisition sites, "acquired B while holding A" order
+  edges, blocking operations executed under a lock (`classify_blocking`:
+  collectives, host syncs, HTTP, timeout-less ``queue.get``/``wait``,
+  ``sleep``, subprocess), and project calls made under a lock — the raw
+  material `ProjectContext.lock_edges` stitches into the cross-file
+  lock-order graph.
+* **where the order graph cycles** — `find_cycles` over any edge list.
+
+Deliberate limits (documented in the README): lock identity is
+name-based, not alias-aware (``lk = self._lock; lk.acquire()`` is
+invisible); ``acquire``/``release`` pairs are matched within one
+statement list, not across ``try/finally`` boundaries; conditional
+acquisition is treated as acquisition.
+"""
+from __future__ import annotations
+
+import ast
+
+__all__ = ["LockModel", "FnLockFacts", "collect_lock_model",
+           "module_lock_facts", "classify_blocking", "find_cycles",
+           "BLOCKING_KINDS"]
+
+# threading (and lockguard) constructors that create a lock-like object
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "GuardedLock")
+_GUARD_FACTORIES = ("lock", "rlock", "condition")
+
+_LOCKISH_MARKERS = ("lock", "cond", "mutex", "sem", "_mu")
+
+BLOCKING_KINDS = {
+    "collective": "a cross-replica collective",
+    "host_sync": "a blocking device->host sync",
+    "http": "an HTTP fetch",
+    "queue": "a timeout-less queue.get()",
+    "wait": "a timeout-less wait()",
+    "sleep": "a sleep",
+    "subprocess": "a subprocess",
+}
+
+_COLLECTIVE_PREFIXES = ("psum", "pmean", "pmax", "pmin", "all_reduce",
+                        "all_gather", "allgather", "reduce_scatter",
+                        "all_to_all", "ppermute", "barrier", "broadcast")
+_HOST_SYNC_ATTRS = ("asnumpy", "asscalar", "wait_to_read", "wait_to_write",
+                    "block_until_ready")
+_HTTP_NAMES = ("urlopen", "urlretrieve")
+_SUBPROCESS_FNS = ("run", "call", "check_call", "check_output", "Popen")
+# call chains under a held lock that we never treat as project calls
+# (logging, string/dict plumbing, telemetry counters — cheap by contract)
+_CALL_SKIP_ATTRS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "appendleft", "get", "items",
+    "keys", "values", "copy", "format", "join", "split", "strip", "info",
+    "debug", "warning", "error", "exception", "inc", "observe", "set",
+    "startswith", "endswith", "encode", "decode", "acquire", "release",
+    "notify", "notify_all", "locked", "time", "monotonic",
+})
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _name_is_lockish(name):
+    low = name.lower()
+    return any(m in low for m in _LOCKISH_MARKERS)
+
+
+def _is_lock_ctor(value):
+    """True when `value` constructs a lock-like object: threading.Lock()
+    et al., or an analysis.lockguard factory (lockguard.lock("name"))."""
+    if not isinstance(value, ast.Call):
+        return False
+    chain = _dotted(value.func) or []
+    if not chain:
+        return False
+    if chain[-1] in _LOCK_CTORS:
+        return True
+    return (chain[-1] in _GUARD_FACTORIES and len(chain) > 1 and
+            "lockguard" in chain[-2].lower())
+
+
+class LockModel:
+    """Lock objects one module declares."""
+
+    __slots__ = ("module_locks", "class_locks")
+
+    def __init__(self, module_locks=None, class_locks=None):
+        self.module_locks = module_locks or {}  # name -> lineno
+        self.class_locks = class_locks or {}    # class -> {attr: lineno}
+
+    def to_dict(self):
+        return {"module_locks": self.module_locks,
+                "class_locks": {c: dict(a)
+                                for c, a in self.class_locks.items()}}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(dict(d.get("module_locks", {})),
+                   {c: dict(a)
+                    for c, a in d.get("class_locks", {}).items()})
+
+
+class FnLockFacts:
+    """What one function does with locks (all fields JSON-plain)."""
+
+    __slots__ = ("qualname", "acquires", "edges", "held_blocking",
+                 "held_calls", "blocking", "stmt_held")
+
+    def __init__(self, qualname, acquires=None, edges=None,
+                 held_blocking=None, held_calls=None, blocking=None):
+        self.qualname = qualname
+        self.acquires = acquires or []      # [[lock, line]]
+        self.edges = edges or []            # [[a, b, a_line, b_line]]
+        self.held_blocking = held_blocking or []  # [[locks, line, kind, detail]]
+        self.held_calls = held_calls or []  # [[chain, line, [locks...]]]
+        self.blocking = blocking or []      # [[line, kind, detail]]
+        # in-memory only: [(stmt, (held lock ids...))] for TPU006 v2
+        self.stmt_held = None
+
+    def to_dict(self):
+        return {"qualname": self.qualname, "acquires": self.acquires,
+                "edges": self.edges, "held_blocking": self.held_blocking,
+                "held_calls": self.held_calls, "blocking": self.blocking}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["qualname"], d.get("acquires"), d.get("edges"),
+                   d.get("held_blocking"), d.get("held_calls"),
+                   d.get("blocking"))
+
+
+def collect_lock_model(tree):
+    """Discover declared locks: module globals and self-attr locks."""
+    module_locks = {}
+    class_locks = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    module_locks[t.id] = node.lineno
+        elif isinstance(node, ast.AnnAssign) and node.value is not None and \
+                _is_lock_ctor(node.value) and \
+                isinstance(node.target, ast.Name):
+            module_locks[node.target.id] = node.lineno
+        elif isinstance(node, ast.ClassDef):
+            attrs = {}
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign) or \
+                        not _is_lock_ctor(sub.value):
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        attrs[t.attr] = sub.lineno
+                    elif isinstance(t, ast.Name):
+                        # class-level `LOCK = threading.Lock()`
+                        attrs[t.id] = sub.lineno
+            if attrs:
+                class_locks[node.name] = attrs
+    return LockModel(module_locks, class_locks)
+
+
+def classify_blocking(call):
+    """(kind, detail) when `call` is a blocking operation, else None.
+    `kind` is a BLOCKING_KINDS key.  Name-based by design — the model has
+    no types; the README documents the blind spots."""
+    chain = _dotted(call.func)
+    if not chain:
+        return None
+    last = chain[-1]
+    if any(last == p or last.startswith(p + "_") for p in
+           _COLLECTIVE_PREFIXES):
+        return ("collective", "%s()" % ".".join(chain))
+    if last in _HOST_SYNC_ATTRS or chain[-2:] == ["jax", "device_get"] or \
+            last == "device_get":
+        return ("host_sync", "%s()" % ".".join(chain))
+    if last in _HTTP_NAMES or (chain[0] == "requests" and
+                               last in ("get", "post", "put", "head")):
+        return ("http", "%s()" % ".".join(chain))
+    if last == "sleep":
+        return ("sleep", "%s()" % ".".join(chain))
+    if chain[0] == "subprocess" and last in _SUBPROCESS_FNS:
+        return ("subprocess", "%s()" % ".".join(chain))
+    if last == "communicate" and not call.args:
+        return ("subprocess", "%s()" % ".".join(chain))
+    no_timeout = not call.args and not any(
+        kw.arg == "timeout" for kw in call.keywords)
+    if last == "get" and len(chain) > 1 and no_timeout and \
+            _queueish(chain[-2]):
+        return ("queue", "%s() without timeout" % ".".join(chain))
+    if last == "wait" and len(chain) > 1 and no_timeout:
+        return ("wait", "%s() without timeout" % ".".join(chain))
+    return None
+
+
+def _queueish(name):
+    low = name.lower()
+    return "queue" in low or low in ("q", "_q", "inbox", "mailbox") or \
+        low.endswith("_q")
+
+
+class _FnWalker:
+    """One pass over a function body tracking the ordered held-lock set."""
+
+    def __init__(self, model, cls_name, qualname):
+        self.model = model
+        self.cls = cls_name
+        self.facts = FnLockFacts(qualname)
+        self.facts.stmt_held = []
+
+    # ------------------------------------------------------------ resolve
+    def lock_ref(self, expr):
+        """Lock id for an expression naming a lock, else None."""
+        if isinstance(expr, ast.Call):
+            # `self._lock.acquire()` handled by caller; a bare call like
+            # `get_lock()` is not a nameable lock
+            return None
+        chain = _dotted(expr)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            if chain[0] in self.model.module_locks:
+                return chain[0]
+            if _name_is_lockish(chain[0]):
+                return "~" + chain[0]   # lockish name, ctor unseen
+            return None
+        if chain[0] == "self" and len(chain) == 2 and self.cls:
+            attrs = self.model.class_locks.get(self.cls, {})
+            if chain[1] in attrs or _name_is_lockish(chain[1]):
+                return "%s.%s" % (self.cls, chain[1])
+        if chain[0] != "self" and _name_is_lockish(chain[-1]):
+            # `with othermod.LOCK:` — an attribute reached through a
+            # (possibly imported) module object.  The project layer
+            # resolves the '@' marker to the owning module's lock id;
+            # file-local linting keeps it as an opaque node.
+            return "@" + ".".join(chain)
+        return None
+
+    # --------------------------------------------------------------- walk
+    def walk(self, body):
+        self._walk(body, [])
+        return self.facts
+
+    def _walk(self, body, held):
+        held = list(held)
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue   # nested defs run later, not under this lock
+            self.facts.stmt_held.append(
+                (stmt, tuple(l for l, _ in held)))
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = list(held)
+                for item in stmt.items:
+                    lock = self.lock_ref(item.context_expr)
+                    if lock is not None:
+                        self._acquired(lock, item.context_expr.lineno,
+                                       inner)
+                        inner.append((lock, item.context_expr.lineno))
+                    else:
+                        # `with urlopen(...) as r:` under a lock is a
+                        # blocking site too
+                        self._scan_expr(item.context_expr, inner)
+                self._walk(stmt.body, inner)
+                continue
+            # acquire()/release() statements adjust the running held set
+            acq = self._acquire_stmt(stmt)
+            if acq is not None:
+                lock, line, is_acquire = acq
+                if is_acquire:
+                    self._acquired(lock, line, held)
+                    held.append((lock, line))
+                else:
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == lock:
+                            del held[i]
+                            break
+                continue
+            self._scan_calls(stmt, held)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    self._walk(sub, held)
+            for handler in getattr(stmt, "handlers", []):
+                self._walk(handler.body, held)
+
+    def _acquire_stmt(self, stmt):
+        """(lock, line, is_acquire) for a bare `X.acquire()`/`X.release()`
+        statement, else None."""
+        if not isinstance(stmt, ast.Expr) or \
+                not isinstance(stmt.value, ast.Call):
+            return None
+        func = stmt.value.func
+        if not isinstance(func, ast.Attribute) or \
+                func.attr not in ("acquire", "release"):
+            return None
+        lock = self.lock_ref(func.value)
+        if lock is None:
+            return None
+        return (lock, stmt.lineno, func.attr == "acquire")
+
+    def _acquired(self, lock, line, held):
+        self.facts.acquires.append([lock, line])
+        for a, a_line in held:
+            if a != lock:
+                self.facts.edges.append([a, lock, a_line, line])
+
+    def _scan_calls(self, stmt, held):
+        # only the statement's OWN expressions — nested statement bodies
+        # are walked by _walk with their own held state
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held)
+
+    def _scan_expr(self, expr, held):
+        held_ids = [l for l, _ in held]
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue   # runs later, not under this lock
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            hit = classify_blocking(node)
+            if hit is not None:
+                kind, detail = hit
+                self.facts.blocking.append([node.lineno, kind, detail])
+                if held_ids:
+                    culprits = held_ids
+                    if kind == "wait":
+                        # cond.wait() releases the cond itself — only the
+                        # OTHER held locks stay pinned across the wait
+                        base = self.lock_ref(node.func.value) \
+                            if isinstance(node.func, ast.Attribute) \
+                            else None
+                        culprits = [l for l in held_ids if l != base]
+                    if culprits:
+                        self.facts.held_blocking.append(
+                            [list(culprits), node.lineno, kind, detail])
+                continue
+            if not held_ids or len(self.facts.held_calls) >= 40:
+                continue
+            chain = _dotted(node.func)
+            if not chain or chain[-1] in _CALL_SKIP_ATTRS:
+                continue
+            self.facts.held_calls.append(
+                [".".join(chain), node.lineno, list(held_ids)])
+
+
+def function_lock_facts(func, model, cls_name=None, qualname=None):
+    walker = _FnWalker(model, cls_name,
+                       qualname or (cls_name + "." + func.name
+                                    if cls_name else func.name))
+    return walker.walk(func.body)
+
+
+def module_lock_facts(tree):
+    """(LockModel, {qualname: FnLockFacts}) for every top-level function
+    and every method of every top-level class."""
+    model = collect_lock_model(tree)
+    facts = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            facts[node.name] = function_lock_facts(node, model)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = "%s.%s" % (node.name, sub.name)
+                    facts[qual] = function_lock_facts(
+                        sub, model, cls_name=node.name, qualname=qual)
+    return model, facts
+
+
+# ---------------------------------------------------------------------------
+# cycle detection over an edge list
+# ---------------------------------------------------------------------------
+def find_cycles(edges, max_cycles=20):
+    """Cycles in a lock-order edge list.
+
+    `edges` is ``[(a, b, info), ...]`` — `info` is opaque edge metadata
+    (site descriptions).  Returns ``[[(a, b, info), ...], ...]`` — one
+    entry per distinct cycle, each a closed chain of edges, deduplicated
+    by the set of (a, b) pairs.  Parallel a→b edges keep only the first
+    (edge order is the caller's priority order)."""
+    first = {}
+    adj = {}
+    for a, b, info in edges:
+        if a == b:
+            continue
+        if (a, b) not in first:
+            first[(a, b)] = info
+            adj.setdefault(a, []).append(b)
+    cycles = []
+    seen = set()
+
+    def dfs(start, node, path, visited):
+        if len(cycles) >= max_cycles or len(path) > 6:
+            return
+        for nxt in adj.get(node, ()):  # noqa: B023
+            if nxt == start:
+                chain = path + [(node, start)]
+                key = frozenset(chain)
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(
+                        [(a, b, first[(a, b)]) for a, b in chain])
+            elif nxt not in visited and nxt > start:
+                # only walk nodes ordered after `start` — each cycle is
+                # found exactly once, rooted at its smallest node
+                dfs(start, nxt, path + [(node, nxt)], visited | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [], {start})
+    return cycles

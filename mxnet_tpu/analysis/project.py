@@ -1,30 +1,41 @@
-"""Whole-program context for tracelint: one-level cross-file resolution.
+"""Whole-program context for tracelint: cross-file summary resolution.
 
 Per-file AST linting cannot see that a helper in ``parallel/sharding.py``
 calls ``.asnumpy()`` when the traced caller lives in
-``gluon/fused_step.py``. A `ProjectContext` closes that gap for exactly one
-import hop:
+``gluon/fused_step.py``. A `ProjectContext` closes that gap:
 
 * it maps dotted module names (``mxnet_tpu.parallel.sharding``) to files
   for every package root handed to `lint_paths`;
 * it computes a `ModuleSummary` per module — the *interprocedural facts*
-  rules consume: per-function host-sync/host-RNG hazard sites (computed
-  with every parameter tainted, so "would this helper sync if handed a
-  tracer?" is answerable at any call site), function arity, and the mesh
-  axis names the module declares (`Mesh(...)`, `create_mesh(...)`,
-  `MeshConfig(...)`, ``axis_order=`` literals, ``pmap(axis_name=...)``);
+  rules consume: per-function host-sync/host-RNG/data-dependent-branch
+  hazard sites (computed with every parameter tainted, so "would this
+  helper sync if handed a tracer?" is answerable at any call site),
+  parameter names, outgoing calls, the module's import table, the static
+  lock model (`analysis.locks`), and the mesh axis names the module
+  declares (`Mesh(...)`, `create_mesh(...)`, `MeshConfig(...)`,
+  ``axis_order=`` literals, ``pmap(axis_name=...)``);
 * summaries are cached on disk keyed by (mtime, size, LINT_VERSION) —
   the same contract as the CLI findings `FileCache` — so repeat runs
   re-summarize only changed files.
 
-The taint model is deliberately ONE level deep: a traced caller sees the
-direct hazards in the imported helper's body, not hazards another hop
-away. That matches how these bugs are actually written (a "small" utility
-wrapping `.asnumpy()`) without dragging in a whole-program call graph.
+Summary resolution follows the import graph to a configurable depth
+(``MXNET_TPU_TRACELINT_IMPORT_DEPTH``, default 2): `function_summary`
+returns an *effective* summary with the helper's own callees' hazards
+folded in — a traced call into ``middle()`` whose callee ``deep()``
+host-syncs is reported at the traced call site, naming the whole chain.
+Recorded calls carry a "was any argument parameter-derived" bit, so
+sync/branch hazards only propagate along argument flow (RNG propagates
+unconditionally — the draw happens regardless of what was passed).
 
-`digest()` folds every project file's (path, mtime, size) into one token;
-the findings cache keys on it so editing a *helper* invalidates the
-cached findings of its *callers*.
+The same summaries carry each function's lock facts; `lock_edges` stitches
+them — including edges created by calling, under a held lock, an imported
+helper that acquires its own lock — into the project-wide lock-order
+graph that TPU009 checks for cycles.
+
+`digest()` folds every project file's (path, mtime, size) plus the
+resolution depth into one token; the findings cache keys on it so editing
+a helper — even a depth-2 one — invalidates the cached findings of its
+transitive callers.
 """
 from __future__ import annotations
 
@@ -33,11 +44,22 @@ import json
 import os
 import tempfile
 
+from . import locks as _locks
 from .taint import TaintTracker
 
 __all__ = ["ProjectContext", "ModuleSummary", "FnSummary", "SummaryCache",
            "package_root", "collect_declared_axes", "collect_axis_sizes",
-           "DEFAULT_SUMMARY_CACHE"]
+           "DEFAULT_SUMMARY_CACHE", "DEFAULT_IMPORT_DEPTH"]
+
+DEFAULT_IMPORT_DEPTH = 2
+
+
+def _env_depth():
+    try:
+        return max(1, int(os.environ.get(
+            "MXNET_TPU_TRACELINT_IMPORT_DEPTH", str(DEFAULT_IMPORT_DEPTH))))
+    except ValueError:
+        return DEFAULT_IMPORT_DEPTH
 
 DEFAULT_SUMMARY_CACHE = os.path.join(
     tempfile.gettempdir(),
@@ -224,56 +246,91 @@ def collect_axis_sizes(tree):
 class FnSummary:
     """Interprocedural facts about one top-level function."""
 
-    __slots__ = ("name", "arity", "has_vararg", "hazards")
+    __slots__ = ("name", "arity", "has_vararg", "hazards", "params",
+                 "calls")
 
-    def __init__(self, name, arity, has_vararg, hazards):
+    def __init__(self, name, arity, has_vararg, hazards, params=None,
+                 calls=None):
         self.name = name
         self.arity = arity          # positional params (incl. defaults)
         self.has_vararg = has_vararg
-        # [(kind, line, detail)] — kind: 'sync' (fires when called with a
-        # tainted arg) | 'rng' (fires whenever called under trace)
+        # [(kind, line, detail[, deps])] — kind: 'sync' (fires when called
+        # with a tainted arg) | 'rng' (fires whenever called under trace)
+        # | 'ctl' (a branch on a parameter; `deps` names the parameters
+        # the branch test reads, or None for a hazard folded in from a
+        # deeper callee, where any tainted argument triggers it)
         self.hazards = hazards
+        self.params = params or []  # positional+kw param names, no self
+        # [(line, dotted_chain, any_arg_param_derived)] — outgoing calls,
+        # the raw material for depth>1 summary folding
+        self.calls = calls or []
 
     def to_dict(self):
         return {"name": self.name, "arity": self.arity,
-                "has_vararg": self.has_vararg, "hazards": self.hazards}
+                "has_vararg": self.has_vararg,
+                "hazards": [list(h) for h in self.hazards],
+                "params": self.params,
+                "calls": [list(c) for c in self.calls]}
 
     @classmethod
     def from_dict(cls, d):
         return cls(d["name"], d["arity"], d["has_vararg"],
-                   [tuple(h) for h in d["hazards"]])
+                   [tuple(h) for h in d["hazards"]],
+                   list(d.get("params", [])),
+                   [tuple(c) for c in d.get("calls", [])])
 
 
 class ModuleSummary:
     """Facts one module exports to its importers."""
 
-    __slots__ = ("module", "path", "functions", "declared_axes")
+    __slots__ = ("module", "path", "functions", "declared_axes",
+                 "imports", "locks")
 
-    def __init__(self, module, path, functions, declared_axes):
+    def __init__(self, module, path, functions, declared_axes,
+                 imports=None, locks=None):
         self.module = module
         self.path = path
         self.functions = functions       # {name: FnSummary}
         self.declared_axes = declared_axes
+        # serialized import table: [{"kind": "import"|"from",
+        #   "module": str, "level": int, "names": [[name, asname], ...]}]
+        # — lets the context resolve the SECOND import hop from cached
+        # summaries without re-parsing the intermediate file
+        self.imports = imports or []
+        # {"model": locks.LockModel dict,
+        #  "functions": {qualname: locks.FnLockFacts dict}}
+        self.locks = locks or {"model": {}, "functions": {}}
 
     def to_dict(self):
         return {"module": self.module, "path": self.path,
                 "functions": {k: v.to_dict()
                               for k, v in self.functions.items()},
-                "declared_axes": sorted(self.declared_axes)}
+                "declared_axes": sorted(self.declared_axes),
+                "imports": self.imports, "locks": self.locks}
 
     @classmethod
     def from_dict(cls, d):
         return cls(d["module"], d["path"],
                    {k: FnSummary.from_dict(v)
                     for k, v in d.get("functions", {}).items()},
-                   set(d.get("declared_axes", [])))
+                   set(d.get("declared_axes", [])),
+                   d.get("imports", []),
+                   d.get("locks"))
 
 
-def _fn_hazards(func, mod_rng):
-    """Direct host-sync/RNG hazard sites in `func`'s body, computed with
-    EVERY parameter tainted (the summary answers "what if a tracer is
-    passed?"). `mod_rng` is the module's (random_aliases, random_names,
-    np_random_aliases, np_random_names, np_aliases, np_names) tuple."""
+def _fn_facts(func, mod_rng):
+    """(hazards, params, calls) for `func`, computed with EVERY parameter
+    tainted (the summary answers "what if a tracer is passed?").
+    `mod_rng` is the module's (random_aliases, random_names,
+    np_random_aliases, np_random_names, np_aliases, np_names) tuple.
+
+    Hazards cover direct host-sync/RNG sites plus 'ctl' entries — a
+    branch test that *directly names* a parameter (deriving the branch
+    through intermediate locals is a documented blind spot; requiring the
+    direct read keeps the summary precise enough to match call-site
+    arguments to the offending parameter).  `calls` records outgoing
+    dotted calls with an any-argument-parameter-derived bit, feeding
+    depth>1 folding."""
     args = func.args
     params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
               if a.arg not in ("self", "cls")]
@@ -284,9 +341,27 @@ def _fn_hazards(func, mod_rng):
     (rand_alias, rand_names, npr_alias, npr_names, np_alias,
      np_names) = mod_rng
     hazards = []
+    calls = []
+    param_set = set(params)
     for node in ast.walk(func):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)) \
+                and taint.is_tainted(node.test):
+            deps = sorted(_names_in(node.test) & param_set)
+            if deps:
+                word = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression",
+                        ast.Assert: "assert"}[type(node)]
+                hazards.append(("ctl", node.lineno,
+                                "%s on parameter %s"
+                                % (word, "/".join(repr(d) for d in deps)),
+                                deps))
+            continue
         if not isinstance(node, ast.Call):
             continue
+        chain = _dotted(node.func)
+        if chain and len(calls) < 60:
+            calls.append((node.lineno, ".".join(chain),
+                          _any_arg_tainted(taint, node)))
         f = node.func
         if isinstance(f, ast.Attribute):
             if f.attr in _SYNC_METHODS and taint.is_tainted(f.value):
@@ -313,7 +388,11 @@ def _fn_hazards(func, mod_rng):
                 hazards.append(("rng", node.lineno, "%s()" % f.id))
             elif f.id in np_names and _any_arg_tainted(taint, node):
                 hazards.append(("sync", node.lineno, "%s()" % f.id))
-    return hazards
+    return hazards, params, calls
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
 
 def _any_arg_tainted(taint, call):
@@ -366,6 +445,23 @@ def _rng_imports(tree):
             np_names)
 
 
+def _import_table(tree):
+    """Serialized Import/ImportFrom nodes (module-level only — a
+    function-local import is invisible to importers anyway)."""
+    table = []
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            table.append({"kind": "import", "module": "", "level": 0,
+                          "names": [[a.name, a.asname]
+                                    for a in node.names]})
+        elif isinstance(node, ast.ImportFrom):
+            table.append({"kind": "from", "module": node.module or "",
+                          "level": node.level,
+                          "names": [[a.name, a.asname]
+                                    for a in node.names]})
+    return table
+
+
 def summarize_source(source, module, path):
     """Build a ModuleSummary from source text (no filesystem access)."""
     try:
@@ -379,11 +475,17 @@ def summarize_source(source, module, path):
             continue
         args = node.args
         arity = len(args.posonlyargs) + len(args.args)
+        hazards, params, calls = _fn_facts(node, mod_rng)
         functions[node.name] = FnSummary(
-            node.name, arity, args.vararg is not None,
-            _fn_hazards(node, mod_rng))
-    return ModuleSummary(module, path, functions,
-                         collect_declared_axes(tree))
+            node.name, arity, args.vararg is not None, hazards,
+            params=params, calls=calls)
+    model, lock_facts = _locks.module_lock_facts(tree)
+    return ModuleSummary(
+        module, path, functions, collect_declared_axes(tree),
+        imports=_import_table(tree),
+        locks={"model": model.to_dict(),
+               "functions": {q: f.to_dict()
+                             for q, f in lock_facts.items()}})
 
 
 # ---------------------------------------------------------------------------
@@ -449,10 +551,15 @@ class ProjectContext:
     """Module-name → file map + lazily computed summaries for a set of
     package roots. Handed to ModuleInfo/rules via `lint_paths`."""
 
-    def __init__(self, roots, cache_path=None, lint_version=0):
+    def __init__(self, roots, cache_path=None, lint_version=0, depth=None):
         self.roots = sorted({os.path.abspath(r) for r in roots if r})
+        self.depth = _env_depth() if depth is None else max(1, int(depth))
         self._modules = {}          # dotted name -> path
         self._summaries = {}        # dotted name -> ModuleSummary | None
+        self._imports_maps = {}     # dotted name -> {alias: (mod, sym)}
+        self._effective = {}        # (mod, fn, budget) -> FnSummary
+        self._lock_edges = None
+        self._lock_cycles = None
         self._axes = None
         self._digest = None
         self._cache = (SummaryCache(cache_path, lint_version)
@@ -488,22 +595,36 @@ class ProjectContext:
         Import/ImportFrom node, restricted to modules in this project.
         `module_name` (the importer's dotted name) anchors relative
         imports; None limits resolution to absolute ones."""
-        out = {}
         if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name not in self._modules:
+            entry = {"kind": "import", "module": "", "level": 0,
+                     "names": [[a.name, a.asname] for a in node.names]}
+        elif isinstance(node, ast.ImportFrom):
+            entry = {"kind": "from", "module": node.module or "",
+                     "level": node.level,
+                     "names": [[a.name, a.asname] for a in node.names]}
+        else:
+            return {}
+        return self._resolve_import_entry(module_name, entry)
+
+    def _resolve_import_entry(self, module_name, entry):
+        """Same resolution from the serialized form a `ModuleSummary`
+        carries — the second import hop resolves from cached summaries
+        without re-parsing the intermediate file."""
+        out = {}
+        if entry["kind"] == "import":
+            for name, asname in entry["names"]:
+                if name not in self._modules:
                     continue
-                if alias.asname:        # import a.b.c as x → x is a.b.c
-                    out[alias.asname] = (alias.name, None)
+                if asname:              # import a.b.c as x → x is a.b.c
+                    out[asname] = (name, None)
                 else:                   # import a.b.c → binds `a`
-                    top = alias.name.split(".")[0]
+                    top = name.split(".")[0]
                     if top in self._modules:
                         out[top] = (top, None)
             return out
-        if not isinstance(node, ast.ImportFrom):
-            return out
-        base = node.module or ""
-        if node.level:
+        base = entry["module"]
+        level = entry["level"]
+        if level:
             if not module_name:
                 return out
             parts = module_name.split(".")
@@ -514,20 +635,62 @@ class ProjectContext:
             # more package
             path = self._modules.get(module_name, "")
             is_pkg = os.path.basename(path) == "__init__.py"
-            drop = node.level - 1 if is_pkg else node.level
+            drop = level - 1 if is_pkg else level
             if drop > len(parts):
                 return out
             anchor = parts[:len(parts) - drop]
             if not anchor:
                 return out
             base = ".".join(anchor + ([base] if base else []))
-        for alias in node.names:
-            target = "%s.%s" % (base, alias.name) if base else alias.name
+        for name, asname in entry["names"]:
+            target = "%s.%s" % (base, name) if base else name
             if target in self._modules:
-                out[alias.asname or alias.name] = (target, None)
+                out[asname or name] = (target, None)
             elif base in self._modules:
-                out[alias.asname or alias.name] = (base, alias.name)
+                out[asname or name] = (base, name)
         return out
+
+    def imports_map(self, dotted):
+        """{alias: (module, symbol|None)} for a module, from its cached
+        summary's import table."""
+        if dotted in self._imports_maps:
+            return self._imports_maps[dotted]
+        summ = self.summary(dotted)
+        table = {}
+        if summ is not None:
+            for entry in summ.imports:
+                table.update(self._resolve_import_entry(dotted, entry))
+        self._imports_maps[dotted] = table
+        return table
+
+    def resolve_function(self, dotted_module, chain):
+        """(module, function) for a dotted call chain as seen from inside
+        `dotted_module` — a same-module helper, an imported symbol, an
+        imported module's attribute, or an absolute path.  None when the
+        chain leaves the project (or names a method)."""
+        if not chain:
+            return None
+        summ = self.summary(dotted_module)
+        if summ is None:
+            return None
+        if len(chain) == 1 and chain[0] in summ.functions:
+            return (dotted_module, chain[0])
+        head = self.imports_map(dotted_module).get(chain[0])
+        if head is not None:
+            module, symbol = head
+            if symbol is not None:
+                return (module, symbol) if len(chain) == 1 else None
+            for part in chain[1:-1]:
+                nxt = module + "." + part
+                if nxt not in self._modules:
+                    return None
+                module = nxt
+            return (module, chain[-1]) if len(chain) > 1 else None
+        if len(chain) >= 2:
+            module = ".".join(chain[:-1])
+            if module in self._modules:
+                return (module, chain[-1])
+        return None
 
     def summary(self, dotted):
         """ModuleSummary for a project module (None for unknown ones)."""
@@ -552,10 +715,164 @@ class ProjectContext:
         return summ
 
     def function_summary(self, dotted_module, fn_name):
+        """*Effective* summary of a function: its own hazards plus the
+        hazards of callees up to `self.depth` import hops away, folded in
+        at the call line.  'sync'/'ctl' hazards propagate only along
+        calls whose arguments are parameter-derived; 'rng' propagates
+        unconditionally.  A folded-in 'ctl' hazard loses its parameter
+        map (deps=None): any tainted argument at the outer call site
+        triggers it."""
+        return self._effective_summary(dotted_module, fn_name,
+                                       self.depth, ())
+
+    def _effective_summary(self, module, fn_name, budget, stack):
+        summ = self.summary(module)
+        if summ is None:
+            return None
+        base = summ.functions.get(fn_name)
+        if base is None or budget <= 1 or (module, fn_name) in stack:
+            return base
+        key = (module, fn_name, budget)
+        if key in self._effective:
+            return self._effective[key]
+        hazards = list(base.hazards)
+        stack = stack + ((module, fn_name),)
+        for line, chain_str, arg_derived in base.calls:
+            if len(hazards) >= 30:
+                break
+            res = self.resolve_function(module, chain_str.split("."))
+            if res is None or res == (module, fn_name):
+                continue
+            eff = self._effective_summary(res[0], res[1], budget - 1,
+                                          stack)
+            if eff is None:
+                continue
+            callee_path = os.path.basename(self.summary(res[0]).path)
+            for h in eff.hazards:
+                kind = h[0]
+                if kind in ("sync", "ctl") and not arg_derived:
+                    continue
+                detail = "%s() -> %s [%s:%d]" % (chain_str, h[2],
+                                                 callee_path, h[1])
+                hazards.append((kind, line, detail, None)
+                               if kind == "ctl" else (kind, line, detail))
+        eff = FnSummary(base.name, base.arity, base.has_vararg, hazards,
+                        params=base.params, calls=base.calls)
+        self._effective[key] = eff
+        return eff
+
+    # ------------------------------------------------------ lock graph
+    def function_lock_facts(self, dotted_module, qualname):
+        """Raw `locks.FnLockFacts` dict for one function/method."""
         summ = self.summary(dotted_module)
         if summ is None:
             return None
-        return summ.functions.get(fn_name)
+        return summ.locks.get("functions", {}).get(qualname)
+
+    def lock_edges(self):
+        """Project-wide lock-order edges: ``[(a, b, info)]`` with
+        module-qualified lock ids (``pkg.mod:NAME`` /
+        ``pkg.mod:Class.attr``).  Intra-function edges come straight from
+        the summaries; calling, under a held lock, a helper (same module
+        or one import hop away) that acquires its own lock contributes a
+        cross-function edge attributed to the call site.  `info` is
+        ``{"file", "line", "fn", "held_line", "via"}``."""
+        if self._lock_edges is not None:
+            return self._lock_edges
+        edges = []
+        for module in sorted(self._modules):
+            summ = self.summary(module)
+            if summ is None:
+                continue
+            fns = summ.locks.get("functions", {})
+            for qual in sorted(fns):
+                facts = fns[qual]
+                for a, b, a_line, b_line in facts.get("edges", []):
+                    edges.append((
+                        self._qualify_lock(module, a),
+                        self._qualify_lock(module, b),
+                        {"file": summ.path, "line": b_line, "fn": qual,
+                         "held_line": a_line, "via": None}))
+                for chain_str, line, held in facts.get("held_calls", []):
+                    res = self._resolve_lock_callee(module, qual,
+                                                    chain_str)
+                    if res is None:
+                        continue
+                    callee_mod, callee_facts = res
+                    for b, b_line in callee_facts.get("acquires", []):
+                        qb = self._qualify_lock(callee_mod, b)
+                        for a in held:
+                            qa = self._qualify_lock(module, a)
+                            if qa == qb:
+                                continue
+                            edges.append((
+                                qa, qb,
+                                {"file": summ.path, "line": line,
+                                 "fn": qual, "held_line": line,
+                                 "via": "%s() acquires %s at %s:%d"
+                                        % (chain_str, b,
+                                           os.path.basename(
+                                               self.summary(
+                                                   callee_mod).path),
+                                           b_line)}))
+        self._lock_edges = edges
+        return edges
+
+    def _qualify_lock(self, module, lock_id):
+        """Module-qualified lock id.  ``@mod.ATTR`` references (a lock
+        reached through an imported module's attribute) and ``~NAME``
+        fallbacks that turn out to be imported lock symbols both resolve
+        to the *owning* module's id, so ``with a.LOCK:`` in one file and
+        ``with LOCK:`` in its home file land on the same graph node."""
+        if lock_id.startswith("@"):
+            chain = lock_id[1:].split(".")
+            head = self.imports_map(module).get(chain[0])
+            if head is not None and head[1] is None and len(chain) == 2:
+                owner = self.summary(head[0])
+                if owner is not None and chain[1] in \
+                        owner.locks.get("model", {}).get("module_locks",
+                                                         {}):
+                    return "%s:%s" % (head[0], chain[1])
+        elif lock_id.startswith("~"):
+            head = self.imports_map(module).get(lock_id[1:])
+            if head is not None and head[1] is not None:
+                owner = self.summary(head[0])
+                if owner is not None and head[1] in \
+                        owner.locks.get("model", {}).get("module_locks",
+                                                         {}):
+                    return "%s:%s" % (head[0], head[1])
+        return "%s:%s" % (module, lock_id)
+
+    def _resolve_lock_callee(self, module, caller_qual, chain_str):
+        """(module, FnLockFacts dict) for a call made under a lock: a
+        same-class method (``self.meth``), a same-module function, or a
+        function one import hop away."""
+        chain = chain_str.split(".")
+        summ = self.summary(module)
+        fns = summ.locks.get("functions", {})
+        if chain[0] == "self" and len(chain) == 2 and "." in caller_qual:
+            cls = caller_qual.split(".")[0]
+            qual = "%s.%s" % (cls, chain[1])
+            if qual in fns:
+                return (module, fns[qual])
+            return None
+        if len(chain) == 1 and chain[0] in fns:
+            return (module, fns[chain[0]])
+        res = self.resolve_function(module, chain)
+        if res is None:
+            return None
+        target = self.summary(res[0])
+        if target is None:
+            return None
+        facts = target.locks.get("functions", {}).get(res[1])
+        return (res[0], facts) if facts is not None else None
+
+    def lock_cycles(self):
+        """Cycles in the project lock-order graph (`locks.find_cycles`),
+        computed once per context."""
+        if self._lock_cycles is None:
+            self._lock_cycles = _locks.find_cycles(self.lock_edges())
+        return self._lock_cycles
 
     def declared_axes(self):
         """Union of mesh axes declared anywhere in the project."""
@@ -575,6 +892,7 @@ class ProjectContext:
         if self._digest is None:
             import hashlib
             h = hashlib.sha1()
+            h.update(("depth=%d;" % self.depth).encode())
             for dotted in sorted(self._modules):
                 path = self._modules[dotted]
                 try:

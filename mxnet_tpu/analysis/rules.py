@@ -26,7 +26,7 @@ from .taint import TaintTracker, UNTAINTED_CALLS
 __all__ = ["RULES", "register", "Rule", "rule_table", "LINT_VERSION"]
 
 # bump when rule logic changes — invalidates the per-file mtime cache
-LINT_VERSION = 7
+LINT_VERSION = 8
 
 RULES = {}
 
@@ -380,6 +380,58 @@ class DataDependentControlFlow(Rule):
                          "on-device iteration",
                     severity=Severity.WARNING,
                     symbol=fn.qualname)
+            elif isinstance(node, ast.Call):
+                cross = self._cross_file_ctl(fn, mod, node)
+                if cross is not None:
+                    yield cross
+
+    def _cross_file_ctl(self, fn, mod, node):
+        """Cross-file taint: a call from a traced body into an imported
+        project helper whose summary says it *branches* on a parameter
+        we pass a traced value for. The finding lands at the traced
+        CALL SITE and names the helper's own branch line. Deps lost in
+        deep folding (`deps is None`) fall back to any-tainted-arg."""
+        if mod.project is None:
+            return None
+        tainted_pos = [i for i, a in enumerate(node.args)
+                       if fn.taint.is_tainted(a)]
+        tainted_kw = {kw.arg for kw in node.keywords
+                      if kw.arg and fn.taint.is_tainted(kw.value)}
+        if not tainted_pos and not tainted_kw:
+            return None
+        res = mod.resolve_callee(dotted(node.func) or [])
+        if res is None:
+            return None
+        summ = mod.project.function_summary(*res)
+        if summ is None:
+            return None
+        params = summ.params or []
+        tainted_params = set(tainted_kw)
+        for i in tainted_pos:
+            if i < len(params):
+                tainted_params.add(params[i])
+            elif summ.has_vararg:
+                tainted_params.add("*")
+        for h in summ.hazards:
+            if h[0] != "ctl":
+                continue
+            deps = h[3] if len(h) > 3 else None
+            if deps is not None and not (set(deps) & tainted_params):
+                continue
+            _, line, detail = h[0], h[1], h[2]
+            helper = "%s.%s" % res
+            return self._finding(
+                mod, node,
+                "call into %s() branches on its argument (%s at %s:%d) "
+                "and we pass it a traced value — the predicate has no "
+                "host value under trace"
+                % (helper, detail,
+                   os.path.basename(mod.project.summary(res[0]).path),
+                   line),
+                hint="pass a static value, or push the select into the "
+                     "helper with F.where",
+                symbol=fn.qualname)
+        return None
 
 
 # --------------------------------------------------------------------------
@@ -623,172 +675,10 @@ class HostRngUnderTrace(Rule):
             symbol=fn.qualname)
 
 
-# --------------------------------------------------------------------------
-# TPU006 — concurrency: module-level mutable state from threads, no lock
-# --------------------------------------------------------------------------
-_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
-                  "deque", "Counter"}
-_LOCKISH_MARKERS = ("lock", "cond", "mutex", "sem", "_mu")
-
-
-def _is_lockish(expr):
-    chain = dotted(expr if not isinstance(expr, ast.Call) else expr.func)
-    if not chain:
-        return False
-    last = chain[-1].lower()
-    return any(m in last for m in _LOCKISH_MARKERS)
-
-
-@register
-class ThreadSharedStateLint(Rule):
-    code = "TPU006"
-    name = "thread-shared-state"
-    severity = Severity.WARNING
-    scope = "module"
-    description = ("module-level mutable state mutated from a "
-                   "thread-reachable function without holding a lock — "
-                   "the runtime's own telemetry/kvstore/watchdog threads "
-                   "must serialize through their registry locks.")
-    hint = ("wrap the mutation in `with <lock>:` (see telemetry.metrics."
-            "Registry) or hand the update to the owning thread")
-
-    def check_module(self, mod):
-        mutables = self._module_mutables(mod.tree)
-        if not mutables:
-            return
-        thread_fns = self._thread_reachable(mod)
-        if not thread_fns:
-            return
-        for func in thread_fns:
-            yield from self._check_mutations(func, mutables, mod)
-
-    @staticmethod
-    def _module_mutables(tree):
-        out = set()
-        for node in tree.body:
-            targets = []
-            if isinstance(node, ast.Assign):
-                targets = node.targets
-                value = node.value
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                targets = [node.target]
-                value = node.value
-            else:
-                continue
-            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
-            if isinstance(value, ast.Call):
-                chain = dotted(value.func) or []
-                mutable = bool(chain) and chain[-1] in _MUTABLE_CTORS
-            if mutable:
-                for t in targets:
-                    out |= _target_names(t)
-        return out
-
-    @staticmethod
-    def _thread_entries(mod):
-        entries = set()
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            chain = dotted(node.func) or []
-            if not chain or chain[-1] != "Thread":
-                continue
-            for kw in node.keywords:
-                if kw.arg != "target":
-                    continue
-                tchain = dotted(kw.value)
-                if tchain:
-                    entries.add(tchain[-1])
-        # Thread subclasses: their run() is the entry
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef) and any(
-                    (dotted(b) or [""])[-1] == "Thread" for b in node.bases):
-                entries.add("run")
-        return entries
-
-    def _thread_reachable(self, mod):
-        entries = self._thread_entries(mod)
-        if not entries:
-            return []
-        by_name = {}
-        for func in mod.all_functions:
-            by_name.setdefault(func.name, []).append(func)
-        seen = set()
-        work = sorted(entries)
-        for _ in range(3):  # bounded transitive closure
-            nxt = []
-            for name in work:
-                if name in seen or name not in by_name:
-                    continue
-                seen.add(name)
-                for func in by_name[name]:
-                    for node in ast.walk(func):
-                        if isinstance(node, ast.Call):
-                            chain = dotted(node.func)
-                            if chain:
-                                nxt.append(chain[-1])
-            work = nxt
-        out = []
-        for name in seen:
-            out.extend(by_name.get(name, []))
-        return out
-
-    def _check_mutations(self, func, mutables, mod):
-        yield from self._walk_body(func.body, func, mutables, mod,
-                                   under_lock=False)
-
-    def _walk_body(self, body, func, mutables, mod, under_lock):
-        for node in body:
-            if isinstance(node, (ast.With, ast.AsyncWith)):
-                locked = under_lock or any(
-                    _is_lockish(item.context_expr) for item in node.items)
-                yield from self._walk_body(node.body, func, mutables, mod,
-                                           locked)
-                continue
-            if not under_lock:
-                yield from self._check_stmt(node, func, mutables, mod)
-            # recurse into nested bodies preserving lock state
-            for attr in ("body", "orelse", "finalbody"):
-                sub = getattr(node, attr, None)
-                if sub and not isinstance(node, (ast.With, ast.AsyncWith)):
-                    yield from self._walk_body(sub, func, mutables, mod,
-                                               under_lock)
-            for handler in getattr(node, "handlers", []):
-                yield from self._walk_body(handler.body, func, mutables,
-                                           mod, under_lock)
-
-    def _check_stmt(self, node, func, mutables, mod):
-        mutated = None
-        if isinstance(node, (ast.Assign, ast.AugAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) \
-                else [node.target]
-            for t in targets:
-                if isinstance(t, ast.Subscript) and \
-                        isinstance(t.value, ast.Name) and \
-                        t.value.id in mutables:
-                    mutated = t.value.id
-        elif isinstance(node, ast.Delete):
-            for t in node.targets:
-                if isinstance(t, ast.Subscript) and \
-                        isinstance(t.value, ast.Name) and \
-                        t.value.id in mutables:
-                    mutated = t.value.id
-        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
-            callee = node.value.func
-            if isinstance(callee, ast.Attribute) and \
-                    callee.attr in _MUTATORS and \
-                    isinstance(callee.value, ast.Name) and \
-                    callee.value.id in mutables:
-                mutated = callee.value.id
-        if mutated is not None:
-            yield self._finding(
-                mod, node,
-                "module-level mutable %r mutated from thread-reachable "
-                "%s() without holding a lock" % (mutated, func.name),
-                symbol=func.name)
-
-
 # TPU007/TPU008 live in their own module (they share the project-level
 # mesh-axis machinery); importing registers them. Deliberately last:
 # spmd_rules imports Rule/register from this partially-initialized module.
 from . import spmd_rules  # noqa: E402,F401
+# TPU006/TPU009/TPU010 (the lock-model concurrency passes) likewise
+# live in their own module and register on import.
+from . import concurrency_rules  # noqa: E402,F401

@@ -597,8 +597,12 @@ class FusedTrainStep:
                     jax.value_and_grad(loss_fn, has_aux=True)(train_raws)
                 if self._bucket_mb is not None:
                     # bucket-wise grad regrouping (identity math; one fused
-                    # flat tensor per bucket in the lowered program)
-                    grads = tuple(_engine.reassociate_bucketed(
+                    # flat tensor per bucket in the lowered program).
+                    # reassociate_bucketed's float()/`if raws` act on the
+                    # static bucket_mb arg and the Python list length, not
+                    # on the grad tracers — the all-params-tainted summary
+                    # can't see that.
+                    grads = tuple(_engine.reassociate_bucketed(  # tpu-lint: disable=TPU001,TPU003
                         list(grads), self._bucket_mb))
                 new_train, new_states = [], []
                 for j in range(len(train_raws)):

@@ -57,7 +57,11 @@ def lib():
         if not os.path.exists(_OUT) or (
                 os.path.exists(_SRC) and
                 os.path.getmtime(_SRC) > os.path.getmtime(_OUT)):
-            if not os.path.exists(_SRC) or not _build():
+            # holding _lock across the compile is the point: concurrent
+            # first callers must WAIT for the one build, not race g++ or
+            # observe a half-written .so — and nothing else ever contends
+            # for this lock after the first call resolves
+            if not os.path.exists(_SRC) or not _build():  # tpu-lint: disable=TPU010
                 return None
         try:
             cdll = ctypes.CDLL(_OUT)

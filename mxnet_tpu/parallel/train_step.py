@@ -289,7 +289,10 @@ class ShardedTrainStep:
                 # program carries one fused flat tensor per bucket, so the
                 # GSPMD-inserted cross-replica reductions combine bucket-wise
                 leaves, tree = jax.tree_util.tree_flatten(grads)
-                leaves = _engine.reassociate_bucketed(leaves, bucket_mb)
+                # reassociate_bucketed's float()/`if raws` act on the static
+                # bucket_mb arg and the Python list length, not the leaf
+                # tracers — the all-params-tainted summary can't see that
+                leaves = _engine.reassociate_bucketed(leaves, bucket_mb)  # tpu-lint: disable=TPU001,TPU003
                 grads = jax.tree_util.tree_unflatten(tree, leaves)
             cur_lr = lr(step_num) if callable(lr) else lr
             new_params, new_state = opt_update(

@@ -119,8 +119,9 @@ class Watchdog:
     """Monitor thread + per-thread guard registry."""
 
     def __init__(self, poll_floor_s=0.005):
+        from ..analysis import lockguard
         self._entries = {}  # thread ident -> _Entry
-        self._cond = threading.Condition()
+        self._cond = lockguard.condition("resilience.watchdog")
         self._thread = None
         self._poll_floor_s = poll_floor_s
 

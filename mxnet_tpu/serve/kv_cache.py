@@ -142,7 +142,8 @@ class KVBlockPool:
         self._nodes = {}            # node key -> _PrefixNode
         self._roots = set()         # node keys with parent None
         self._lru_clock = 0
-        self._lock = threading.Lock()
+        from ..analysis import lockguard
+        self._lock = lockguard.lock("serve.kv_pool")
 
     # ------------------------------------------------------------- geometry
     def blocks_for(self, n_tokens):

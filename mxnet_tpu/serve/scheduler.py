@@ -289,9 +289,10 @@ class RequestQueue:
     FRONT — a stream must never be shed by its own replica's death."""
 
     def __init__(self, cap=None):
+        from ..analysis import lockguard
         self.cap = int(cap or default_queue_cap())
         self._items = deque()
-        self._cond = threading.Condition()
+        self._cond = lockguard.condition("serve.queue")
 
     def push(self, stream):
         with self._cond:

@@ -146,7 +146,10 @@ class Registry:
 
     def __init__(self):
         self._metrics = {}
-        self._lock = threading.Lock()
+        # deferred import: analysis loads after telemetry in the package
+        # __init__, and lockguard only needs ..base at module level
+        from ..analysis import lockguard
+        self._lock = lockguard.lock("telemetry.registry")
 
     def _get_or_create(self, name, cls, *args):
         metric = self._metrics.get(name)

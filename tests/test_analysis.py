@@ -495,7 +495,7 @@ def test_rule_registry_complete():
     table = analysis.rule_table()
     got = [row[0] for row in table]
     assert got == ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
-                   "TPU006", "TPU007", "TPU008"]
+                   "TPU006", "TPU007", "TPU008", "TPU009", "TPU010"]
     assert all(row[4] for row in table)  # every rule documented
 
 
@@ -594,9 +594,9 @@ def test_parse_log_lint_mode(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "| severity | code | location | symbol | message |" in r.stdout
     assert "TPU001" in r.stdout
-    # per-rule rollup table rides along
-    assert "| rule | severity | count |" in r.stdout
-    assert "| TPU001 | error | 1 |" in r.stdout
+    # per-rule rollup table rides along, naming the rule
+    assert "| rule | name | severity | count |" in r.stdout
+    assert "| TPU001 | host-sync-under-trace | error | 1 |" in r.stdout
 
 
 # ===========================================================================
@@ -1298,6 +1298,101 @@ def test_cross_file_cache_invalidates_when_helper_changes(tmp_path):
     (pkg / "helpers.py").write_text(_XF_HELPER_FIXED)
     second = analysis.lint_paths([str(pkg)], cache=cache)
     assert [f for f in second if f.code in ("TPU001", "TPU005")] == []
+
+
+_XF_BRANCHY = """
+def route(x, flag):
+    if flag > 0:
+        return x * 2
+    return x
+"""
+
+
+def _write_ctl_pkg(tmp_path, call):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helpers.py").write_text(_XF_BRANCHY)
+    (pkg / "model.py").write_text(
+        "import jax\n"
+        "from .helpers import route\n\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return %s\n" % call)
+    return pkg
+
+
+def test_cross_file_ctl_flags_helper_branch_at_call_site(tmp_path):
+    pkg = _write_ctl_pkg(tmp_path, "route(x, x.sum())")
+    hits = [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU003"]
+    assert len(hits) == 1
+    assert hits[0].file.endswith("model.py")
+    assert "pkg.helpers.route" in hits[0].message
+    assert "if on parameter 'flag'" in hits[0].message
+    assert "helpers.py:3" in hits[0].message
+
+
+def test_cross_file_ctl_keyword_argument_maps_to_parameter(tmp_path):
+    pkg = _write_ctl_pkg(tmp_path, "route(2, flag=x.sum())")
+    hits = [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU003"]
+    assert len(hits) == 1 and "flag" in hits[0].message
+
+
+def test_cross_file_ctl_clean_when_branch_param_is_static(tmp_path):
+    # the traced value flows into `x`, the branch is on static `flag`
+    pkg = _write_ctl_pkg(tmp_path, "route(x, 3)")
+    assert [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU003"] == []
+
+
+def _write_depth_pkg(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "deep.py").write_text(
+        "def pull(v):\n"
+        "    return float(v.sum())\n")
+    (pkg / "mid.py").write_text(
+        "from .deep import pull\n\n"
+        "def stage(y):\n"
+        "    return pull(y)\n")
+    (pkg / "model.py").write_text(
+        "import jax\n"
+        "from .mid import stage\n\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return stage(x)\n")
+    return pkg
+
+
+def test_import_depth_two_reaches_second_hop(tmp_path):
+    pkg = _write_depth_pkg(tmp_path)
+    hits = [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU001"]
+    assert len(hits) == 1
+    assert hits[0].file.endswith("model.py")
+    assert "pkg.mid.stage" in hits[0].message
+    assert "deep.py" in hits[0].message  # names the second-hop sync
+
+
+def test_import_depth_env_knob_limits_folding(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACELINT_IMPORT_DEPTH", "1")
+    pkg = _write_depth_pkg(tmp_path)
+    hits = [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU001"]
+    assert hits == []
+
+
+def test_depth_changes_project_digest(tmp_path):
+    from mxnet_tpu.analysis.engine import build_project
+    pkg = _write_depth_pkg(tmp_path)
+    from mxnet_tpu.analysis.project import ProjectContext
+    from mxnet_tpu.analysis.rules import LINT_VERSION
+    d2 = ProjectContext([str(pkg)], lint_version=LINT_VERSION, depth=2)
+    d1 = ProjectContext([str(pkg)], lint_version=LINT_VERSION, depth=1)
+    assert d2.digest() != d1.digest()
 
 
 def test_summary_cache_round_trip(tmp_path):

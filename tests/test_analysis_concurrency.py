@@ -1,0 +1,674 @@
+"""tracelint concurrency analysis: the static lock model (analysis.locks),
+the TPU009 lock-order-inversion / TPU010 blocking-under-lock /
+TPU006-v2 guarded-state rules, the project-wide lock-order graph, and the
+runtime lock-order guard (analysis.lockguard) with its env gating."""
+import ast
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import warnings
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import LockOrderError, Severity, check_source
+from mxnet_tpu.analysis import locks as locksmod
+from mxnet_tpu.analysis import lockguard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(src, rules=None):
+    return check_source(textwrap.dedent(src), filename="fixture.py",
+                        rules=rules)
+
+
+def only(findings, code):
+    return [f for f in findings if f.code == code]
+
+
+def _facts(src):
+    tree = ast.parse(textwrap.dedent(src))
+    return locksmod.module_lock_facts(tree)
+
+
+# ===========================================================================
+# static lock model
+# ===========================================================================
+def test_lock_model_discovers_module_and_class_locks():
+    model, facts = _facts("""
+    import threading
+    _LOCK = threading.Lock()
+    _COND = threading.Condition()
+    class Pool:
+        SHARED = threading.RLock()
+        def __init__(self):
+            self._lock = threading.Lock()
+    """)
+    assert set(model.module_locks) == {"_LOCK", "_COND"}
+    assert model.class_locks["Pool"].keys() == {"_lock", "SHARED"}
+
+
+def test_lock_model_sees_lockguard_factories():
+    model, _ = _facts("""
+    from mxnet_tpu.analysis import lockguard
+    _L = lockguard.lock("telemetry.registry")
+    class Q:
+        def __init__(self):
+            self._cond = lockguard.condition("serve.queue")
+    """)
+    assert "_L" in model.module_locks
+    assert "_cond" in model.class_locks["Q"]
+
+
+def test_fn_lock_facts_acquires_and_edges():
+    _, facts = _facts("""
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+    def f():
+        with A:
+            with B:
+                pass
+    """)
+    f = facts["f"]
+    assert [a[0] for a in f.acquires] == ["A", "B"]
+    assert [(e[0], e[1]) for e in f.edges] == [("A", "B")]
+
+
+def test_fn_lock_facts_sequential_withs_make_no_edge():
+    _, facts = _facts("""
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+    def f():
+        with A:
+            pass
+        with B:
+            pass
+    """)
+    assert facts["f"].edges == []
+
+
+def test_fn_lock_facts_bare_acquire_release_tracks_held():
+    _, facts = _facts("""
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+    def f():
+        A.acquire()
+        with B:
+            pass
+        A.release()
+        with B:
+            pass
+    """)
+    # only the first `with B` runs under A
+    assert [(e[0], e[1]) for e in facts["f"].edges] == [("A", "B")]
+
+
+def test_find_cycles_reports_two_lock_inversion_once():
+    edges = [("A", "B", {"f": 1}), ("B", "A", {"f": 2}),
+             ("B", "A", {"f": 3})]
+    cycles = locksmod.find_cycles(edges)
+    assert len(cycles) == 1
+    assert {(a, b) for a, b, _ in cycles[0]} == {("A", "B"), ("B", "A")}
+
+
+def test_find_cycles_three_lock_ring_and_acyclic_clean():
+    ring = [("A", "B", None), ("B", "C", None), ("C", "A", None)]
+    assert len(locksmod.find_cycles(ring)) == 1
+    dag = [("A", "B", None), ("A", "C", None), ("B", "C", None)]
+    assert locksmod.find_cycles(dag) == []
+
+
+def test_classify_blocking_kinds():
+    def kind(expr):
+        call = ast.parse(expr, mode="eval").body
+        got = locksmod.classify_blocking(call)
+        return got and got[0]
+    assert kind("time.sleep(1)") == "sleep"
+    assert kind("jax.lax.psum(x, 'dp')") == "collective"
+    assert kind("x.asnumpy()") == "host_sync"
+    assert kind("urllib.request.urlopen(u)") == "http"
+    assert kind("subprocess.run(cmd)") == "subprocess"
+    assert kind("self._queue.get()") == "queue"
+    assert kind("self._queue.get(timeout=1)") is None
+    assert kind("math.sqrt(2)") is None
+
+
+# ===========================================================================
+# TPU009 — lock-order inversion
+# ===========================================================================
+_INVERSION = """
+import threading
+A = threading.Lock()
+B = threading.Lock()
+
+def take_ab():
+    with A:
+        with B:
+            pass
+
+def take_ba():
+    with B:
+        with A:
+            pass
+"""
+
+
+def test_tpu009_reports_both_chains_with_lines():
+    hits = only(lint(_INVERSION), "TPU009")
+    assert len(hits) == 1
+    h = hits[0]
+    assert h.severity == Severity.ERROR
+    # both acquisition chains, each with file:line
+    assert "take_ab() acquires B at fixture.py:8" in h.message
+    assert "take_ba() acquires A at fixture.py:13" in h.message
+    assert "holding A" in h.message and "holding B" in h.message
+
+
+def test_tpu009_consistent_hierarchy_clean():
+    f = lint("""
+    import threading
+    A = threading.Lock()
+    B = threading.Lock()
+    def f():
+        with A:
+            with B:
+                pass
+    def g():
+        with A:
+            with B:
+                pass
+    """)
+    assert not only(f, "TPU009")
+
+
+def test_tpu009_instance_lock_inversion_across_methods():
+    f = lint("""
+    import threading
+    class Pool:
+        def __init__(self):
+            self._alloc = threading.Lock()
+            self._index = threading.Lock()
+        def grow(self):
+            with self._alloc:
+                with self._index:
+                    pass
+        def shrink(self):
+            with self._index:
+                with self._alloc:
+                    pass
+    """)
+    hits = only(f, "TPU009")
+    assert len(hits) == 1
+    assert "Pool._alloc" in hits[0].message
+    assert "Pool._index" in hits[0].message
+
+
+def test_tpu009_suppressible():
+    src = _INVERSION.replace("        with B:\n",
+                             "        with B:  # tpu-lint: disable=TPU009\n",
+                             1)
+    assert not only(lint(src), "TPU009")
+
+
+def test_tpu009_cross_module_inversion(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text(textwrap.dedent("""
+        import threading
+        from .b import grab_b
+        LOCK_A = threading.Lock()
+        def forward():
+            with LOCK_A:
+                grab_b()
+    """))
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        import threading
+        from . import a
+        LOCK_B = threading.Lock()
+        def grab_b():
+            with LOCK_B:
+                pass
+        def backward():
+            with LOCK_B:
+                with a.LOCK_A:
+                    pass
+    """))
+    hits = [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU009"]
+    assert len(hits) == 1
+    assert "LOCK_A" in hits[0].message and "LOCK_B" in hits[0].message
+    assert "grab_b" in hits[0].message  # the import-hop edge is named
+
+
+# ===========================================================================
+# TPU010 — blocking under lock
+# ===========================================================================
+def test_tpu010_flags_each_blocking_class():
+    f = lint("""
+    import queue
+    import subprocess
+    import threading
+    import time
+    from urllib.request import urlopen
+    import jax
+    L = threading.Lock()
+    _Q = queue.Queue()
+    def f(x):
+        with L:
+            time.sleep(0.5)
+            y = x.asnumpy()
+            jax.lax.psum(x, "dp")
+            urlopen("http://example.com/cfg")
+            subprocess.run(["ls"])
+            item = _Q.get()
+    """)
+    hits = only(f, "TPU010")
+    assert len(hits) == 6
+    assert all(h.severity == Severity.WARNING for h in hits)
+    assert all("holding L" in h.message for h in hits)
+
+
+def test_tpu010_clean_when_blocking_is_outside_lock():
+    f = lint("""
+    import threading
+    import time
+    L = threading.Lock()
+    def f():
+        with L:
+            n = 1
+        time.sleep(0.5)
+    """)
+    assert not only(f, "TPU010")
+
+
+def test_tpu010_queue_get_with_timeout_clean():
+    f = lint("""
+    import queue
+    import threading
+    L = threading.Lock()
+    _Q = queue.Queue()
+    def f():
+        with L:
+            return _Q.get(timeout=0.1)
+    """)
+    assert not only(f, "TPU010")
+
+
+def test_tpu010_condition_wait_on_own_lock_exempt():
+    # cond.wait() RELEASES the lock it guards — the canonical pattern
+    f = lint("""
+    import threading
+    C = threading.Condition()
+    def f():
+        with C:
+            C.wait()
+    """)
+    assert not only(f, "TPU010")
+
+
+def test_tpu010_cross_function_same_module():
+    f = lint("""
+    import threading
+    import time
+    L = threading.Lock()
+    def slow():
+        time.sleep(1.0)
+    def f():
+        with L:
+            slow()
+    """)
+    hits = only(f, "TPU010")
+    assert len(hits) == 1
+    assert "slow()" in hits[0].message and "holding L" in hits[0].message
+
+
+def test_tpu010_cross_module_blocking(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "io_util.py").write_text(textwrap.dedent("""
+        from urllib.request import urlopen
+        def fetch(url):
+            return urlopen(url).read()
+    """))
+    (pkg / "svc.py").write_text(textwrap.dedent("""
+        import threading
+        from .io_util import fetch
+        _LOCK = threading.Lock()
+        def refresh(url):
+            with _LOCK:
+                return fetch(url)
+    """))
+    hits = [f for f in analysis.lint_paths([str(pkg)])
+            if f.code == "TPU010"]
+    assert len(hits) == 1
+    assert hits[0].file.endswith("svc.py")
+    assert "fetch" in hits[0].message
+
+
+# ===========================================================================
+# TPU006 v2 — guarded-state inference
+# ===========================================================================
+def test_tpu006_infers_majority_lock_and_flags_minority():
+    f = lint("""
+    import threading
+    L = threading.Lock()
+    _STATE = {}
+    def worker():
+        with L:
+            _STATE["a"] = 1
+        with L:
+            _STATE["b"] = 2
+        _STATE["c"] = 3
+    t = threading.Thread(target=worker)
+    t.start()
+    """)
+    hits = only(f, "TPU006")
+    assert len(hits) == 1
+    assert "'L'" in hits[0].message
+    assert "2 of 3" in hits[0].message
+
+
+def test_tpu006_flags_wrong_lock_held():
+    f = lint("""
+    import threading
+    L = threading.Lock()
+    M = threading.Lock()
+    _STATE = {}
+    def worker():
+        with L:
+            _STATE["a"] = 1
+        with L:
+            _STATE["b"] = 2
+        with M:
+            _STATE["c"] = 3
+    t = threading.Thread(target=worker)
+    t.start()
+    """)
+    hits = only(f, "TPU006")
+    assert len(hits) == 1
+    assert "'L'" in hits[0].message and "M" in hits[0].message
+
+
+def test_tpu006_instance_attr_inference():
+    f = lint("""
+    import threading
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+        def put(self, x):
+            with self._lock:
+                self._items.append(x)
+        def put2(self, x):
+            with self._lock:
+                self._items.append(x)
+        def drop(self):
+            self._items.clear()
+        def run(self):
+            self.put(1)
+            self.drop()
+    class Worker(threading.Thread):
+        def __init__(self, pool):
+            super().__init__()
+            self.pool = pool
+        def run(self):
+            self.pool.run()
+    """)
+    hits = only(f, "TPU006")
+    assert len(hits) == 1
+    assert "_items" in hits[0].message and "_lock" in hits[0].message
+
+
+def test_tpu006_all_sites_guarded_clean():
+    f = lint("""
+    import threading
+    L = threading.Lock()
+    _STATE = {}
+    def worker():
+        with L:
+            _STATE["a"] = 1
+        with L:
+            _STATE["b"] = 2
+    t = threading.Thread(target=worker)
+    t.start()
+    """)
+    assert not only(f, "TPU006")
+
+
+def test_tpu006_no_threads_clean():
+    f = lint("""
+    import threading
+    L = threading.Lock()
+    _STATE = {}
+    def main():
+        _STATE["a"] = 1
+    """)
+    assert not only(f, "TPU006")
+
+
+# ===========================================================================
+# runtime lock-order guard
+# ===========================================================================
+@pytest.fixture
+def guard(request):
+    mode = getattr(request, "param", "raise")
+    prev = lockguard.set_mode(mode)
+    lockguard.reset()
+    yield lockguard
+    lockguard.set_mode(prev)
+    lockguard.reset()
+
+
+def _counter(name):
+    return mx.telemetry.snapshot()["counters"].get(name, 0)
+
+
+def test_lockguard_two_thread_inversion_raises_with_both_stacks(guard):
+    a = lockguard.lock("A")
+    b = lockguard.lock("B")
+    seeded = threading.Event()
+    caught = []
+
+    def t1():
+        with a:
+            with b:          # records edge A -> B
+                pass
+        seeded.set()
+
+    def t2():
+        seeded.wait(5)
+        try:
+            with b:
+                with a:      # inverts it
+                    pass
+        except LockOrderError as e:
+            caught.append(e)
+
+    th1 = threading.Thread(target=t1, name="seeder")
+    th2 = threading.Thread(target=t2, name="inverter")
+    th1.start(); th1.join()
+    th2.start(); th2.join()
+
+    assert len(caught) == 1
+    err = caught[0]
+    assert err.edge == ("B", "A")
+    assert err.this_thread == "inverter"
+    assert err.this_chain == ["B"]
+    assert err.other_thread == "seeder"
+    assert err.other_chain == ["A"]
+    assert err.this_stack and err.other_stack
+    assert "--- this thread" in str(err)
+    assert "--- first-observed order" in str(err)
+
+
+def test_lockguard_counts_and_flight_event(guard):
+    from mxnet_tpu.telemetry import flight
+    before = _counter("analysis.guard.lock_order")
+    a, b = lockguard.lock("ga"), lockguard.lock("gb")
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderError):
+        with b:
+            with a:
+                pass
+    assert _counter("analysis.guard.lock_order") == before + 1
+    pending = list(flight._RECORDER._events)
+    assert any(k == "lock_order_inversion" and "gb" in d
+               for k, d, _ in pending)
+
+
+@pytest.mark.parametrize("guard", ["warn"], indirect=True)
+def test_lockguard_warn_mode_warns_once_per_edge(guard):
+    a, b = lockguard.lock("wa"), lockguard.lock("wb")
+    with a:
+        with b:
+            pass
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        for _ in range(3):
+            with b:
+                with a:
+                    pass
+    msgs = [w for w in seen if "lock-order inversion" in str(w.message)]
+    assert len(msgs) == 1
+
+
+def test_lockguard_transitive_inversion(guard):
+    a = lockguard.lock("ta")
+    b = lockguard.lock("tb")
+    c = lockguard.lock("tc")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    # c -> a closes the a -> b -> c ring
+    with pytest.raises(LockOrderError):
+        with c:
+            with a:
+                pass
+
+
+def test_lockguard_rlock_reentrancy_is_not_an_inversion(guard):
+    r = lockguard.rlock("rl")
+    other = lockguard.lock("ol")
+    with r:
+        with other:
+            with r:          # re-entry: no other -> rl edge learned
+                pass
+    with r:
+        with other:          # would invert if re-entry had made an edge
+            pass
+
+
+def test_lockguard_condition_wait_notify_roundtrip(guard):
+    cond = lockguard.condition("cv")
+    ready = []
+
+    def producer():
+        with cond:
+            ready.append(1)
+            cond.notify()
+
+    with cond:
+        t = threading.Thread(target=producer)
+        t.start()
+        got = cond.wait_for(lambda: ready, timeout=5)
+    t.join()
+    assert got
+
+
+def test_lockguard_factories_return_raw_primitives_when_off():
+    prev = lockguard.set_mode("off")
+    try:
+        assert not lockguard.active()
+        assert type(lockguard.lock("x")) is type(threading.Lock())
+        assert isinstance(lockguard.condition("x"), threading.Condition)
+    finally:
+        lockguard.set_mode(prev)
+
+
+_INERT_PROBE = """
+import threading
+from mxnet_tpu.analysis import lockguard
+from mxnet_tpu.telemetry.metrics import Registry
+from mxnet_tpu.serve.scheduler import RequestQueue
+from mxnet_tpu.resilience.watchdog import Watchdog
+
+assert not lockguard.active()
+r = Registry()
+q = RequestQueue(cap=4)
+w = Watchdog()
+# creation-time gating: raw threading primitives, no wrapper in the path
+assert type(r._lock) is type(threading.Lock()), type(r._lock)
+assert not isinstance(getattr(q._cond, "_lock", None), lockguard.GuardedLock)
+assert not isinstance(getattr(w._cond, "_lock", None), lockguard.GuardedLock)
+r.counter("c").inc()
+print("INERT_OK")
+"""
+
+
+def test_lockguard_disabled_env_is_fully_inert():
+    env = dict(os.environ,
+               MXNET_TPU_LOCK_GUARD="0", MXNET_TPU_TELEMETRY="0")
+    out = subprocess.run(
+        [sys.executable, "-c", _INERT_PROBE], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "INERT_OK" in out.stdout
+
+
+_ENV_RAISE_PROBE = """
+from mxnet_tpu.analysis import lockguard, LockOrderError
+assert lockguard.active() and lockguard.mode() == "raise"
+a, b = lockguard.lock("A"), lockguard.lock("B")
+with a:
+    with b:
+        pass
+try:
+    with b:
+        with a:
+            pass
+except LockOrderError as e:
+    assert e.edge == ("B", "A")
+    print("RAISED_OK")
+"""
+
+
+def test_lockguard_env_one_arms_raise_mode():
+    env = dict(os.environ, MXNET_TPU_LOCK_GUARD="1")
+    out = subprocess.run(
+        [sys.executable, "-c", _ENV_RAISE_PROBE], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "RAISED_OK" in out.stdout
+
+
+# ===========================================================================
+# adoption — guarded sites carry their order-class names
+# ===========================================================================
+def test_adopted_sites_use_guarded_locks_when_armed(guard):
+    from mxnet_tpu.telemetry.metrics import Registry
+    from mxnet_tpu.serve.scheduler import RequestQueue
+    r = Registry()
+    q = RequestQueue(cap=2)
+    assert isinstance(r._lock, lockguard.GuardedLock)
+    assert r._lock.name == "telemetry.registry"
+    assert isinstance(q._cond._lock, lockguard.GuardedLock)
+    assert q._cond._lock.name == "serve.queue"
+    r.counter("x").inc()          # exercise the guarded paths
+    import types
+    s = types.SimpleNamespace(owner=None)
+    q.push(s)
+    assert q.pop() is s

@@ -799,6 +799,22 @@ def _print_overlap(rows, fmt):
 # severity ordering for the lint table: errors first, then by location
 _LINT_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
 
+# rule id -> short name for the rollup (static mirror of
+# `mxnet_tpu.analysis.rule_table()` — this tool parses dumps offline and
+# must not import the package)
+_LINT_RULE_NAMES = {
+    "TPU001": "host-sync-under-trace",
+    "TPU002": "side-effect-under-trace",
+    "TPU003": "data-dependent-control-flow",
+    "TPU004": "retrace-hazard",
+    "TPU005": "host-rng-under-trace",
+    "TPU006": "thread-shared-state",
+    "TPU007": "sharding-annotation",
+    "TPU008": "collective-safety",
+    "TPU009": "lock-order-inversion",
+    "TPU010": "blocking-under-lock",
+}
+
 
 def parse_lint(obj):
     """Flatten tracelint JSON (`python -m mxnet_tpu.analysis --format
@@ -846,10 +862,12 @@ def _print_lint(rows, fmt):
         key = (code, sev)
         by_rule[key] = by_rule.get(key, 0) + 1
     print()
-    print("| rule | severity | count |")
-    print("| --- | --- | --- |")
+    print("| rule | name | severity | count |")
+    print("| --- | --- | --- | --- |")
     for code, sev in sorted(by_rule):
-        print("| %s | %s | %d |" % (code, sev, by_rule[(code, sev)]))
+        print("| %s | %s | %s | %d |"
+              % (code, _LINT_RULE_NAMES.get(code, "?"), sev,
+                 by_rule[(code, sev)]))
 
 
 _OVERLAY_SCOPES = ("prefix_cache",)   # bytes shared with another scope

@@ -51,7 +51,11 @@ fi
 # — the serving scheduler/replica threads are TPU006-clean with zero
 # suppressions (tests/test_serve.py asserts it under the lint marker),
 # and the whole-graph compiler package is tracelint-clean with zero
-# suppressions (tests/test_compiler.py asserts it the same way).
+# suppressions (tests/test_compiler.py asserts it the same way). The
+# linter also lints its own runtime guards: mxnet_tpu/analysis/guard.py
+# and lockguard.py sit under the package root, so the lock-order guard
+# must itself pass TPU009/TPU010 (its _GRAPH_LOCK is the one lock the
+# guard holds while checking, and nothing blocking happens under it).
 exec python -m mxnet_tpu.analysis mxnet_tpu tools/mxtop.py \
     tools/prebake_cache.py tools/benchdb.py tools/check_bench.py \
     --fail-on=error "$@"
