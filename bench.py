@@ -658,6 +658,133 @@ def bench_comm(on_accel):
     return payload
 
 
+def bench_comm_readiness(on_accel):
+    """BENCH=comm extra legs (ISSUE 19): the readiness-ordered flush
+    engine and the schedule autotuner, A/B'd against the
+    reverse-registration engine on IDENTICAL traffic (same net, same
+    seed, same batches — only the flush policy differs). Emitted as
+    separate gated rows so check_bench tracks `overlap_frac_*` (up) and
+    `collective_ms_*` (down) as first-class series.
+
+    Reading the rows: `first_flush_before_backward_end=1` is the
+    readiness engine's proof-of-life — the first bucket's collective
+    launched while backward was still running, which the registration
+    engine cannot do by construction (it first sees gradients at step
+    time). `parity_ok` asserts the legs' final parameters stayed
+    bit-identical, i.e. the overlap was free."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, engine, gluon, nd, telemetry
+    from mxnet_tpu.gluon import nn
+
+    steps = 12 if on_accel else 5
+    widths = (512, 512, 256, 256, 128)
+    cap_mb = 0.5   # several buckets per step: flushes can land mid-backward
+
+    def run(comm_ready, env=None):
+        prev_env = {}
+        for k, v in (env or {}).items():
+            prev_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            with engine.bucket_mb_scope(None if env else cap_mb):
+                mx.random.seed(0)
+                rng = _np.random.RandomState(0)
+                net = nn.HybridSequential()
+                with net.name_scope():
+                    for w in widths:
+                        net.add(nn.Dense(w, activation="relu"))
+                    net.add(nn.Dense(10))
+                net.initialize(mx.init.Xavier())
+                tr = gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.05},
+                                   update_on_kvstore=True,
+                                   comm_ready=comm_ready)
+                x = nd.array(rng.randn(64, 256).astype(_np.float32))
+                y = nd.array(rng.randn(64, 10).astype(_np.float32))
+                loss_fn = gluon.loss.L2Loss()
+
+                def one_step():
+                    with autograd.record():
+                        loss = loss_fn(net(x), y)
+                    loss.backward()
+                    tr.step(64)
+
+                sweep = 0
+                if env:   # autotuned leg: let the sweep finish first
+                    while tr._autotune is None or not tr._autotune.done:
+                        one_step()
+                        sweep += 1
+                        if sweep > 64:
+                            break
+                else:
+                    for _ in range(2):
+                        one_step()     # warm the fused programs
+                telemetry.reset()
+                for _ in range(steps):
+                    one_step()
+                _sync(net.collect_params().values().__iter__().__next__()
+                      .data().data_jax)
+                ovl = telemetry.overlap_report(
+                    site="trainer.step")["summary"]
+                snap = telemetry.snapshot()["counters"]
+                params = [p.data().asnumpy()
+                          for p in net.collect_params().values()]
+                sched = engine.current_schedule()
+                frac = ovl.get("overlap_frac")
+                if frac is None and snap.get("comm.collectives", 0):
+                    # no comm span inside the step window but collectives
+                    # DID run: they all launched during backward — the
+                    # whole comm phase is hidden, i.e. full overlap
+                    frac = 1.0
+                return {
+                    "overlap_frac": frac,
+                    "collective_ms": round(
+                        ovl.get("collective_ms", 0.0) / steps, 3),
+                    "first_flush_before_backward_end": min(1, snap.get(
+                        "comm.ready.first_flush_before_backward_end", 0)),
+                    "flush_during_backward": snap.get(
+                        "comm.ready.flush_during_backward", 0) // steps,
+                    "ready_rounds": snap.get("comm.ready.rounds", 0),
+                    "sweep_steps": sweep,
+                    "schedule": sched.describe() if sched else None,
+                    "params": params,
+                }
+        finally:
+            for k, v in prev_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            if env:
+                engine.set_schedule(None)
+
+    reg = run(False)
+    rdy = run(True)
+    tuned = run(None, env={"MXNET_TPU_COMM_AUTOTUNE": "1",
+                           "MXNET_TPU_COMM_AUTOTUNE_STEPS": "1",
+                           "MXNET_TPU_COMM_AUTOTUNE_CAPS": "0,0.5,25"})
+    parity = all(_np.array_equal(a, b)
+                 for a, b in zip(reg["params"], rdy["params"]))
+    unit_f, unit_ms = "frac", "ms"
+    rows = [
+        {"metric": "overlap_frac_comm_ready", "value": rdy["overlap_frac"],
+         "unit": unit_f, "overlap_frac_registration": reg["overlap_frac"],
+         "first_flush_before_backward_end":
+             rdy["first_flush_before_backward_end"],
+         "flush_during_backward_per_step": rdy["flush_during_backward"],
+         "ready_rounds": rdy["ready_rounds"], "parity_ok": parity},
+        {"metric": "collective_ms_comm_ready", "value": rdy["collective_ms"],
+         "unit": unit_ms,
+         "collective_ms_registration": reg["collective_ms"]},
+        {"metric": "overlap_frac_comm_autotuned",
+         "value": tuned["overlap_frac"], "unit": unit_f,
+         "schedule": tuned["schedule"],
+         "sweep_steps": tuned["sweep_steps"],
+         "collective_ms_autotuned": tuned["collective_ms"]},
+    ]
+    return rows
+
+
 def bench_zero(on_accel):
     """BENCH=zero: ZeRO-1 weight-update sharding microbench. A
     resnet18-shaped parameter set (62 tensors, ~11.7M params) trains
@@ -1500,6 +1627,8 @@ def main():
         return
     if which == "comm":
         _emit(bench_comm(on_accel))
+        for row in bench_comm_readiness(on_accel):
+            _emit(row)
         return
     if which == "zero":
         _emit(bench_zero(on_accel))

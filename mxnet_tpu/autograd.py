@@ -84,11 +84,49 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []          # list[weakref.ref[_Node]]
+        _state.grad_ready_hooks = []   # fns fired per finalized leaf grad
+        _state.in_backward = False
+        _state.backward_round = 0      # backward() invocations (thread)
     return _state
 
 
 def is_recording():
     return _st().recording
+
+
+def in_backward():
+    """True while backward() is replaying the tape on this thread. A
+    grad-ready hook that launches work can use this to tell whether the
+    launch happened before backward completed (comm/compute overlap)."""
+    return _st().in_backward
+
+
+def backward_round():
+    """Monotonic count of backward() calls on this thread. Grad-ready
+    consumers use it to notice a SECOND backward before the optimizer
+    step (gradient accumulation) and fall back to step-time sync."""
+    return _st().backward_round
+
+
+def add_grad_ready_hook(fn):
+    """Register `fn(nd_var)` to fire the moment a marked leaf's gradient
+    is FINAL during backward() — i.e. no remaining tape node can still
+    contribute to it — right after its `.grad` buffer is written. Hooks
+    are per-thread; while any hook is installed, backward() writes leaf
+    grads incrementally (earliest-finalized first) instead of all at the
+    end, which is what lets a comm engine launch collectives while the
+    rest of backward is still running (ISSUE 19)."""
+    _st().grad_ready_hooks.append(fn)
+    return fn
+
+
+def remove_grad_ready_hook(fn):
+    """Unregister a hook installed by add_grad_ready_hook (no-op if the
+    hook is not installed)."""
+    try:
+        _st().grad_ready_hooks.remove(fn)
+    except ValueError:
+        pass
 
 
 def is_training():
@@ -186,10 +224,19 @@ def record_op(op_name, input_nds, output_nds, vjp_fn, primal_fn=None):
         st.tape = [r for r in st.tape if r() is not None]
 
 
-def _run_backward(heads, head_grads, retain_graph, want_ids=None):
+def _run_backward(heads, head_grads, retain_graph, want_ids=None,
+                  ready_cb=None):
     """Reverse replay. Returns {id(nd): (nd, cotangent)} for inputs whose
     grad_req != 'null', plus any ids in `want_ids`. Does NOT touch .grad
-    buffers (callers decide)."""
+    buffers (callers decide).
+
+    With `ready_cb`, a wanted leaf is handed to `ready_cb(nd, cot)` the
+    moment its cotangent is FINAL — once the replay has passed the
+    earliest tape node that uses it, nothing downstream can contribute
+    to it anymore — and removed from the returned dict. That is the
+    pullback-completion signal the readiness comm engine hooks
+    (ISSUE 19): the first gradients finalize long before the replay
+    reaches the front of the tape."""
     st = _st()
     tape = [r() for r in st.tape]
     tape = [n for n in tape if n is not None]
@@ -197,6 +244,21 @@ def _run_backward(heads, head_grads, retain_graph, want_ids=None):
     def _wanted(nd_in):
         return (nd_in._grad_req != "null" or
                 (want_ids is not None and id(nd_in) in want_ids))
+
+    fire_at = last_use = None
+    if ready_cb is not None:
+        # earliest tape position using each wanted leaf = the node the
+        # reverse replay processes LAST for that leaf; pass it -> final
+        last_use = {}
+        for pos, node in enumerate(tape):
+            for nd_in in node.inputs:
+                if nd_in._autograd_node is None and _wanted(nd_in):
+                    k = id(nd_in)
+                    if k not in last_use or pos < last_use[k]:
+                        last_use[k] = pos
+        fire_at = {}
+        for k, pos in last_use.items():
+            fire_at.setdefault(pos, []).append(k)
 
     leaf_acc = {}
     for h, hg in zip(heads, head_grads):
@@ -211,35 +273,49 @@ def _run_backward(heads, head_grads, retain_graph, want_ids=None):
             node.out_cots = [None] * node.n_out
         node.out_cots[slot] = _add_maybe(node.out_cots[slot], cot)
 
-    for node in reversed(tape):
-        if node.out_cots is None or not node.alive:
-            continue
-        if node.n_out == 1:
-            cot_arg = node.out_cots[0]
-        else:
-            # zero-fill unused output slots so the pullback sees full structure
-            cot_arg = tuple(
-                c if c is not None else jnp.zeros(sh, dtype=dt)
-                for c, (sh, dt) in zip(node.out_cots, node.out_meta))
-        in_cots = node.vjp_fn(cot_arg)
-        for nd_in, cot in zip(node.inputs, in_cots):
-            if cot is None or (hasattr(cot, "dtype") and
-                               cot.dtype == jax.dtypes.float0):
-                continue
-            entry = nd_in._autograd_node
-            if entry is not None:
-                pnode, pslot = entry
-                if pnode.alive:
-                    if pnode.out_cots is None:
-                        pnode.out_cots = [None] * pnode.n_out
-                    pnode.out_cots[pslot] = _add_maybe(
-                        pnode.out_cots[pslot], cot)
-            if _wanted(nd_in):
-                _acc(leaf_acc, nd_in, cot)
-        node.out_cots = None
-        if not retain_graph:
-            node.alive = False
-            node.vjp_fn = None
+    if ready_cb is not None:
+        # leaf heads no tape node can still feed are final right away
+        for k in [k for k in leaf_acc if k not in last_use]:
+            nd, cot = leaf_acc.pop(k)
+            ready_cb(nd, cot)
+
+    for pos in range(len(tape) - 1, -1, -1):
+        node = tape[pos]
+        if node.out_cots is not None and node.alive:
+            if node.n_out == 1:
+                cot_arg = node.out_cots[0]
+            else:
+                # zero-fill unused output slots so the pullback sees full
+                # structure
+                cot_arg = tuple(
+                    c if c is not None else jnp.zeros(sh, dtype=dt)
+                    for c, (sh, dt) in zip(node.out_cots, node.out_meta))
+            in_cots = node.vjp_fn(cot_arg)
+            for nd_in, cot in zip(node.inputs, in_cots):
+                if cot is None or (hasattr(cot, "dtype") and
+                                   cot.dtype == jax.dtypes.float0):
+                    continue
+                entry = nd_in._autograd_node
+                if entry is not None:
+                    pnode, pslot = entry
+                    if pnode.alive:
+                        if pnode.out_cots is None:
+                            pnode.out_cots = [None] * pnode.n_out
+                        pnode.out_cots[pslot] = _add_maybe(
+                            pnode.out_cots[pslot], cot)
+                if _wanted(nd_in):
+                    _acc(leaf_acc, nd_in, cot)
+            node.out_cots = None
+            if not retain_graph:
+                node.alive = False
+                node.vjp_fn = None
+        if fire_at is not None:
+            # fire even when the node itself was skipped (dead branch):
+            # passing its position still proves no further contribution
+            for k in fire_at.get(pos, ()):
+                got = leaf_acc.pop(k, None)
+                if got is not None:
+                    ready_cb(got[0], got[1])
 
     if not retain_graph:
         st.tape = [r for r in st.tape if r() is not None and r().alive]
@@ -267,42 +343,76 @@ def _add_maybe(a, b):
     return a + b
 
 
+def _write_leaf_grad(nd_var, cot):
+    """Write one leaf's accumulated cotangent into its `.grad` buffer,
+    honoring grad_req 'write' (overwrite) vs 'add' (accumulate across
+    backwards). Returns False for grad_req='null' (nothing written)."""
+    from .ndarray.sparse import RowSparseNDArray
+    if nd_var._grad_req == "null":
+        return False
+    if nd_var._grad is None:
+        from .ndarray.ndarray import zeros
+        nd_var._grad = zeros(nd_var.shape, ctx=nd_var._ctx,
+                             dtype=nd_var.dtype)
+    grad_buf = nd_var._grad
+    if isinstance(cot, RowSparseRows):
+        if isinstance(grad_buf, RowSparseNDArray):
+            # keep the gradient row-sparse end to end (reference:
+            # Embedding sparse_grad -> row_sparse grad NDArray)
+            if nd_var._grad_req == "add":
+                idx, vals = _canonical_rows(
+                    cot.astype(nd_var.dtype),
+                    extra_indices=grad_buf._indices,
+                    extra_values=grad_buf._values)
+            else:
+                idx, vals = _canonical_rows(cot.astype(nd_var.dtype))
+            grad_buf._set_rows(vals, idx)
+            return True
+        cot = cot.densify()  # dense grad buffer: collapse
+    if nd_var._grad_req == "add":
+        grad_buf._write(grad_buf._read() + cot.astype(nd_var.dtype))
+    else:
+        grad_buf._write(cot.astype(nd_var.dtype))
+    return True
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """reference: MXAutogradBackwardEx via python/mxnet/autograd.py (backward).
     Writes accumulated gradients into `.grad` of marked variables, honoring
-    grad_req 'write' (overwrite) vs 'add' (accumulate across backwards)."""
+    grad_req 'write' (overwrite) vs 'add' (accumulate across backwards).
+
+    With grad-ready hooks installed (add_grad_ready_hook), each leaf's
+    grad is written the moment it finalizes during the replay and the
+    hooks fire with the leaf — readiness-ordered, not registration-
+    ordered — so comm can launch while backward is still running."""
+    st = _st()
     heads = heads if isinstance(heads, (list, tuple)) else [heads]
     if head_grads is None:
         head_grads = [None] * len(heads)
     head_grads = [g._read() if hasattr(g, "_read") else g for g in head_grads]
-    leaf_acc = _run_backward(list(heads), head_grads, retain_graph)
-    from .ndarray.sparse import RowSparseNDArray
-    for _, (nd_var, cot) in leaf_acc.items():
-        if nd_var._grad_req == "null":
-            continue
-        if nd_var._grad is None:
-            from .ndarray.ndarray import zeros
-            nd_var._grad = zeros(nd_var.shape, ctx=nd_var._ctx,
-                                 dtype=nd_var.dtype)
-        grad_buf = nd_var._grad
-        if isinstance(cot, RowSparseRows):
-            if isinstance(grad_buf, RowSparseNDArray):
-                # keep the gradient row-sparse end to end (reference:
-                # Embedding sparse_grad -> row_sparse grad NDArray)
-                if nd_var._grad_req == "add":
-                    idx, vals = _canonical_rows(
-                        cot.astype(nd_var.dtype),
-                        extra_indices=grad_buf._indices,
-                        extra_values=grad_buf._values)
-                else:
-                    idx, vals = _canonical_rows(cot.astype(nd_var.dtype))
-                grad_buf._set_rows(vals, idx)
-                continue
-            cot = cot.densify()  # dense grad buffer: collapse
-        if nd_var._grad_req == "add":
-            grad_buf._write(grad_buf._read() + cot.astype(nd_var.dtype))
-        else:
-            grad_buf._write(cot.astype(nd_var.dtype))
+    hooks = list(st.grad_ready_hooks)
+
+    ready_cb = None
+    if hooks:
+        def ready_cb(nd_var, cot):
+            if _write_leaf_grad(nd_var, cot):
+                for h in hooks:
+                    h(nd_var)
+
+    prev_in_backward = st.in_backward
+    st.in_backward = True
+    st.backward_round += 1
+    try:
+        leaf_acc = _run_backward(list(heads), head_grads, retain_graph,
+                                 ready_cb=ready_cb)
+        # leftovers (no ready_cb, or leaves the pre-pass could not place)
+        for _, (nd_var, cot) in leaf_acc.items():
+            if ready_cb is not None:
+                ready_cb(nd_var, cot)
+            else:
+                _write_leaf_grad(nd_var, cot)
+    finally:
+        st.in_backward = prev_in_backward
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

@@ -209,7 +209,7 @@ class GradBucketer:
         """Queue one gradient; returns the list of buckets (possibly empty)
         that are now ready to launch. Empty/None grads are skipped (stale
         grads a `grad_req` change left behind)."""
-        from . import telemetry as _telem
+        from .. import telemetry as _telem
         ready = []
         if raw is None or int(raw.size) == 0:
             _telem.inc("comm.bucket.skipped")
@@ -246,7 +246,7 @@ class GradBucketer:
 
 
 def _count_bucket(bucket):
-    from . import telemetry as _telem
+    from .. import telemetry as _telem
     if _telem.ENABLED:
         _telem.inc("comm.bucket.count")
         _telem.inc("comm.bucket.bytes", bucket.nbytes)
@@ -323,7 +323,7 @@ class SparseGradBucketer:
         self._dtype = None
 
     def add(self, key, ids, vals):
-        from . import telemetry as _telem
+        from .. import telemetry as _telem
         ready = []
         if vals is None or int(vals.size) == 0:
             _telem.inc("comm.sparse.bucket.skipped")
@@ -359,7 +359,7 @@ class SparseGradBucketer:
 
 
 def _count_sparse_bucket(bucket):
-    from . import telemetry as _telem
+    from .. import telemetry as _telem
     if _telem.ENABLED:
         _telem.inc("comm.sparse.bucket.count")
         _telem.inc("comm.sparse.bucket.bytes", bucket.nbytes)
@@ -522,7 +522,7 @@ class BucketLayout:
         self.buckets = list(buckets)
         # HBM ledger: the frozen layout IS the flat-gradient working set
         # this rank materializes every step (pack + reduce-scatter input)
-        from .telemetry import ledger as _ledger
+        from ..telemetry import ledger as _ledger
         _ledger.account("grad_buckets", self.total_nbytes())
 
     @classmethod
@@ -657,3 +657,17 @@ def reassociate_bucketed(raws, bucket_mb=None):
         for idx, part, shape in zip(bucket.keys, parts, bucket.shapes):
             out[idx] = part.reshape(shape)
     return out
+
+
+# readiness-ordered flushing + schedule autotuning (ISSUE 19) live in
+# submodules; re-exported here so `from .. import engine` callers see one
+# flat engine namespace
+from .ready import ReadyScheduler            # noqa: E402
+from . import autotune                       # noqa: E402
+from .autotune import (                      # noqa: E402
+    CommSchedule, ScheduleAutotuner, current_schedule, set_schedule,
+    schedule_payload, restore_schedule, autotune_enabled)
+
+__all__ += ["ReadyScheduler", "autotune", "CommSchedule",
+            "ScheduleAutotuner", "current_schedule", "set_schedule",
+            "schedule_payload", "restore_schedule", "autotune_enabled"]

@@ -1,0 +1,260 @@
+"""Telemetry-driven comm-schedule autotuning (ISSUE 19).
+
+A *comm schedule* is the pair of knobs the bucketed engine exposes:
+
+* the bucket cap (``MXNET_TPU_COMM_BUCKET_MB``; 0 = per-key escape
+  hatch), and
+* the flush policy — ``registration`` (reverse-registration order fed
+  at step time, the PR 4 engine) vs ``ready`` (event-driven flushing
+  from the autograd grad-ready callback, `engine.ready`).
+
+`ScheduleAutotuner` sweeps a candidate grid over the first real training
+steps: each candidate is applied for ``steps_per_candidate`` steps, then
+scored from `telemetry.overlap_report()` over exactly those steps —
+``collective_ms`` down, ``overlap_frac`` up, folded into one exposed-
+communication-milliseconds scalar. After the sweep the winner is pinned
+(process-wide `engine.set_bucket_mb` + the trainer's flush policy),
+announced to the flight ring, and exported as gauges. The chosen
+schedule serializes into checkpoint payloads (`schedule_payload` /
+`restore_schedule`), so a restart re-applies it with ZERO re-sweep
+steps.
+
+Every candidate is safe to sweep live: bucketing (any cap, either
+policy) is a reassociation of the SAME per-key arithmetic, so every
+swept schedule is bit-identical to the unbucketed baseline — the sweep
+changes when collectives launch, never what they compute.
+
+Env knobs::
+
+    MXNET_TPU_COMM_AUTOTUNE=1          enable the sweep (Trainer)
+    MXNET_TPU_COMM_AUTOTUNE_STEPS=N    steps per candidate (default 2)
+    MXNET_TPU_COMM_AUTOTUNE_CAPS=a,b   bucket-MB grid (default 0,4,25,100)
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["CommSchedule", "ScheduleAutotuner", "autotune_enabled",
+           "sweep_budget", "current_schedule", "set_schedule",
+           "schedule_payload", "restore_schedule", "POLICIES"]
+
+POLICIES = ("registration", "ready")
+
+_DEFAULT_CAPS_MB = (0.0, 4.0, 25.0, 100.0)
+_DEFAULT_STEPS = 2
+
+
+def autotune_enabled():
+    """True when `MXNET_TPU_COMM_AUTOTUNE` asks for a warm-up sweep."""
+    return os.environ.get("MXNET_TPU_COMM_AUTOTUNE", "0").lower() \
+        not in ("0", "", "false", "off")
+
+
+def sweep_budget():
+    """Steps per candidate (`MXNET_TPU_COMM_AUTOTUNE_STEPS`, default 2)."""
+    try:
+        return max(1, int(os.environ.get("MXNET_TPU_COMM_AUTOTUNE_STEPS",
+                                         _DEFAULT_STEPS)))
+    except (TypeError, ValueError):
+        return _DEFAULT_STEPS
+
+
+def _caps_grid():
+    raw = os.environ.get("MXNET_TPU_COMM_AUTOTUNE_CAPS", "")
+    if not raw.strip():
+        return list(_DEFAULT_CAPS_MB)
+    out = []
+    for part in raw.split(","):
+        try:
+            out.append(float(part))
+        except ValueError:
+            pass
+    return out or list(_DEFAULT_CAPS_MB)
+
+
+class CommSchedule:
+    """One (bucket_mb, flush policy) point — the unit the autotuner
+    sweeps, scores, pins, and checkpoints."""
+
+    __slots__ = ("bucket_mb", "policy", "score", "source")
+
+    def __init__(self, bucket_mb, policy, score=None, source="manual"):
+        if policy not in POLICIES:
+            raise ValueError("flush policy must be one of %s, got %r"
+                             % (POLICIES, policy))
+        self.bucket_mb = float(bucket_mb)
+        self.policy = str(policy)
+        self.score = None if score is None else float(score)
+        self.source = str(source)
+
+    def apply(self):
+        """Pin this schedule's bucket cap process-wide. Returns the
+        previous override (for restore); the flush policy is read by the
+        Trainer via `current_schedule()`."""
+        from . import set_bucket_mb
+        return set_bucket_mb(self.bucket_mb)
+
+    def describe(self):
+        return "%gMB/%s" % (self.bucket_mb, self.policy)
+
+    def to_payload(self):
+        return {"schedule_format": 1, "bucket_mb": self.bucket_mb,
+                "policy": self.policy, "score": self.score,
+                "source": self.source}
+
+    @classmethod
+    def from_payload(cls, payload):
+        if int(payload.get("schedule_format", -1)) != 1:
+            raise ValueError("unsupported comm-schedule payload %r"
+                             % (payload,))
+        return cls(payload["bucket_mb"], payload["policy"],
+                   score=payload.get("score"),
+                   source=payload.get("source", "checkpoint"))
+
+    def __eq__(self, other):
+        return (isinstance(other, CommSchedule)
+                and self.bucket_mb == other.bucket_mb
+                and self.policy == other.policy)
+
+    def __repr__(self):
+        return ("CommSchedule(%s, score=%s, source=%s)"
+                % (self.describe(), self.score, self.source))
+
+
+# process-wide chosen schedule — what checkpoints carry and restores pin
+_CURRENT = None
+
+
+def current_schedule():
+    return _CURRENT
+
+
+def set_schedule(schedule, announce=False):
+    """Pin `schedule` process-wide (None clears). Applies the bucket cap,
+    exports gauges, and (optionally) announces to the flight ring."""
+    global _CURRENT
+    _CURRENT = schedule
+    if schedule is None:
+        from . import set_bucket_mb
+        set_bucket_mb(None)
+        return None
+    schedule.apply()
+    from .. import telemetry as _telem
+    if _telem.ENABLED:
+        _telem.set_gauge("comm.schedule.bucket_mb", schedule.bucket_mb)
+        _telem.set_gauge("comm.schedule.ready",
+                         1.0 if schedule.policy == "ready" else 0.0)
+    if announce:
+        from ..telemetry import flight
+        flight.note_event("autotune", "comm schedule %s (score=%s, %s)"
+                          % (schedule.describe(), schedule.score,
+                             schedule.source))
+    return schedule
+
+
+def schedule_payload():
+    """The chosen schedule as a checkpointable dict, or None — callers
+    splice this into their checkpoint trees (ResilientRunner, Trainer
+    save_states) so restarts skip the sweep."""
+    return None if _CURRENT is None else _CURRENT.to_payload()
+
+
+def restore_schedule(payload):
+    """Re-pin a checkpointed schedule (no-op on None). Returns the
+    `CommSchedule` — the restart path to a ZERO-step sweep."""
+    if not payload:
+        return None
+    sched = CommSchedule.from_payload(payload)
+    sched.source = "checkpoint"
+    return set_schedule(sched, announce=True)
+
+
+class ScheduleAutotuner:
+    """Drives the sweep from inside the training loop. Per step::
+
+        sched = tuner.current()     # schedule for THIS step (trainer
+                                    # applies cap + flush policy)
+        ... run the step ...
+        tuner.on_step_end()         # advance; scores + pins when done
+
+    `done` flips once the winner is pinned (or immediately when
+    constructed from a checkpointed schedule — `sweep_steps == 0`)."""
+
+    def __init__(self, candidates=None, steps_per_candidate=None,
+                 site="trainer.step"):
+        if candidates is None:
+            candidates = [CommSchedule(mb, pol, source="sweep")
+                          for mb in _caps_grid() for pol in POLICIES]
+        self.candidates = list(candidates)
+        if not self.candidates:
+            raise ValueError("autotuner needs at least one candidate")
+        self.steps_per = (sweep_budget() if steps_per_candidate is None
+                          else max(1, int(steps_per_candidate)))
+        self.site = site
+        self.results = []          # [(CommSchedule, metrics dict)]
+        self.sweep_steps = 0       # steps spent sweeping (0 after restore)
+        self._idx = 0
+        self._step_in_candidate = 0
+        self.chosen = None
+
+    @classmethod
+    def restored(cls, schedule, site="trainer.step"):
+        """An autotuner that is already done: the checkpointed schedule
+        is the winner and zero sweep steps will run."""
+        tuner = cls(candidates=[schedule], site=site)
+        tuner.chosen = schedule
+        return tuner
+
+    @property
+    def done(self):
+        return self.chosen is not None
+
+    def current(self):
+        """The schedule the NEXT step must run under."""
+        if self.chosen is not None:
+            return self.chosen
+        return self.candidates[self._idx]
+
+    @staticmethod
+    def score(metrics):
+        """Exposed communication milliseconds — lower is better. The
+        overlap report's ``collective_ms`` is host time on the collective
+        path and ``overlap_frac`` is the share of the comm phase the host
+        spent OFF that path, so ``collective_ms * (1 - overlap_frac)`` is
+        the un-hidden remainder; the tiny ``collective_ms`` tie-break
+        prefers the schedule that also shrank total collective time."""
+        coll = float(metrics.get("collective_ms", 0.0))
+        frac = metrics.get("overlap_frac")
+        frac = 0.0 if frac is None else float(frac)
+        return coll * (1.0 - frac) + 1e-3 * coll
+
+    def on_step_end(self):
+        """Advance the sweep by one completed step. Scores the candidate
+        after its budget, pins the winner after the last candidate.
+        Returns the chosen schedule once done, else None."""
+        if self.chosen is not None:
+            return self.chosen
+        self.sweep_steps += 1
+        self._step_in_candidate += 1
+        if self._step_in_candidate < self.steps_per:
+            return None
+        from .. import telemetry as _telem
+        report = _telem.overlap_report(site=self.site, limit=self.steps_per)
+        cand = self.candidates[self._idx]
+        metrics = dict(report.get("summary") or {})
+        cand.score = self.score(metrics)
+        self.results.append((cand, metrics))
+        self._idx += 1
+        self._step_in_candidate = 0
+        if self._idx < len(self.candidates):
+            return None
+        best = min(self.results, key=lambda cm: cm[0].score)[0]
+        best.source = "autotune"
+        self.chosen = best
+        set_schedule(best, announce=True)
+        from .. import telemetry as _telem2
+        if _telem2.ENABLED:
+            _telem2.inc("comm.autotune.sweeps")
+            _telem2.set_gauge("comm.autotune.sweep_steps",
+                              float(self.sweep_steps))
+        return best

@@ -1,0 +1,176 @@
+"""Readiness-ordered bucket assembly (ISSUE 19).
+
+`GradBucketer` packs gradients in whatever order the caller feeds them —
+the trainer approximates backward-completion order by feeding reverse
+registration order, but nothing launches until the caller has every
+grad in hand. `ReadyScheduler` closes that gap: it is fed from the
+autograd grad-ready callback (`autograd.add_grad_ready_hook`) the moment
+each parameter's pullback completes, and hands completed buckets to a
+dispatch function immediately — so the first collective launches while
+the rest of backward is still running.
+
+Two assembly modes:
+
+* **free** (``layout=None``): greedy size-capped packing with one OPEN
+  bucket PER DTYPE. Readiness interleaves dtypes arbitrarily; a single
+  open bucket would degenerate into ``dtype_split`` flushes the
+  registration path never saw, so each dtype packs independently.
+  Capacity flushes count ``comm.bucket.flush_reason.ready``; `drain()`
+  flushes the partial tails as ``final``. ``cap_bytes=0`` is the
+  per-key escape hatch: every `add` dispatches a single-key bucket
+  immediately, so the per-key ``comm.key[k]`` spans reflect true launch
+  order instead of registration order.
+
+* **frozen** (``layout=BucketLayout``): bucket membership is fixed — a
+  bucket dispatches the moment ALL its members have arrived, possibly
+  out of bucket-index order. The `Bucket` handed to dispatch is built in
+  the spec's canonical key order regardless of arrival order, so the
+  packed flat vector is byte-identical to the registration-ordered path
+  (bit-exact parity by construction) and every rank of a distributed
+  job launches identical segment collectives. This is the ZeRO / dist
+  mode: sharded state, residuals, and checkpoints all key on the frozen
+  layout, only the LAUNCH ORDER floats with readiness.
+
+Single-threaded by design: the autograd hook fires on the thread running
+`backward()`, and `drain()` runs on the same thread at step time.
+"""
+from __future__ import annotations
+
+__all__ = ["ReadyScheduler"]
+
+
+def _in_backward():
+    from .. import autograd
+    return autograd.in_backward()
+
+
+class ReadyScheduler:
+    """Feed `add(key, raw)` in gradient-readiness order; `dispatch_fn`
+    fires the moment a bucket completes. See module docstring for the
+    free vs frozen assembly modes.
+
+    ``dispatch_fn(bucket, spec)`` — ``spec`` is the `BucketSpec` in
+    frozen mode, ``None`` in free mode.
+
+    Counters: flushed buckets tick the standard ``comm.bucket.*`` family
+    (reason ``ready`` for readiness flushes, ``final`` for drain tails);
+    ``comm.ready.flush_during_backward`` counts dispatches that happened
+    while `autograd.backward` was still replaying the tape, and
+    ``comm.ready.first_flush_before_backward_end`` ticks once per
+    add/drain cycle when the FIRST dispatch beat backward's end — the
+    overlap proof the bench asserts on.
+    """
+
+    def __init__(self, dispatch_fn, cap_bytes=None, layout=None):
+        from . import bucket_bytes
+        self._dispatch_fn = dispatch_fn
+        self.cap = bucket_bytes() if cap_bytes is None else int(cap_bytes)
+        self.layout = layout
+        self.dispatched = 0
+        self._first_dispatch_done = False
+        if layout is not None:
+            self._spec_by_key = {}
+            for spec in layout:
+                for k in spec.keys:
+                    self._spec_by_key[k] = spec
+            self._pending = {}      # spec.index -> {key: raw}
+        else:
+            self._open = {}         # dtype -> (items, nbytes)
+
+    # -- dispatch plumbing ---------------------------------------------------
+    def _dispatch(self, bucket, spec=None):
+        from .. import telemetry as _telem
+        if _telem.ENABLED and _in_backward():
+            _telem.inc("comm.ready.flush_during_backward")
+            if not self._first_dispatch_done:
+                _telem.inc("comm.ready.first_flush_before_backward_end")
+        self._first_dispatch_done = True
+        self.dispatched += 1
+        self._dispatch_fn(bucket, spec)
+
+    # -- free mode -----------------------------------------------------------
+    def _add_free(self, key, raw):
+        import numpy as _np
+        from . import Bucket, _count_bucket, _nbytes
+        nbytes = _nbytes(raw)
+        if self.cap == 0:
+            # per-key escape hatch, now readiness-ordered (ISSUE 19 fix)
+            self._dispatch(_count_bucket(Bucket([(key, raw)], "ready")))
+            return 1
+        if nbytes >= self.cap:
+            # at/above the cap: never merged, never split — its own bucket
+            self._dispatch(_count_bucket(Bucket([(key, raw)], "oversize")))
+            return 1
+        dt = _np.dtype(raw.dtype)
+        items, held = self._open.get(dt, ([], 0))
+        n = 0
+        if items and held + nbytes > self.cap:
+            self._dispatch(_count_bucket(Bucket(items, "ready")))
+            items, held = [], 0
+            n = 1
+        items.append((key, raw))
+        self._open[dt] = (items, held + nbytes)
+        return n
+
+    # -- frozen mode ---------------------------------------------------------
+    def _add_frozen(self, key, raw):
+        from . import Bucket, _count_bucket
+        key = str(key)
+        spec = self._spec_by_key.get(key)
+        if spec is None:
+            raise ValueError(
+                "ReadyScheduler: key %r is not in the frozen bucket layout "
+                "(layout keys: %s) — a changed parameter set needs a new "
+                "layout" % (key, self._spec_by_key and
+                            sorted(self._spec_by_key)[:8]))
+        got = self._pending.setdefault(spec.index, {})
+        got[key] = raw
+        if len(got) < len(spec.keys):
+            return 0
+        del self._pending[spec.index]
+        # canonical spec order, NOT arrival order: the packed flat vector
+        # is identical to the registration path's, bit for bit
+        bucket = Bucket([(k, got[k]) for k in spec.keys], "ready")
+        self._dispatch(_count_bucket(bucket), spec)
+        return 1
+
+    # -- public API ----------------------------------------------------------
+    def add(self, key, raw):
+        """Feed one finalized gradient. Returns the number of buckets
+        dispatched by this call (0 or more). Empty/None grads are skipped
+        (``comm.bucket.skipped``) — in frozen mode they would stall the
+        bucket forever, which `drain()` reports."""
+        from .. import telemetry as _telem
+        if raw is None or int(raw.size) == 0:
+            _telem.inc("comm.bucket.skipped")
+            return 0
+        if self.layout is not None:
+            return self._add_frozen(key, raw)
+        return self._add_free(key, raw)
+
+    def drain(self):
+        """End of the readiness stream (step time). Free mode flushes the
+        partial per-dtype tails (reason ``final``); frozen mode raises if
+        any bucket is still missing members — the frozen-layout guard.
+        Returns the number of buckets dispatched and re-arms the
+        first-flush counter for the next step."""
+        from . import Bucket, _count_bucket
+        n = 0
+        if self.layout is not None:
+            if self._pending:
+                missing = {}
+                for idx, got in sorted(self._pending.items()):
+                    spec = next(s for s in self.layout if s.index == idx)
+                    missing[idx] = [k for k in spec.keys if k not in got]
+                raise ValueError(
+                    "ReadyScheduler: frozen layout drained with incomplete "
+                    "buckets (missing grads): %s" % (missing,))
+        else:
+            for dt in sorted(self._open, key=str):
+                items, _ = self._open[dt]
+                if items:
+                    self._dispatch(_count_bucket(Bucket(items, "final")))
+                    n += 1
+            self._open = {}
+        self._first_dispatch_done = False
+        return n

@@ -27,7 +27,7 @@ class Trainer:
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, zero=None):
+                 update_on_kvstore=None, zero=None, comm_ready=None):
         param_list = []
         if isinstance(params, (dict, ParameterDict)):
             for key in sorted(list(params.keys())):
@@ -73,7 +73,22 @@ class Trainer:
         self._update_on_kvstore = None
         self._distributed = None
         self._params_to_init = []
+        # readiness-ordered comm (ISSUE 19): grads push the moment each
+        # parameter's backward completes, via the autograd grad-ready
+        # hook. comm_ready=True/False forces the policy; None defers to
+        # the autotuned/pinned schedule, then MXNET_TPU_COMM_READY.
+        self._comm_ready = comm_ready
+        self._ready_sess = None
+        self._ready_round = -1
+        self._ready_blocked = False
+        self._ready_leaf_map = {}
+        self._ready_pending = {}
+        self._ready_expected = set()
+        self._ready_warned = False
+        self._autotune = None
+        self._ready_hook = None
         self._reset_kvstore()
+        self._maybe_install_ready_hook()
 
     def _check_contexts(self):
         contexts = None
@@ -216,11 +231,189 @@ class Trainer:
         else:
             self._kvstore.row_sparse_pull(idx, out=out, row_ids=row_id)
 
+    # -- readiness-ordered comm + schedule autotuning (ISSUE 19) --------
+    def _comm_autotuner(self):
+        """The schedule autotuner, created lazily when
+        `MXNET_TPU_COMM_AUTOTUNE` asks for one. A schedule already pinned
+        process-wide (checkpoint restore) short-circuits to a finished
+        tuner — the zero-re-sweep restart path."""
+        from .. import engine as _engine
+        if not _engine.autotune_enabled():
+            return None
+        if self._autotune is None or not self._autotune.done:
+            # a process-wide pin appearing mid-sweep (checkpoint restore,
+            # or another trainer's finished sweep) wins: adopt it with
+            # zero further sweep steps
+            sched = _engine.current_schedule()
+            if sched is not None and (
+                    self._autotune is None
+                    or sched is not self._autotune.current()):
+                self._autotune = _engine.ScheduleAutotuner.restored(sched)
+                sched.apply()
+            elif self._autotune is None:
+                self._autotune = _engine.ScheduleAutotuner()
+                self._autotune.current().apply()
+        return self._autotune
+
+    def _comm_policy(self):
+        """Flush policy for the NEXT readiness round: explicit
+        `comm_ready` arg > autotuner candidate / pinned schedule >
+        `MXNET_TPU_COMM_READY` env > registration order."""
+        if self._comm_ready is not None:
+            return "ready" if self._comm_ready else "registration"
+        tuner = self._comm_autotuner()
+        if tuner is not None:
+            return tuner.current().policy
+        from .. import engine as _engine
+        sched = _engine.current_schedule()
+        if sched is not None:
+            return sched.policy
+        import os
+        return ("ready" if os.environ.get("MXNET_TPU_COMM_READY", "0")
+                .lower() not in ("0", "", "false", "off")
+                else "registration")
+
+    def _maybe_install_ready_hook(self):
+        """Register the grad-ready hook only when readiness could ever be
+        chosen — a registration-only trainer must not tax every
+        backward on this thread."""
+        if self._ready_hook is not None:
+            return
+        import os
+        from .. import engine as _engine
+        sched = _engine.current_schedule()
+        want = (self._comm_ready is True
+                or (self._comm_ready is None and (
+                    _engine.autotune_enabled()
+                    or (sched is not None and sched.policy == "ready")
+                    or os.environ.get("MXNET_TPU_COMM_READY", "0")
+                    .lower() not in ("0", "", "false", "off"))))
+        if not want:
+            return
+        import weakref
+        from .. import autograd
+        ref = weakref.ref(self)
+
+        def _hook(leaf):
+            # weakref: the hook must not keep a dead Trainer armed (or
+            # alive) — self-removes once the trainer is collected
+            tr = ref()
+            if tr is None:
+                autograd.remove_grad_ready_hook(_hook)
+                return
+            tr._on_grad_ready(leaf)
+
+        self._ready_hook = autograd.add_grad_ready_hook(_hook)
+
+    def _ready_supported(self):
+        """Readiness preconditions: an initialized dense non-compressed
+        store, and no 'add' grads (gradient accumulation needs step-time
+        sync — the PyTorch-DDP no_sync analog)."""
+        if (self._kvstore is None or not self._kv_initialized
+                or self._params_to_init or self._compression_params
+                or not hasattr(self._kvstore, "ready_session")):
+            return False
+        for p in self._params:
+            if p.grad_req == "add":
+                return False
+            if p.grad_req != "null" and (p._stype != "default"
+                                         or p._grad_stype != "default"):
+                return False
+        return True
+
+    def _arm_ready_session(self):
+        """Open a ReadyPushSession for this backward round and index the
+        autograd leaves (per-ctx parameter data arrays) that must report
+        before each key pushes."""
+        entries = [(i, p) for i, p in enumerate(self._params)
+                   if p.grad_req != "null"]
+        if not entries:
+            return
+        canonical = [str(self._param2idx[p.name])
+                     for _, p in reversed(entries)]
+        self._ready_leaf_map = {}
+        self._ready_pending = {}
+        for _, p in entries:
+            leaves = p._check_and_get(p._data, list)
+            ids = set()
+            for d in leaves:
+                self._ready_leaf_map[id(d)] = p
+                ids.add(id(d))
+            self._ready_pending[p.name] = ids
+        self._ready_expected = set(canonical)
+        self._ready_sess = self._kvstore.ready_session(
+            canonical_keys=canonical)
+
+    def _abort_ready(self):
+        self._ready_sess = None
+        self._ready_blocked = True
+        _telem.inc("comm.ready.aborted")
+
+    def _on_grad_ready(self, leaf):
+        """autograd grad-ready hook: fired per finalized leaf during
+        backward. Pushes a parameter into the readiness session once ALL
+        its device leaves have reported. Any anomaly aborts the round —
+        session launches are side-effect-free, so the registration path
+        at step time stays a safe fallback."""
+        from .. import autograd
+        rnd = autograd.backward_round()
+        if rnd != self._ready_round:
+            if self._ready_sess is not None \
+                    and not self._ready_sess.finished:
+                # a SECOND backward before step(): gradient accumulation
+                # territory — discard the launches, sync at step time
+                self._abort_ready()
+            self._ready_round = rnd
+            if not self._ready_blocked and self._comm_policy() == "ready" \
+                    and self._ready_supported():
+                self._arm_ready_session()
+        sess = self._ready_sess
+        if sess is None or sess.finished:
+            return
+        param = self._ready_leaf_map.get(id(leaf))
+        if param is None:
+            return
+        pend = self._ready_pending.get(param.name)
+        if pend is None:
+            # the same parameter finalized twice in one backward — not a
+            # state the tape should produce; fail safe
+            self._abort_ready()
+            return
+        pend.discard(id(leaf))
+        if pend:
+            return
+        del self._ready_pending[param.name]
+        try:
+            sess.push(self._param2idx[param.name], param.list_grad())
+        except Exception as exc:
+            self._abort_ready()
+            if not self._ready_warned:
+                self._ready_warned = True
+                warnings.warn("readiness comm disabled for this step "
+                              "(falling back to registration order): %s"
+                              % (exc,))
+
+    def _autotune_advance(self):
+        """End-of-step sweep bookkeeping: score/advance the candidate
+        (the step's span is recorded by then) and pre-apply the next
+        candidate's bucket cap so the NEXT backward's readiness round
+        packs under it."""
+        tuner = self._comm_autotuner()
+        if tuner is None or tuner.done:
+            self._maybe_install_ready_hook()
+            return
+        tuner.on_step_end()
+        if not tuner.done:
+            tuner.current().apply()
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update step: grad allreduce + optimizer.
         reference: Trainer.step."""
         if not _telem.ENABLED:
-            return self._step_impl(batch_size, ignore_stale_grad)
+            try:
+                return self._step_impl(batch_size, ignore_stale_grad)
+            finally:
+                self._autotune_advance()
         ts = _telem.span_clock()
         t0 = time.perf_counter()
         try:
@@ -232,6 +425,8 @@ class Trainer:
             _telem.maybe_sample_memory()
             # telemetry v2: anomaly detection + crash flight recorder
             _telem.step_event("trainer", dur * 1e3)
+            # the autotuner scores AFTER the step span lands
+            self._autotune_advance()
 
     def _step_impl(self, batch_size, ignore_stale_grad):
         rescale_grad = self._scale / batch_size
@@ -269,6 +464,24 @@ class Trainer:
     def _allreduce_grads(self):
         if not self._kvstore:
             return
+        sess, self._ready_sess = self._ready_sess, None
+        self._ready_blocked = False
+        if sess is not None and not sess.finished:
+            # readiness fast-path: the collectives launched DURING
+            # backward; here we only verify every key reported and run
+            # the deferred apply (updater / out broadcast)
+            if not self._ready_pending \
+                    and set(sess.pushed) == self._ready_expected:
+                outs = None
+                if not self._update_on_kvstore:
+                    outs = [(str(self._param2idx[p.name]), p.list_grad())
+                            for p in self._params if p.grad_req != "null"]
+                sess.finish(outs=outs)
+                _telem.inc("comm.ready.rounds")
+                return
+            # some parameter never finalized (e.g. unused in this
+            # graph): launches are pure, discarding them is free
+            _telem.inc("comm.ready.aborted")
         from .. import engine as _engine
         # ZeRO always takes the multi-key path: the sharded updater needs
         # the FULL key set per step (its bucket layout is frozen); the

@@ -30,7 +30,7 @@ from .. import optimizer as opt
 from .. import telemetry as _telem
 from ..base import MXNetError
 
-__all__ = ["KVStore", "KVStoreLocal", "create"]
+__all__ = ["KVStore", "KVStoreLocal", "ReadyPushSession", "create"]
 
 
 def _key_list(key):
@@ -568,6 +568,91 @@ class KVStoreLocal(KVStore):
         if tail is not None:
             dispatch(tail)
 
+    # -- readiness-ordered push (ISSUE 19) ------------------------------
+    def ready_session(self, canonical_keys=None):
+        """Open a readiness-ordered push session: the Trainer feeds
+        per-key device gradients the moment each parameter's backward
+        completes (`session.push`), comm launches ride the bucket
+        assembly immediately (while backward still runs), and the
+        store/updater application is deferred to `session.finish()` at
+        step time. `canonical_keys` is the registration-order key
+        sequence — the order the non-readiness path would feed — used to
+        freeze layouts deterministically."""
+        return ReadyPushSession(self, canonical_keys=canonical_keys)
+
+    def _ready_ingest(self, sess, key, vals):
+        """Capture one key's replica payloads for the readiness path;
+        returns the raw array the bucket assembly packs. Local mode keeps
+        every replica (the fused bucket merge sums them in one program,
+        exactly like `_push_bucketed`)."""
+        sess.raw_slots[key] = [v.as_in_context(sess.ctx)._read()
+                               for v in vals]
+        return sess.raw_slots[key][0]
+
+    def _ready_launch(self, sess, bucket):
+        """Launch one readiness bucket's comm program. Pure computation on
+        immutable arrays — under async dispatch the work overlaps the rest
+        of backward; nothing observable mutates until `_ready_apply`."""
+        if sess.cap == 0 and len(bucket.keys) == 1:
+            # per-key escape hatch, readiness-ordered: the comm.key[k]
+            # span now reflects the true launch order (ISSUE 19 fix)
+            k = bucket.keys[0]
+            _telem.inc("comm.collectives")
+            ts = _telem.span_clock()
+            t0 = time.perf_counter()
+            raws = sess.raw_slots[k]
+            acc = raws[0]
+            for r in raws[1:]:
+                acc = acc + r
+            _telem.record_span(_engine.comm_span_name(str(k), "key"),
+                               _engine.SPAN_CAT_COMM, ts,
+                               time.perf_counter() - t0)
+            return [acc]
+        return self._launch_bucket_merge(bucket, sess.raw_slots, sess.nrep)
+
+    def _ready_apply(self, sess, bucket, parts):
+        """Apply one launched readiness bucket at step time: per-key fault
+        sites, updater/store writes, and the optional out broadcast —
+        the same semantics as `_push_bucketed`'s apply, minus the launch
+        (already in flight). Store-replace mode retries the bucket as a
+        unit; the parts are immutable, so a replay is safe."""
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        use_faults = _faults.active_plan() is not None
+
+        def apply_bucket():
+            for k, part in zip(bucket.keys, parts):
+                if use_faults:
+                    _faults.check(
+                        "kvstore.push",
+                        context="key=%s bucket=[%s]" % (k,
+                                                        bucket.key_range()))
+                stored = self._store[k]
+                merged = nd.from_jax(part, ctx=sess.ctx)
+                if self._updater is not None:
+                    idx = int(k) if k.isdigit() else k
+                    self._updater(idx, merged, stored)
+                else:
+                    stored._write(merged.as_in_context(
+                        stored.context)._read().astype(stored.dtype))
+                if sess.out_map is not None:
+                    if use_faults:
+                        _faults.check(
+                            "kvstore.pull",
+                            context="key=%s bucket=[%s]"
+                            % (k, bucket.key_range()))
+                    src = self._store[k]
+                    for t in sess.out_map[k]:
+                        src.copyto(t)
+
+        if self._updater is None and use_faults:
+            call_with_retry(
+                apply_bucket, site="kvstore.push",
+                context="bucket keys=[%s] %dB"
+                % (",".join(bucket.keys), bucket.nbytes))
+        else:
+            apply_bucket()
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast merged value to all outs (reference:
         KVStoreLocal::PullImpl → comm Broadcast). A resilience fault site
@@ -679,6 +764,146 @@ class KVStoreLocal(KVStore):
                         % t.stype)
                 t._values = vals.astype(t.dtype)
                 t._indices = idx
+
+
+class ReadyPushSession:
+    """One readiness-ordered grad-sync round (ISSUE 19).
+
+    The Trainer opens a session before backward, feeds `push(key, vals)`
+    from the autograd grad-ready hook the moment each parameter
+    finalizes, and calls `finish()` at step time. Bucket assembly is a
+    `ReadyScheduler`; each completed bucket LAUNCHES its comm program
+    immediately (pure computation on immutable arrays — under async
+    dispatch the collective overlaps the rest of backward) while every
+    observable mutation (updater calls, store writes, out broadcasts) is
+    deferred to `finish()`. That split is also the safety story: an
+    abandoned or aborted session has changed nothing — the caller can
+    always fall back to the registration-ordered path.
+
+    Three modes, chosen from the store's updater:
+
+    * plain store / local Updater — free-mode scheduler; buckets apply at
+      finish in launch order (per-key fault sites + per-bucket retry
+      semantics identical to `_push_bucketed`).
+    * `ZeroUpdater` with a frozen layout — frozen-mode scheduler; each
+      completed bucket's reduce-scatter launches during backward
+      (`ZeroUpdater.scatter_ready`), and `finish()` runs the fused shard
+      updates + pipelined all-gathers in completion order.
+    * `ZeroUpdater` before the first step (no layout yet) — grads are
+      buffered and replayed in canonical registration order at finish, so
+      the layout freezes exactly as the registration path would (every
+      rank, either policy: same layout).
+
+    Cross-rank contract (dist stores): readiness order is DETERMINISTIC —
+    the autograd tape fires grad-ready callbacks in reverse tape order,
+    so workers running the same SPMD program produce the same arrival
+    order, hence identical free-mode bucket boundaries and identical
+    collective launch order (the same identical-replica contract the
+    frozen-layout and compression paths already assert). `finish()`
+    verifies the pushed key set against `canonical_keys` as the guard.
+    """
+
+    def __init__(self, store, canonical_keys=None):
+        from ..optimizer.zero import ZeroUpdater
+        self.store = store
+        self.cap = _engine.bucket_bytes()
+        self.canonical = (None if canonical_keys is None
+                          else [str(k) for k in canonical_keys])
+        self.raw_slots = {}
+        self.nrep = None
+        self.ctx = None
+        self.out_map = None
+        self.launched = []     # [(bucket, handle)] in launch order
+        self.arrivals = []     # zero mode: [(spec, g_shard)]
+        self.pushed = []       # str keys in readiness (arrival) order
+        self.finished = False
+        self._zero = isinstance(store._updater, ZeroUpdater)
+        self._buffer = None
+        if self._zero:
+            layout = store._updater.layout
+            if layout is None:
+                self._sched = None
+                self._buffer = {}
+            else:
+                self._sched = _engine.ReadyScheduler(
+                    self._dispatch_zero, layout=layout)
+        else:
+            self._sched = _engine.ReadyScheduler(
+                self._dispatch, cap_bytes=self.cap)
+
+    def _dispatch(self, bucket, spec=None):
+        self.launched.append((bucket, self.store._ready_launch(self,
+                                                               bucket)))
+
+    def _dispatch_zero(self, bucket, spec):
+        flat_g = _engine.pack_flat(spec, bucket.raws)
+        g_shard = self.store._updater.scatter_ready(
+            spec, flat_g, self.store._store)
+        self.arrivals.append((spec, g_shard))
+
+    def push(self, key, vals):
+        """Feed one parameter's per-device gradients in readiness order
+        (during backward). Launches whatever buckets just completed."""
+        from ..ndarray import sparse as _sp
+        k = str(key)
+        vals = list(vals) if isinstance(vals, (list, tuple)) else [vals]
+        if not vals or any(not isinstance(v, nd.NDArray)
+                           or isinstance(v, _sp.BaseSparseNDArray)
+                           for v in vals):
+            raise MXNetError(
+                "readiness push requires dense NDArray gradients (key %s)"
+                % (key,))
+        if self.nrep is None:
+            self.nrep = len(vals)
+            self.ctx = self.store._store_ctx_for(vals)
+        elif len(vals) != self.nrep:
+            raise MXNetError(
+                "readiness push saw %d replicas for key %s, expected %d"
+                % (len(vals), key, self.nrep))
+        if _telem.ENABLED:
+            _record_comm("push", [vals])
+        self.pushed.append(k)
+        if self._buffer is not None:
+            self._buffer[k] = vals     # zero, first step: no early launch
+            return
+        if self._zero:
+            raw = self.store._merge(vals)._read()
+            self.raw_slots[k] = [raw]
+        else:
+            raw = self.store._ready_ingest(self, k, vals)
+        self._sched.add(k, raw)
+
+    def finish(self, outs=None):
+        """Complete the round at step time: drain the tail buckets, then
+        apply every launched bucket (updater/store writes, pulls) in
+        launch order — or, for ZeRO, run the update + pipelined
+        all-gather legs. `outs` is [(key, [targets])] for the fused
+        pushpull flow (store-replace mode only)."""
+        if self.finished:
+            raise MXNetError("ReadyPushSession.finish() called twice")
+        self.finished = True
+        store = self.store
+        if self._buffer is not None:
+            order = self.canonical if self.canonical is not None \
+                else list(self._buffer)
+            keys = [k for k in order if k in self._buffer]
+            if len(keys) != len(self._buffer):
+                raise MXNetError(
+                    "readiness round pushed keys outside the canonical "
+                    "order (%s vs %s)" % (sorted(self._buffer),
+                                          sorted(order)))
+            store._maybe_push_zero(keys, [self._buffer[k] for k in keys])
+            return
+        self._sched.drain()   # frozen mode raises on missing members
+        if self._zero:
+            store._updater.finish_ready(self.arrivals, store._store)
+            return
+        if outs is not None:
+            self.out_map = {str(k): targets for k, targets in outs}
+            if _telem.ENABLED:
+                _record_comm("pull", [t for _, t in outs])
+        for bucket, handle in self.launched:
+            store._ready_apply(self, bucket, handle)
 
 
 def create(name="local"):

@@ -655,6 +655,81 @@ class KVStoreDist(KVStoreLocal):
         if tail is not None:
             dispatch(tail)
 
+    # -- readiness-ordered push (ISSUE 19) ------------------------------
+    def _ready_ingest(self, sess, key, vals):
+        """Dist readiness capture: replicas merge locally per key (same
+        as `_push_bucketed`), so the bucket packs merged raws and ONE
+        cross-worker allreduce per bucket crosses the wire."""
+        merged = self._merge(vals)._read()
+        sess.raw_slots[key] = [merged]
+        return merged
+
+    def _ready_launch(self, sess, bucket):
+        """Launch one readiness bucket's cross-worker allreduce: pack flat
+        (one launch) + the retried worker-mesh psum, async-dispatched
+        while backward continues. Returns the summed flat vector."""
+        from .. import telemetry as _telem
+        from ..resilience.errors import (FatalTrainingError, ResilienceError,
+                                         TransportError, classify)
+        context = ("bucket keys=[%s] %dB"
+                   % (",".join(bucket.keys), bucket.nbytes))
+        kind = "key" if (sess.cap == 0 and len(bucket.keys) == 1) \
+            else "bucket"
+        try:
+            flat = _engine.pack_bucket(bucket)
+            ts = _telem.span_clock()
+            t0 = time.perf_counter()
+            summed = self._allreduce(flat, context=context)
+            _telem.record_span(
+                _engine.comm_span_name(bucket.key_range(), kind),
+                _engine.SPAN_CAT_COMM, ts, time.perf_counter() - t0)
+            return summed
+        except ResilienceError:
+            raise
+        except Exception as exc:
+            detail = ("kvstore_dist readiness push failed: keys=[%s] %dB "
+                      "worker=%d/%d: %s: %s"
+                      % (",".join(bucket.keys), bucket.nbytes, dist.rank(),
+                         dist.num_workers(), type(exc).__name__, exc))
+            if classify(exc) == "retriable":
+                raise TransportError(detail, site="kvstore.push",
+                                     key=bucket.key_range()) from exc
+            raise FatalTrainingError(detail) from exc
+
+    def _ready_apply(self, sess, bucket, summed):
+        """Apply one launched readiness bucket at step time: unpack the
+        summed flat vector, per-key updater/store writes + optional out
+        broadcast — the lower half of `_push_bucketed`'s apply."""
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        use_faults = _faults.active_plan() is not None
+        parts = _engine.unpack_bucket(bucket, summed)
+        for k, part in zip(bucket.keys, parts):
+            stored = self._store[k]
+            merged = nd.from_jax(part, ctx=stored.context)
+            if self._updater is not None:
+                idx = int(k) if k.isdigit() else k
+                self._updater(idx, merged, stored)
+            else:
+                stored._write(merged.as_in_context(
+                    stored.context)._read().astype(stored.dtype))
+            if sess.out_map is not None:
+                src = self._store[k]
+                targets = sess.out_map[k]
+                if not use_faults:
+                    for t in targets:
+                        src.copyto(t)
+                    continue
+                pctx = "key=%s bucket=[%s]" % (k, bucket.key_range())
+
+                def broadcast(src=src, targets=targets, pctx=pctx):
+                    _faults.check("kvstore.pull", context=pctx)
+                    for t in targets:
+                        src.copyto(t)
+
+                call_with_retry(broadcast, site="kvstore.pull",
+                                context=pctx)
+
     def barrier(self):
         nd.waitall()
         if dist.num_workers() > 1:

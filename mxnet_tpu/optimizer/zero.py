@@ -279,45 +279,117 @@ class ZeroUpdater:
         for idx in (self._idx(k) for k in keys):
             opt._update_count(idx)
         clip = opt.clip_gradient
+        # software pipeline: bucket N's reduce-scatter launches BEFORE
+        # bucket N-1's all-gather-back, so under async dispatch the two
+        # collectives overlap instead of serializing around the update
+        # (ISSUE 19; "Automatic Cross-Replica Sharding of Weight Update").
+        # Per-bucket arithmetic and write order are untouched — bit parity
+        # with the sequential loop holds by construction.
+        pending = None   # (spec, new_w) awaiting its all-gather
         for spec in self.layout:
             self._ensure_shards(spec, weights_by_key)
             flat_g = _engine.pack_flat(
                 spec, [grads_by_key[k] for k in spec.keys])
-            context = "bucket=[%s] %dB world=%d" % (
-                spec.key_range(), spec.nbytes(), self.comm.world)
-
-            def scatter(flat_g=flat_g, spec=spec, context=context):
-                _faults.check("collective.reduce_scatter", context=context)
-                return self.comm.reduce_scatter(spec, flat_g)
-
-            _telem.inc("comm.collectives")
-            _telem.inc("comm.reduce_scatter")
-            ts = _telem.span_clock()
-            t0 = time.perf_counter()
-            g_shard = call_with_retry(
-                scatter, site="collective.reduce_scatter", context=context)
-            _telem.record_span(spec.span_name("rs"), _engine.SPAN_CAT_COMM,
-                               ts, time.perf_counter() - t0)
-            new_w = self._fused_shard_update(spec, g_shard, clip)
-
-            def gather(new_w=new_w, spec=spec, context=context):
-                _faults.check("collective.all_gather", context=context)
-                return self.comm.all_gather(spec, new_w)
-
-            _telem.inc("comm.collectives")
-            _telem.inc("comm.all_gather")
-            ts = _telem.span_clock()
-            t0 = time.perf_counter()
-            full = call_with_retry(
-                gather, site="collective.all_gather", context=context)
-            _telem.record_span(spec.span_name("ag"), _engine.SPAN_CAT_COMM,
-                               ts, time.perf_counter() - t0)
-            for k, part in zip(spec.keys,
-                               _engine.unpack_flat(spec, full)):
-                stored = weights_by_key[k]
-                stored._write(part.astype(stored.dtype))
+            g_shard = self._scatter_leg(spec, flat_g)
+            if pending is not None:
+                _telem.inc("comm.zero.pipelined")
+                self._gather_writeback(pending[0], pending[1],
+                                       weights_by_key)
+            pending = (spec, self._fused_shard_update(spec, g_shard, clip))
+        if pending is not None:
+            self._gather_writeback(pending[0], pending[1], weights_by_key)
         # re-assert every step: gauges are cheap and `telemetry.reset()`
         # between measurement windows must not lose the footprint
+        self._update_state_gauge()
+
+    def _scatter_leg(self, spec, flat_g):
+        """The reduce-scatter leg for one bucket: fault site, counters,
+        span, retry. Safe to launch while backward is still running (it
+        only reads immutable grad arrays) — the readiness push path calls
+        it per completed bucket, out of bucket-index order."""
+        from .. import telemetry as _telem
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        context = "bucket=[%s] %dB world=%d" % (
+            spec.key_range(), spec.nbytes(), self.comm.world)
+
+        def scatter(flat_g=flat_g, spec=spec, context=context):
+            _faults.check("collective.reduce_scatter", context=context)
+            return self.comm.reduce_scatter(spec, flat_g)
+
+        _telem.inc("comm.collectives")
+        _telem.inc("comm.reduce_scatter")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        g_shard = call_with_retry(
+            scatter, site="collective.reduce_scatter", context=context)
+        _telem.record_span(spec.span_name("rs"), _engine.SPAN_CAT_COMM,
+                           ts, time.perf_counter() - t0)
+        return g_shard
+
+    def _gather_writeback(self, spec, new_w, weights_by_key):
+        """The all-gather-back leg: retried exchange, then per-key store
+        writes of the reassembled full weights."""
+        from .. import telemetry as _telem
+        from ..resilience import faults as _faults
+        from ..resilience.retry import call_with_retry
+        context = "bucket=[%s] %dB world=%d" % (
+            spec.key_range(), spec.nbytes(), self.comm.world)
+
+        def gather(new_w=new_w, spec=spec, context=context):
+            _faults.check("collective.all_gather", context=context)
+            return self.comm.all_gather(spec, new_w)
+
+        _telem.inc("comm.collectives")
+        _telem.inc("comm.all_gather")
+        ts = _telem.span_clock()
+        t0 = time.perf_counter()
+        full = call_with_retry(
+            gather, site="collective.all_gather", context=context)
+        _telem.record_span(spec.span_name("ag"), _engine.SPAN_CAT_COMM,
+                           ts, time.perf_counter() - t0)
+        for k, part in zip(spec.keys, _engine.unpack_flat(spec, full)):
+            stored = weights_by_key[k]
+            stored._write(part.astype(stored.dtype))
+
+    # -- readiness-ordered entry points (ISSUE 19) -----------------------
+    def scatter_ready(self, spec, flat_g, weights_by_key):
+        """Launch one completed bucket's reduce-scatter the moment its
+        members finish backward (frozen-layout readiness mode). Returns
+        the g_shard handle `finish_ready` consumes."""
+        self._ensure_shards(spec, weights_by_key)
+        return self._scatter_leg(spec, flat_g)
+
+    def finish_ready(self, arrivals, weights_by_key):
+        """Complete a readiness round at step time: `arrivals` is
+        [(spec, g_shard)] in COMPLETION order (any permutation of the
+        layout). Per-bucket update + all-gather run in that order, each
+        bucket's all-gather pipelined behind the next bucket's update —
+        every reduce-scatter already launched during backward. The
+        arithmetic per bucket is identical to `step`."""
+        from .. import telemetry as _telem
+        if self.layout is None:
+            raise RuntimeError("finish_ready needs a frozen layout "
+                               "(first step goes through step())")
+        got = [s.index for s, _ in arrivals]
+        want = [s.index for s in self.layout]
+        if sorted(got) != sorted(want):
+            raise ValueError(
+                "readiness round arrived with buckets %s but the frozen "
+                "layout holds %s" % (sorted(got), sorted(want)))
+        opt = self.optimizer
+        for k in self.layout.keys():
+            opt._update_count(self._idx(k))
+        clip = opt.clip_gradient
+        pending = None
+        for spec, g_shard in arrivals:
+            if pending is not None:
+                _telem.inc("comm.zero.pipelined")
+                self._gather_writeback(pending[0], pending[1],
+                                       weights_by_key)
+            pending = (spec, self._fused_shard_update(spec, g_shard, clip))
+        if pending is not None:
+            self._gather_writeback(pending[0], pending[1], weights_by_key)
         self._update_state_gauge()
 
     def _fused_shard_update(self, spec, g_shard, clip):
@@ -447,7 +519,8 @@ class ZeroUpdater:
         "state": {bucket_index: {slot: ndarray}}}`` — pickleable by
         `SnapshotCheckpointer`, orbax-friendly as a pytree of arrays."""
         if self.layout is None:
-            return {"zero_format": 1, "layout": None, "state": {}}
+            return {"zero_format": 1, "layout": None, "state": {},
+                    "comm_schedule": _engine.schedule_payload()}
         state = {}
         for spec in self.layout:
             slots = {}
@@ -459,7 +532,8 @@ class ZeroUpdater:
                 slots["master"] = _np.asarray(full[:spec.size])
             state[spec.index] = slots
         return {"zero_format": 1, "layout": self.layout.to_payload(),
-                "state": state}
+                "state": state,
+                "comm_schedule": _engine.schedule_payload()}
 
     def load_state_payload(self, payload):
         """Inverse of `state_payload`, re-partitioned for THIS comm's
@@ -470,6 +544,10 @@ class ZeroUpdater:
         if int(payload.get("zero_format", -1)) != 1:
             raise ValueError("not a ZeRO state payload: %r"
                              % (payload.get("zero_format"),))
+        if payload.get("comm_schedule") is not None:
+            # the autotuned comm schedule rides the optimizer state: a
+            # restart resumes the winning schedule with 0 sweep steps
+            _engine.restore_schedule(payload["comm_schedule"])
         self._w_shards.clear()
         self._masters.clear()
         self._states.clear()

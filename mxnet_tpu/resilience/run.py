@@ -279,6 +279,14 @@ class ResilientRunner:
         t0 = time.monotonic()
         with _telem.span("checkpoint", "resilience"):
             tree = self.state_get()
+            if isinstance(tree, dict) and "comm_schedule" not in tree:
+                # autotuned comm schedule rides the checkpoint so a
+                # relaunch skips the warm-up sweep (ISSUE 19)
+                from .. import engine as _engine
+                sched = _engine.schedule_payload()
+                if sched is not None:
+                    tree = dict(tree)
+                    tree["comm_schedule"] = sched
             if self.commit is not None:
                 # two-phase: payload durable everywhere BEFORE any marker
                 # moves; the marker then names the fleet-elected step
@@ -336,6 +344,10 @@ class ResilientRunner:
                 step, tree = self.ckpt.restore(step)
             except FileNotFoundError:
                 raise cause from None
+            if isinstance(tree, dict) and tree.get("comm_schedule") \
+                    is not None:
+                from .. import engine as _engine
+                _engine.restore_schedule(tree.pop("comm_schedule"))
             self.state_set(tree)
         _telem.inc("resilience.restores")
         from ..telemetry import flight as _flight

@@ -1,0 +1,606 @@
+"""Readiness-ordered comm overlap + schedule autotuning (ISSUE 19) tests.
+
+Coverage: autograd grad-ready hooks (firing order = reverse tape order,
+`in_backward`/`backward_round` bookkeeping, hook-free path unchanged),
+`engine.ready.ReadyScheduler` free/frozen assembly + flush reasons + the
+bucket_mb=0 per-key escape hatch, bit-exact Trainer parity readiness-vs-
+registration (local kvstore both update_on_kvstore modes, dist kvstore,
+ZeRO) across bucket caps, ZeRO world=2/4 readiness parity on the
+injectable FakeFleet fabric with out-of-order bucket completion, frozen
+BucketLayout stability across reordered steps, fault-injected per-bucket
+retry under out-of-order flush, per-key span launch order at cap=0,
+gradient-accumulation abort + fallback, the schedule autotuner sweep →
+pin → gauges, and checkpoint round-trips (ZeRO payload + ResilientRunner
+tree) that restart with ZERO re-sweep steps.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, nd, telemetry
+from mxnet_tpu.engine import ready as engine_ready
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.optimizer import ZeroUpdater, create as opt_create
+
+from test_zero import FakeFleet, _run_fleet  # noqa: F401 (fleet fabric)
+
+
+def _counters():
+    return dict(telemetry.snapshot()["counters"])
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+@pytest.fixture(autouse=True)
+def _no_pinned_schedule():
+    """Every test starts and ends without a process-wide pinned comm
+    schedule (the autotuner tests pin one)."""
+    engine.set_schedule(None)
+    yield
+    engine.set_schedule(None)
+
+
+# ===========================================================================
+# autograd grad-ready hooks
+# ===========================================================================
+
+def test_grad_ready_hook_fires_in_reverse_tape_order():
+    order = []
+    hook = autograd.add_grad_ready_hook(lambda leaf: order.append(id(leaf)))
+    try:
+        w1 = nd.array(np.ones((3,), np.float32))
+        w2 = nd.array(np.full((3,), 2.0, np.float32))
+        autograd.mark_variable(w1, grad_req="write")
+        autograd.mark_variable(w2, grad_req="write")
+        with autograd.record():
+            h = w1 * 3.0          # w1's last use: early tape position
+            y = (h + w2).sum()    # w2's last use: later position
+        y.backward()
+    finally:
+        autograd.remove_grad_ready_hook(hook)
+    # reverse replay finalizes w2 (later position) BEFORE w1
+    assert order == [id(w2), id(w1)]
+    np.testing.assert_array_equal(w1.grad.asnumpy(), np.full(3, 3.0))
+    np.testing.assert_array_equal(w2.grad.asnumpy(), np.ones(3))
+
+
+def test_grad_ready_hook_sees_in_backward_and_rounds():
+    flags, rounds0 = [], autograd.backward_round()
+    hook = autograd.add_grad_ready_hook(
+        lambda leaf: flags.append(autograd.in_backward()))
+    try:
+        x = nd.array(np.ones((2,), np.float32))
+        autograd.mark_variable(x, grad_req="write")
+        for _ in range(2):
+            with autograd.record():
+                y = (x * x).sum()
+            y.backward()
+    finally:
+        autograd.remove_grad_ready_hook(hook)
+    assert flags == [True, True]
+    assert autograd.backward_round() == rounds0 + 2
+    assert not autograd.in_backward()
+
+
+def test_grad_ready_hook_free_path_bit_identical():
+    def grads(with_hook):
+        mx.random.seed(3)
+        np.random.seed(3)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+        net.initialize(mx.init.Xavier())
+        hook = (autograd.add_grad_ready_hook(lambda leaf: None)
+                if with_hook else None)
+        try:
+            x = nd.array(np.random.RandomState(0).randn(4, 6)
+                         .astype(np.float32))
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+        finally:
+            if hook is not None:
+                autograd.remove_grad_ready_hook(hook)
+        return [p.list_grad()[0].asnumpy()
+                for p in net.collect_params().values()]
+
+    for a, b in zip(grads(False), grads(True)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_remove_grad_ready_hook_absent_is_noop():
+    autograd.remove_grad_ready_hook(lambda leaf: None)
+
+
+# ===========================================================================
+# ReadyScheduler: event-driven bucket assembly
+# ===========================================================================
+
+def _raw(n, fill=1.0):
+    return jnp.full((n,), fill, jnp.float32)
+
+
+def test_ready_scheduler_free_mode_reasons_and_boundaries():
+    got = []
+    sched = engine_ready.ReadyScheduler(
+        lambda bucket, spec=None: got.append(bucket), cap_bytes=40)
+    sched.add("a", _raw(5))       # 20B, open
+    sched.add("b", _raw(5))       # 40B, still open (== cap is full next add)
+    sched.add("c", _raw(5))       # overflow: [a,b] flush as "ready"
+    sched.add("big", _raw(64))    # oversize alone
+    sched.drain()                 # tail [c] flushes as "final"
+    assert [b.reason for b in got] == ["ready", "oversize", "final"]
+    assert [list(b.keys) for b in got] == [["a", "b"], ["big"], ["c"]]
+
+
+def test_ready_scheduler_cap0_dispatches_per_key_immediately():
+    got = []
+    sched = engine_ready.ReadyScheduler(
+        lambda bucket, spec=None: got.append(bucket), cap_bytes=0)
+    sched.add("x", _raw(2))
+    assert [list(b.keys) for b in got] == [["x"]]   # BEFORE drain
+    sched.add("y", _raw(2))
+    sched.drain()
+    assert [list(b.keys) for b in got] == [["x"], ["y"]]
+    assert all(b.reason == "ready" for b in got)
+
+
+def test_ready_scheduler_frozen_mode_canonical_order():
+    entries = [(k, _raw(4, float(i))) for i, k in enumerate("abcd")]
+    layout = engine.BucketLayout.from_entries(entries, world=1,
+                                              cap_bytes=32)
+    assert len(layout) == 2       # [a,b] and [c,d]
+    got = []
+    sched = engine_ready.ReadyScheduler(
+        lambda bucket, spec: got.append((spec.index, list(bucket.keys))),
+        layout=layout)
+    # arrival order is fully reversed: buckets still assemble in each
+    # spec's canonical key order, completing out of bucket-index order
+    for k, r in reversed(entries):
+        sched.add(k, r)
+    sched.drain()
+    assert got == [(1, ["c", "d"]), (0, ["a", "b"])]
+
+
+def test_ready_scheduler_frozen_mode_guards():
+    entries = [("a", _raw(4)), ("b", _raw(4))]
+    layout = engine.BucketLayout.from_entries(entries, world=1,
+                                              cap_bytes=1 << 20)
+    sched = engine_ready.ReadyScheduler(lambda b, s: None, layout=layout)
+    with pytest.raises(ValueError, match="not in the frozen bucket layout"):
+        sched.add("zz", _raw(4))
+    sched.add("a", _raw(4))
+    with pytest.raises(ValueError, match="b"):
+        sched.drain()             # bucket incomplete: missing key named
+
+
+# ===========================================================================
+# Trainer parity: readiness vs registration, bit-exact
+# ===========================================================================
+
+def _train(comm_ready, cap, steps=4, uok=True, zero=None, kvstore="device",
+           opt_kw=None):
+    mx.random.seed(0)
+    np.random.seed(0)
+    with engine.bucket_mb_scope(cap):
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(8),
+                    nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           dict(opt_kw or {"learning_rate": 0.125,
+                                           "momentum": 0.5}),
+                           kvstore=kvstore, update_on_kvstore=uok,
+                           zero=zero, comm_ready=comm_ready)
+        x = nd.array(np.random.RandomState(1).randn(8, 10)
+                     .astype(np.float32))
+        y = nd.array(np.ones((8,), np.float32))
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        for _ in range(steps):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(8)
+        return tr, [p.data().asnumpy()
+                    for p in net.collect_params().values()]
+
+
+@pytest.mark.parametrize("cap", [None, 0.0001, 0])
+def test_trainer_readiness_parity_local(cap):
+    before = _counters()
+    _, a = _train(True, cap)
+    after = _counters()
+    _, b = _train(False, cap)
+    for pa, pb in zip(a, b):
+        np.testing.assert_array_equal(pa, pb)
+    # the first step goes registration (kv uninitialized during its
+    # backward); every later round is readiness-ordered
+    assert _delta(before, after, "comm.ready.rounds") == 3
+    assert _delta(before, after, "comm.ready.aborted") == 0
+
+
+def test_trainer_readiness_first_flush_before_backward_end():
+    """The acceptance counter: with buckets smaller than the grad set the
+    FIRST collective launches while backward is still running."""
+    before = _counters()
+    _train(True, 0.0001)
+    after = _counters()
+    assert _delta(before, after,
+                  "comm.ready.first_flush_before_backward_end") >= 1
+    assert _delta(before, after, "comm.ready.flush_during_backward") >= 1
+    reason_ready = sum(
+        _delta(before, after, k) for k in after
+        if k.startswith("comm.bucket.flush_reason.ready"))
+    assert reason_ready >= 1 or _delta(
+        before, after, "comm.bucket.flush_reason.oversize") >= 1
+
+
+def test_trainer_readiness_parity_pushpull():
+    """update_on_kvstore=False: readiness launches feed the SAME grads
+    back through the deferred out-broadcast at finish()."""
+    _, a = _train(True, 0.0001, uok=False, kvstore=mx.kv.create("device"))
+    _, b = _train(False, 0.0001, uok=False, kvstore=mx.kv.create("device"))
+    for pa, pb in zip(a, b):
+        np.testing.assert_array_equal(pa, pb)
+
+
+@pytest.mark.parametrize("cap", [None, 0.0001, 0])
+def test_trainer_readiness_parity_dist_single_worker(cap):
+    from mxnet_tpu.kvstore.kvstore_dist import KVStoreDist
+    _, a = _train(True, cap, kvstore=KVStoreDist("dist_sync"))
+    _, b = _train(False, cap, kvstore=KVStoreDist("dist_sync"))
+    for pa, pb in zip(a, b):
+        np.testing.assert_array_equal(pa, pb)
+
+
+@pytest.mark.parametrize("cap", [None, 0.0001])
+def test_trainer_readiness_parity_zero(cap):
+    before = _counters()
+    _, a = _train(True, cap, zero=True)
+    after = _counters()
+    _, b = _train(False, cap, zero=True)
+    _, c = _train(False, cap, zero=None)
+    for pa, pb, pc in zip(a, b, c):
+        np.testing.assert_array_equal(pa, pb)
+        np.testing.assert_array_equal(pa, pc)   # and vs non-ZeRO baseline
+    assert _delta(before, after, "comm.ready.rounds") == 3
+    if cap == 0.0001:
+        # multi-bucket layout: update(N) pipelines against ag(N-1)
+        assert _delta(before, after, "comm.zero.pipelined") >= 1
+
+
+def test_trainer_readiness_env_optin(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_COMM_READY", "1")
+    before = _counters()
+    _, a = _train(None, 0.0001)
+    after = _counters()
+    assert _delta(before, after, "comm.ready.rounds") == 3
+    monkeypatch.delenv("MXNET_TPU_COMM_READY")
+    _, b = _train(None, 0.0001)
+    for pa, pb in zip(a, b):
+        np.testing.assert_array_equal(pa, pb)
+
+
+def test_trainer_readiness_grad_accumulation_aborts():
+    """A second backward before step() means gradient accumulation: the
+    armed session must be discarded (its launches are pure — nothing was
+    mutated) and the step must fall back to the final grad buffers."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    with engine.bucket_mb_scope(0.0001):
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(4, in_units=3))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, update_on_kvstore=True,
+                           comm_ready=True)
+        x = nd.array(np.ones((2, 3), np.float32))
+
+        def backward():
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+
+        backward()
+        tr.step(2)                 # round 1: registration (kv init)
+        before = _counters()
+        backward()                 # round 2 arms a session...
+        backward()                 # ...round 3 must abort it
+        tr.step(2)
+        after = _counters()
+        assert _delta(before, after, "comm.ready.aborted") >= 1
+        # grads from the LAST backward applied (write semantics)
+        expected = [p.data().asnumpy() for p in
+                    net.collect_params().values()]
+        assert all(np.isfinite(p).all() for p in expected)
+
+
+def test_trainer_readiness_fault_injected_bucket_retry():
+    """Per-bucket retry fires under out-of-order readiness flush with the
+    bucket keys in the error context — and the step still lands the same
+    parameters as the registration path under the same plan. Store-replace
+    mode (update_on_kvstore=False): readiness launches are immutable, so
+    the bucket replays as a unit."""
+    from mxnet_tpu.resilience import faults
+
+    def run(comm_ready):
+        mx.random.seed(0)
+        np.random.seed(0)
+        with engine.bucket_mb_scope(0.0001):
+            net = nn.HybridSequential()
+            with net.name_scope():
+                net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+            net.initialize(mx.init.Xavier())
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.125},
+                               kvstore=mx.kv.create("device"),
+                               update_on_kvstore=False,
+                               comm_ready=comm_ready)
+            x = nd.array(np.random.RandomState(1).randn(4, 6)
+                         .astype(np.float32))
+            y = nd.array(np.ones((4,), np.float32))
+            loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+            for step in range(3):
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                if step == 2:
+                    with faults.inject("kvstore.push:error:1"):
+                        tr.step(4)
+                else:
+                    tr.step(4)
+            return [p.data().asnumpy()
+                    for p in net.collect_params().values()]
+
+    before = _counters()
+    a = run(True)
+    after = _counters()
+    assert _delta(before, after, "resilience.retries.kvstore.push") >= 1
+    assert _delta(before, after, "comm.ready.rounds") >= 1
+    b = run(False)
+    for pa, pb in zip(a, b):
+        np.testing.assert_array_equal(pa, pb)
+
+
+def test_bucket0_escape_hatch_per_key_spans_in_ready_order():
+    """ISSUE 19 satellite: at bucket_mb=0 the per-key pushes route through
+    the ready callback, so `comm.key[...]` spans appear in LAUNCH
+    (readiness) order — reverse registration order for a chain net."""
+    telemetry.reset()
+    _train(True, 0, steps=2)
+    names = [ev[0] for ev in telemetry.span_events()
+             if ev[0].startswith("comm.key[")]
+    assert names, "no per-key comm spans recorded"
+    # steps feed 6 params (3 layers x weight+bias); readiness order within
+    # a round is last-registered-first — the same set, key order reversed
+    per_round = len(set(names))
+    first_round = names[-per_round:]
+    keys = [n[len("comm.key["):-1] for n in first_round]
+    assert keys == sorted(keys, key=int, reverse=True)
+
+
+# ===========================================================================
+# ZeRO readiness at world=2/4 on the injectable fleet
+# ===========================================================================
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_zero_readiness_worldN_out_of_order_parity(world):
+    rng = np.random.RandomState(7)
+    shapes = [(24,), (17,), (33,), (8,)]
+    keys = [str(i) for i in range(len(shapes))]
+    steps = [[rng.randn(*s).astype(np.float32) for s in shapes]
+             for _ in range(3)]
+    init_w = [rng.randn(*s).astype(np.float32) for s in shapes]
+
+    def run(ready):
+        out = {}
+
+        def worker(rank, comm):
+            zu = ZeroUpdater(opt_create("sgd", learning_rate=0.25,
+                                        momentum=0.5, rescale_grad=1.0),
+                             comm=comm)
+            ws = [nd.array(w.copy()) for w in init_w]
+            by_key = dict(zip(keys, ws))
+            with engine.bucket_mb_scope(0.0001):
+                # first step always registration: freezes the layout
+                zu.step(keys, [jnp.asarray(g) for g in steps[0]], ws)
+                for grads in steps[1:]:
+                    if not ready:
+                        zu.step(keys, [jnp.asarray(g) for g in grads], ws)
+                        continue
+                    graw = dict(zip(keys, [jnp.asarray(g) for g in grads]))
+                    arrivals = []
+                    # buckets complete in REVERSED layout order on every
+                    # rank (same SPMD readiness order), exercising
+                    # finish_ready's any-permutation contract
+                    for spec in reversed(list(zu.layout)):
+                        flat = engine.pack_flat(
+                            spec, [graw[k] for k in spec.keys])
+                        arrivals.append(
+                            (spec, zu.scatter_ready(spec, flat, by_key)))
+                    zu.finish_ready(arrivals, by_key)
+            if rank == 0:
+                out["w"] = [w.asnumpy() for w in ws]
+
+        _run_fleet(world, worker)
+        return out["w"]
+
+    before = _counters()
+    a = run(True)
+    after = _counters()
+    b = run(False)
+    for pa, pb in zip(a, b):
+        np.testing.assert_array_equal(pa, pb)
+    assert _delta(before, after, "comm.zero.pipelined") >= 1
+
+
+def test_zero_frozen_layout_stable_across_reordered_steps():
+    """Readiness rounds with shuffled completion order must not disturb
+    the frozen layout (same payload every step, same as registration)."""
+    rng = np.random.RandomState(1)
+    shapes = [(10,), (6,), (14,)]
+    keys = [str(i) for i in range(len(shapes))]
+    zu = ZeroUpdater(opt_create("sgd", learning_rate=0.5))
+    ws = [nd.array(rng.randn(*s).astype(np.float32)) for s in shapes]
+    by_key = dict(zip(keys, ws))
+    with engine.bucket_mb_scope(0.0001):
+        zu.step(keys, [jnp.asarray(rng.randn(*s).astype(np.float32))
+                       for s in shapes], ws)
+        frozen = zu.layout.to_payload()
+        orders = [list(zu.layout), list(reversed(list(zu.layout)))]
+        for order in orders:
+            graw = {k: jnp.asarray(rng.randn(*s).astype(np.float32))
+                    for k, s in zip(keys, shapes)}
+            arrivals = [(spec, zu.scatter_ready(
+                spec, engine.pack_flat(spec, [graw[k] for k in spec.keys]),
+                by_key)) for spec in order]
+            zu.finish_ready(arrivals, by_key)
+            assert zu.layout.to_payload() == frozen
+
+
+def test_zero_finish_ready_rejects_incomplete_round():
+    zu = ZeroUpdater(opt_create("sgd", learning_rate=0.5))
+    ws = [nd.array(np.ones(4, np.float32)), nd.array(np.ones(6, np.float32))]
+    by_key = {"0": ws[0], "1": ws[1]}
+    with engine.bucket_mb_scope(0.00001):
+        zu.step(["0", "1"], [jnp.ones((4,), jnp.float32),
+                             jnp.ones((6,), jnp.float32)], ws)
+        spec = list(zu.layout)[0]
+        g = zu.scatter_ready(spec, engine.pack_flat(
+            spec, [jnp.ones((4,), jnp.float32)]), by_key)
+        with pytest.raises(ValueError):
+            zu.finish_ready([(spec, g)], by_key)
+
+
+# ===========================================================================
+# schedule autotuner
+# ===========================================================================
+
+def test_comm_schedule_payload_roundtrip():
+    sched = engine.CommSchedule(4.0, "ready", score=1.5, source="autotune")
+    back = engine.CommSchedule.from_payload(sched.to_payload())
+    assert back == sched and back.score == 1.5
+    with pytest.raises(ValueError):
+        engine.CommSchedule(4.0, "nonsense")
+    with pytest.raises(ValueError):
+        engine.CommSchedule.from_payload({"schedule_format": 99})
+
+
+def test_autotuner_scores_and_pins_winner(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_COMM_AUTOTUNE", "1")
+    monkeypatch.setenv("MXNET_TPU_COMM_AUTOTUNE_STEPS", "1")
+    monkeypatch.setenv("MXNET_TPU_COMM_AUTOTUNE_CAPS", "0,25")
+    before = _counters()
+    tr, a = _train(None, None, steps=6)
+    after = _counters()
+    tuner = tr._autotune
+    assert tuner is not None and tuner.done
+    assert len(tuner.results) == 4          # 2 caps x 2 policies
+    chosen = engine.current_schedule()
+    assert chosen is not None and chosen.source == "autotune"
+    assert chosen.score == min(c.score for c, _ in tuner.results)
+    assert _delta(before, after, "comm.autotune.sweeps") == 1
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["comm.schedule.bucket_mb"]["value"] == chosen.bucket_mb
+    # every swept schedule stayed bit-identical: the sweep run's final
+    # params match a plain registration run of the same traffic
+    _, b = _train(False, None, steps=6)
+    for pa, pb in zip(a, b):
+        np.testing.assert_array_equal(pa, pb)
+
+
+def test_autotuner_restored_runs_zero_sweep_steps():
+    sched = engine.CommSchedule(25.0, "ready", source="checkpoint")
+    tuner = engine.ScheduleAutotuner.restored(sched)
+    assert tuner.done and tuner.sweep_steps == 0
+    assert tuner.on_step_end() is sched
+    assert tuner.sweep_steps == 0
+
+
+def test_zero_state_payload_carries_schedule_and_restores():
+    engine.set_schedule(engine.CommSchedule(4.0, "ready", score=0.5,
+                                            source="autotune"))
+    zu = ZeroUpdater(opt_create("sgd", learning_rate=0.5))
+    ws = [nd.array(np.ones(4, np.float32))]
+    zu.step(["0"], [jnp.ones((4,), jnp.float32)], ws)
+    payload = zu.state_payload()
+    assert payload["comm_schedule"]["bucket_mb"] == 4.0
+    engine.set_schedule(None)
+    zu.load_state_payload(payload)
+    restored = engine.current_schedule()
+    assert restored is not None and restored.policy == "ready"
+    assert restored.source == "checkpoint"
+    assert engine.bucket_bytes() == int(4.0 * 1024 * 1024)
+
+
+def test_trainer_restart_after_restore_skips_sweep(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_COMM_AUTOTUNE", "1")
+    monkeypatch.setenv("MXNET_TPU_COMM_AUTOTUNE_STEPS", "1")
+    monkeypatch.setenv("MXNET_TPU_COMM_AUTOTUNE_CAPS", "0,25")
+    tr, _ = _train(None, None, steps=6, zero=True)
+    assert tr._autotune.done
+    payload = tr._kvstore._updater.state_payload()
+    chosen = engine.current_schedule()
+    assert payload["comm_schedule"] == chosen.to_payload()
+    engine.set_schedule(None)
+    # "relaunch": fresh trainer, restore, then train — no sweeping
+    tr2, _ = _train(None, None, steps=1, zero=True)
+    tr2._kvstore._updater.load_state_payload(payload)
+    mx.random.seed(0)
+    x = nd.array(np.ones((2, 10), np.float32))
+    net = nn.Dense(2, in_units=10)
+    net.initialize()
+    tr3 = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1}, update_on_kvstore=True)
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr3.step(2)
+    assert tr3._autotune is not None and tr3._autotune.done
+    assert tr3._autotune.sweep_steps == 0
+    assert tr3._autotune.current() == chosen
+
+
+def test_resilient_runner_checkpoint_carries_schedule(tmp_path):
+    from mxnet_tpu.resilience.errors import PreemptionError
+    from mxnet_tpu.resilience.run import ResilientRunner
+    engine.set_schedule(engine.CommSchedule(25.0, "ready",
+                                            source="autotune"))
+    state = {"step": 0}
+    seen = {}
+
+    def step_fn(step):
+        state["step"] = step
+        if step == 2 and "crashed" not in seen:
+            seen["crashed"] = True
+            raise PreemptionError("host reclaimed")
+        return 0.0
+
+    def state_set(tree):
+        seen["restored_tree"] = dict(tree)
+        state.update(tree)
+        # the schedule was consumed by the runner BEFORE state_set
+        seen["sched_at_restore"] = engine.current_schedule()
+
+    runner = ResilientRunner(
+        step_fn, state_get=lambda: dict(state), state_set=state_set,
+        ckpt_dir=str(tmp_path), ckpt_every=1, max_restarts=2)
+    # pin cleared mid-run simulates the relaunched process
+    orig_restore = runner._restore
+
+    def clearing_restore(report, cause):
+        engine.set_schedule(None)
+        return orig_restore(report, cause)
+
+    runner._restore = clearing_restore
+    runner.run(4)
+    assert "comm_schedule" not in seen["restored_tree"]
+    restored = seen["sched_at_restore"]
+    assert restored is not None and restored.bucket_mb == 25.0
+    assert restored.policy == "ready" and restored.source == "checkpoint"

@@ -59,6 +59,22 @@ _DIRECTION_OVERRIDES = {
     "sparse_rows_pct": "both",
     "lookup_ms_p50": "down",
     "lookup_ms_p99": "down",
+    # BENCH=comm readiness legs (ISSUE 19): overlap fraction is a share
+    # (no throughput/latency suffix) and the collective_ms_* rows end in
+    # the leg name, not _ms — pin both directions explicitly
+    "overlap_frac": "up",
+    "collective_ms": "down",
+}
+
+# built-in per-metric tolerance floors, longest-prefix match (CLI
+# --tolerance still overrides). Sub-millisecond CPU comm timings swing
+# far past the 10% default from scheduler jitter alone — an interleaved
+# same-code A/B shows ±20-50% run-to-run — so gating them at 10% flags
+# pure noise. The readiness A/B's load-bearing signal (overlap_frac,
+# a ratio of spans from the SAME run) keeps the tight default.
+_TOLERANCE_OVERRIDES = {
+    "collective_ms_comm": 0.75,
+    "comm_grad_sync_cpu": 0.30,
 }
 
 
@@ -83,10 +99,14 @@ def direction_for(metric):
 
 def _tolerance_for(metric, tolerances, default):
     """Longest matching prefix wins: `--tolerance serve=0.2` covers every
-    serve_* metric unless a longer prefix is also given."""
+    serve_* metric unless a longer prefix is also given. CLI overrides
+    shadow the built-in `_TOLERANCE_OVERRIDES` at equal prefix length."""
     best, best_len = default, -1
-    for prefix, tol in tolerances.items():
+    for prefix, tol in _TOLERANCE_OVERRIDES.items():
         if metric.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = tol, len(prefix)
+    for prefix, tol in tolerances.items():
+        if metric.startswith(prefix) and len(prefix) >= best_len:
             best, best_len = tol, len(prefix)
     return best
 

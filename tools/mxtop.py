@@ -166,6 +166,26 @@ def render(payload, prev_payload=None, dt=None, source=""):
         lines.append("  %-14s %14s total %14s"
                      % ("collectives", coll,
                         ("%.1f/s" % rate) if rate is not None else ""))
+    # comm schedule + measured overlap (ISSUE 19): which (cap, policy) is
+    # live and how much of the comm phase the step windows hide
+    gauges = snap.get("gauges", {})
+    row = []
+    cap_g = gauges.get("comm.schedule.bucket_mb", {})
+    if isinstance(cap_g, dict) and cap_g.get("value") is not None:
+        ready_g = gauges.get("comm.schedule.ready", {})
+        policy = "ready" if (isinstance(ready_g, dict)
+                             and ready_g.get("value")) else "registration"
+        row.append("schedule=%gMB/%s" % (cap_g["value"], policy))
+    fracs = [(n[len("attrib."):-len(".overlap_frac")], g.get("value"))
+             for n, g in sorted(gauges.items())
+             if n.startswith("attrib.") and n.endswith(".overlap_frac")
+             and isinstance(g, dict) and g.get("value") is not None]
+    row.extend("overlap_frac[%s]=%.2f" % (site, v) for site, v in fracs)
+    rounds = counters.get("comm.ready.rounds")
+    if rounds:
+        row.append("ready_rounds=%d" % rounds)
+    if row:
+        lines.append("  " + "  ".join(row))
     lines.append("")
 
     # --- compiles -------------------------------------------------------

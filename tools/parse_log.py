@@ -149,7 +149,11 @@ def parse_comm(obj):
     rows = []
     ordered = ("comm.collectives", "comm.reduce_scatter", "comm.all_gather",
                "comm.bucket.count", "comm.bucket.bytes",
-               "comm.bucket.skipped", "kvstore.push_calls",
+               "comm.bucket.skipped", "comm.ready.rounds",
+               "comm.ready.flush_during_backward",
+               "comm.ready.first_flush_before_backward_end",
+               "comm.ready.aborted", "comm.zero.pipelined",
+               "comm.autotune.sweeps", "kvstore.push_calls",
                "kvstore.push_bytes", "kvstore.pull_calls",
                "kvstore.pull_bytes")
     for name in ordered:
@@ -168,6 +172,19 @@ def parse_comm(obj):
         rows.append(("opt.fused_updates", fused["count"]))
         rows.append(("opt.fused_update_ms_avg",
                      round(fused.get("sum", 0.0) / fused["count"], 3)))
+    # the chosen comm schedule (autotuner winner or checkpoint-restored):
+    # bucket cap + flush policy as one human row (ISSUE 19)
+    gauges = obj.get("gauges", {})
+    cap_g = gauges.get("comm.schedule.bucket_mb")
+    if isinstance(cap_g, dict) and cap_g.get("value") is not None:
+        ready_g = gauges.get("comm.schedule.ready", {})
+        policy = "ready" if (isinstance(ready_g, dict)
+                             and ready_g.get("value")) else "registration"
+        rows.append(("comm.schedule",
+                     "%gMB/%s" % (cap_g["value"], policy)))
+    sweep_g = gauges.get("comm.autotune.sweep_steps")
+    if isinstance(sweep_g, dict) and sweep_g.get("value") is not None:
+        rows.append(("comm.autotune.sweep_steps", int(sweep_g["value"])))
     buckets = counters.get("comm.bucket.count", 0)
     if buckets:
         rows.append(("avg_bucket_kb",
