@@ -892,7 +892,9 @@ def bench_resilience(on_accel):
     Y = rng.randint(0, 4, (steps, 32)).astype(np.float32)
 
     def batch_fn(i):
-        return nd.array(X[i]), nd.array(Y[i])
+        # modulo: a rollback skip advances the data index past the last
+        # pre-generated batch
+        return nd.array(X[i % steps]), nd.array(Y[i % steps])
 
     ckpt_dir = tempfile.mkdtemp(prefix="bench_resilience_")
     # notice on the 2nd poll (proactive path: zero replay), hard preemption
@@ -908,6 +910,104 @@ def bench_resilience(on_accel):
         report = runner.run(steps)
     listener.stop()
     total_s = time.perf_counter() - t0
+
+    # --- integrity plane (PR 20) -------------------------------------
+    # (a) sentinel overhead A/B: identical clean runs with the fused
+    # all-finite check off vs on — the check is ONE scalar reduction
+    # riding the already-materialised flat buckets plus one host sync,
+    # budget <=2%. Measured on a model whose step time is realistic
+    # (~20ms): the sync is a fixed per-step cost, and quoting it against
+    # a sub-ms toy step would overstate it ~20x;
+    # (b) rollback exercise: a corrupt batch plus a corrupt newest
+    # snapshot drive rollback-to-last-good and the checksum fallback.
+    def _with_integrity(value, fn):
+        old = os.environ.get("MXNET_TPU_INTEGRITY")
+        os.environ["MXNET_TPU_INTEGRITY"] = value
+        try:
+            return fn()
+        finally:
+            if old is None:
+                os.environ.pop("MXNET_TPU_INTEGRITY", None)
+            else:
+                os.environ["MXNET_TPU_INTEGRITY"] = old
+
+    def _ab_fused():
+        mx.random.seed(0)
+        n2 = gluon.nn.HybridSequential()
+        with n2.name_scope():
+            n2.add(gluon.nn.Dense(512, activation="relu"),
+                   gluon.nn.Dense(512, activation="relu"),
+                   gluon.nn.Dense(16))
+        n2.initialize(mx.init.Xavier())
+        t2 = gluon.Trainer(n2.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        return gluon.FusedTrainStep(
+            n2, gluon.loss.SoftmaxCrossEntropyLoss(), t2)
+
+    ab_rng = np.random.RandomState(1)
+    ab_x = nd.array(ab_rng.rand(128, 256).astype(np.float32))
+    ab_y = nd.array(ab_rng.randint(0, 16, (128,)).astype(np.float32))
+    fused_off = _with_integrity("0", _ab_fused)  # sentinel baked at build
+    fused_on = _with_integrity("1", _ab_fused)
+    fused_off(ab_x, ab_y).asnumpy()  # compile outside the timed window
+    _with_integrity("1", lambda: fused_on(ab_x, ab_y).asnumpy())
+
+    def _chunk(fused2):
+        n = 10
+        t = time.perf_counter()
+        for _ in range(n):
+            # per-step loss sync in BOTH legs: the runner records a float
+            # loss every step (run.py RunReport.losses) whether or not the
+            # sentinel is on, so the A/B isolates the sentinel's marginal
+            # cost — the fused reduction — not the loop's own sync
+            fused2(ab_x, ab_y).asnumpy()
+        return n / (time.perf_counter() - t)
+
+    # paired chunks, median-of-8: adjacent off/on chunks share the box's
+    # load conditions, so the per-pair ratio cancels drift and the median
+    # sheds spike outliers
+    pairs = []
+    for _ in range(8):
+        off = _chunk(fused_off)
+        on = _with_integrity("1", lambda: _chunk(fused_on))
+        pairs.append((off, on))
+    ratios = sorted(on / off for off, on in pairs)
+    mid = (ratios[3] + ratios[4]) / 2.0
+
+    def _fresh_fused():
+        mx.random.seed(0)
+        n3 = gluon.nn.HybridSequential()
+        with n3.name_scope():
+            n3.add(gluon.nn.Dense(32, activation="relu"),
+                   gluon.nn.Dense(4))
+        n3.initialize(mx.init.Xavier())
+        t3 = gluon.Trainer(n3.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        return gluon.FusedTrainStep(
+            n3, gluon.loss.SoftmaxCrossEntropyLoss(), t3)
+
+    def _rollback_leg():
+        from mxnet_tpu import telemetry as _telem
+        c0 = _telem.snapshot()["counters"].get(
+            "checkpoint.corrupt_fallbacks", 0)
+        fused3 = _fresh_fused()
+        rb_dir = tempfile.mkdtemp(prefix="bench_rollback_")
+        # the 3rd prepare is the step-4 snapshot — the NEWEST candidate
+        # when the step-4 divergence rolls back, so the checksum fallback
+        # path (restore 2, replay) actually runs
+        with faults.inject("train.batch:corrupt:5;"
+                           "checkpoint.corrupt:corrupt:3;"
+                           "run.step:preempt:9"):
+            rb_runner = rz.ResilientRunner.for_fused_step(
+                fused3, batch_fn, ckpt_dir=rb_dir, ckpt_every=2,
+                max_restarts=4)
+            rb_report = rb_runner.run(steps)
+        fallbacks = _telem.snapshot()["counters"].get(
+            "checkpoint.corrupt_fallbacks", 0) - c0
+        return rb_report, fallbacks
+
+    rb_report, corrupt_restores = _with_integrity("1", _rollback_leg)
+
     return {
         "metric": ("resilience_recovery_time_s" if on_accel
                    else "resilience_cpu_recovery_time_s"),
@@ -919,6 +1019,10 @@ def bench_resilience(on_accel):
         "proactive_ckpt": report.proactive_ckpts,
         "restarts": report.restarts,
         "checkpoints": report.checkpoints,
+        "rollbacks": rb_report.rollbacks,
+        "skipped_batches": rb_report.skipped_batches,
+        "corrupt_restores": corrupt_restores,
+        "integrity_overhead_pct": round((1.0 - mid) * 100.0, 2),
     }
 
 

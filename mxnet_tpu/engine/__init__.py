@@ -382,12 +382,22 @@ def _split_points(shapes):
     return sizes, list(_np.cumsum(sizes)[:-1])
 
 
-def fused_bucket_fn(tag, comm_fn, shapes, dtype, n_slots=1):
+def fused_bucket_fn(tag, comm_fn, shapes, dtype, n_slots=1,
+                    with_finite=False):
     """Compile (and cache) ONE program: flatten `n_slots` groups of arrays
     with these shapes, run ``comm_fn(*flats)`` (flat vector per slot ->
     one flat vector), and unflatten back to `shapes`. This is the bucket's
-    single launch — XLA fuses pack, comm, and scatter."""
-    key = (tag, int(n_slots), tuple(tuple(s) for s in shapes), str(dtype))
+    single launch — XLA fuses pack, comm, and scatter.
+
+    with_finite=True appends ONE extra output: a scalar bool, True iff the
+    post-comm flat vector is all-finite — the integrity sentinel's bucket
+    check, fused into the launch the collective already pays for (a
+    non-finite input propagates through any sum/identity comm_fn, so
+    checking the output covers both legs). Cached separately from the
+    plain program, so toggling MXNET_TPU_INTEGRITY never poisons a warm
+    cache."""
+    key = (tag, int(n_slots), tuple(tuple(s) for s in shapes), str(dtype),
+           bool(with_finite))
     fn = _FUSED_CACHE.get(key)
     if fn is not None:
         return fn
@@ -402,7 +412,10 @@ def fused_bucket_fn(tag, comm_fn, shapes, dtype, n_slots=1):
                          if nshapes > 1 else grp[0].reshape(-1))
         out = comm_fn(*flats)
         parts = jnp.split(out, splits) if splits else [out]
-        return tuple(p.reshape(sh) for p, sh in zip(parts, shapes))
+        shaped = tuple(p.reshape(sh) for p, sh in zip(parts, shapes))
+        if with_finite:
+            return shaped + (jnp.isfinite(out).all(),)
+        return shaped
 
     fn = jax.jit(run)
     _FUSED_CACHE[key] = fn

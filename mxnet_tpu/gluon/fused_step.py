@@ -546,6 +546,13 @@ class FusedTrainStep:
             self._label_sharding = NamedSharding(
                 mesh, _P(bspec[0] if len(bspec) else None))
 
+        # the sentinel flag is baked into the traced program (an extra
+        # output changes the signature), so it is read ONCE at build time:
+        # enable MXNET_TPU_INTEGRITY before the first step (or rebuild)
+        from ..resilience import integrity as _integrity
+        sentinel = _integrity.enabled()
+        self._sentinel = sentinel
+
         def make_program(in_fmt):
             # one (jitted, holder) pair per input nesting: the trace reads
             # in_fmt and records its own aux-target order, so neither may be
@@ -611,6 +618,18 @@ class FusedTrainStep:
                                   sc, rescale)
                     new_train.append(w.astype(train_raws[j].dtype))
                     new_states.append(_state_cast_like(s, state_raws[j]))
+                if sentinel:
+                    # integrity sentinel (MXNET_TPU_INTEGRITY=1 at build
+                    # time): one fused all-finite scalar over the raw
+                    # grads + loss, emitted as an extra program output —
+                    # the whole-step analog of the bucket check. The host
+                    # checks it BEFORE any write-back, so a tripped step
+                    # leaves params/states untouched.
+                    fin = jax.tree_util.tree_reduce(
+                        lambda a, g: a & jnp.isfinite(g).all(), list(grads),
+                        jnp.isfinite(loss_mean))
+                    return (tuple(new_train), tuple(new_states), aux_new,
+                            loss_mean, fin)
                 return tuple(new_train), tuple(new_states), aux_new, loss_mean
 
             donate = (0, 2) if self._donate else ()
@@ -752,9 +771,20 @@ class FusedTrainStep:
             self._maybe_aot(jitted, step_args, sig, repr(in_fmt))
         aot = self._aot_progs.get(repr(in_fmt))
         if aot is not None and aot[1] == sig:
-            new_train, new_states, aux_new, loss_mean = aot[0](*step_args)
+            outs = aot[0](*step_args)
         else:
-            new_train, new_states, aux_new, loss_mean = jitted(*step_args)
+            outs = jitted(*step_args)
+        if getattr(self, "_sentinel", False):
+            new_train, new_states, aux_new, loss_mean, fin = outs
+            from ..resilience import integrity as _integrity
+            # raises DivergenceError BEFORE any write-back: a tripped
+            # step leaves params, states, and aux exactly as they were
+            _integrity.check_scalar(
+                fin, site="fused_step",
+                keys=[p.name for p in getattr(self, "_train_params", [])
+                      if hasattr(p, "name")])
+        else:
+            new_train, new_states, aux_new, loss_mean = outs
         if pallas_before is not None:
             # unconditionally: a recompile that fuses ZERO kernels (gate
             # turned off, shapes fell back) must not leave a stale count

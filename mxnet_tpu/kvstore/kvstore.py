@@ -491,8 +491,16 @@ class KVStoreLocal(KVStore):
                 for f in flats[1:]:
                     acc = acc + f
                 return acc
+        # integrity sentinel (MXNET_TPU_INTEGRITY=1): the fused program
+        # also emits an all-finite scalar over the merged flat vector —
+        # one reduction riding the launch the merge already pays for. A
+        # trip raises DivergenceError HERE, before any store/updater
+        # write sees the poisoned values.
+        from ..resilience import integrity as _integrity
+        sentinel = _integrity.enabled()
         fn = _engine.fused_bucket_fn(tag, comm_fn, bucket.shapes,
-                                     bucket.dtype, n_slots=nrep)
+                                     bucket.dtype, n_slots=nrep,
+                                     with_finite=sentinel)
         raws = []
         for r in range(nrep):
             for k in bucket.keys:
@@ -500,9 +508,16 @@ class KVStoreLocal(KVStore):
         _telem.inc("comm.collectives")
         ts = _telem.span_clock()
         t0 = time.perf_counter()
-        parts = fn(*raws)
+        outs = fn(*raws)
+        if sentinel:
+            parts, fin = outs[:-1], outs[-1]
+        else:
+            parts = outs
         _telem.record_span(bucket.span_name(), _engine.SPAN_CAT_COMM,
                            ts, time.perf_counter() - t0)
+        if sentinel:
+            _integrity.check_scalar(fin, site="kvstore.bucket",
+                                    keys=bucket.keys)
         return parts
 
     def _push_bucketed(self, entries, cap, outs=None):

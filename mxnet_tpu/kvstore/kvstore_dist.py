@@ -582,24 +582,52 @@ class KVStoreDist(KVStoreLocal):
         context — then one unflatten, with per-key updater/store-write
         semantics unchanged. Buckets launch as they fill, so bucket N's
         collective overlaps bucket N+1's local merge + pack under async
-        dispatch (reference: engine-overlapped ZPush, SURVEY §3.4)."""
+        dispatch (reference: engine-overlapped ZPush, SURVEY §3.4).
+
+        ``MXNET_TPU_COMM_CHECKSUM=1`` arms the heavyweight wire check:
+        sha256 the packed bucket before the exchange (proves the local
+        send buffer was not mutated under the collective) and all-finite
+        the summed result after — a poisoned exchange raises
+        `DivergenceError` before any store/updater write. Costs one host
+        digest + one scalar sync per bucket; counter
+        ``comm.checksum.buckets``."""
+        import hashlib
+        import numpy as _np
         from .. import telemetry as _telem
         from ..resilience import faults as _faults
+        from ..resilience import integrity as _integrity
         from ..resilience.errors import (FatalTrainingError, ResilienceError,
                                          TransportError, classify)
         from ..resilience.retry import call_with_retry
         out_map = dict(outs) if outs is not None else None
         use_faults = _faults.active_plan() is not None
+        wire_check = _integrity.comm_checksum_enabled()
 
         def apply_bucket(bucket):
             context = ("bucket keys=[%s] %dB"
                        % (",".join(bucket.keys), bucket.nbytes))
             flat = _engine.pack_bucket(bucket)
+            sent_digest = None
+            if wire_check:
+                sent_digest = hashlib.sha256(
+                    _np.ascontiguousarray(_np.asarray(flat)).tobytes()
+                ).hexdigest()
             ts = _telem.span_clock()
             t0 = time.perf_counter()
             summed = self._allreduce(flat, context=context)
             _telem.record_span(bucket.span_name(), _engine.SPAN_CAT_COMM,
                                ts, time.perf_counter() - t0)
+            if wire_check:
+                _telem.inc("comm.checksum.buckets")
+                got = hashlib.sha256(_np.ascontiguousarray(
+                    _np.asarray(flat)).tobytes()).hexdigest()
+                if got != sent_digest:
+                    _integrity._raise(
+                        "kvstore_dist.bucket", bucket.keys,
+                        "send buffer mutated across the exchange "
+                        "(sha256 %s -> %s)" % (sent_digest[:12], got[:12]))
+                _integrity.check_finite(
+                    [summed], site="kvstore_dist.bucket", keys=bucket.keys)
             parts = _engine.unpack_bucket(bucket, summed)
             for k, part in zip(bucket.keys, parts):
                 stored = self._store[k]

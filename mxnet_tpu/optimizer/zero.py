@@ -290,6 +290,7 @@ class ZeroUpdater:
             self._ensure_shards(spec, weights_by_key)
             flat_g = _engine.pack_flat(
                 spec, [grads_by_key[k] for k in spec.keys])
+            self._guard_bucket(spec, flat_g)
             g_shard = self._scatter_leg(spec, flat_g)
             if pending is not None:
                 _telem.inc("comm.zero.pipelined")
@@ -301,6 +302,17 @@ class ZeroUpdater:
         # re-assert every step: gauges are cheap and `telemetry.reset()`
         # between measurement windows must not lose the footprint
         self._update_state_gauge()
+
+    def _guard_bucket(self, spec, flat_g):
+        """Integrity sentinel over one packed ZeRO bucket
+        (MXNET_TPU_INTEGRITY=1): the bucket is already ONE flat array, so
+        the all-finite check is a single fused reduction — it trips BEFORE
+        the reduce-scatter launches, so no shard update ever sees the
+        poisoned values."""
+        from ..resilience import integrity as _integrity
+        if _integrity.enabled():
+            _integrity.check_finite([flat_g], site="zero.bucket",
+                                    keys=spec.keys)
 
     def _scatter_leg(self, spec, flat_g):
         """The reduce-scatter leg for one bucket: fault site, counters,
@@ -358,6 +370,7 @@ class ZeroUpdater:
         members finish backward (frozen-layout readiness mode). Returns
         the g_shard handle `finish_ready` consumes."""
         self._ensure_shards(spec, weights_by_key)
+        self._guard_bucket(spec, flat_g)
         return self._scatter_leg(spec, flat_g)
 
     def finish_ready(self, arrivals, weights_by_key):

@@ -21,6 +21,14 @@ ahead rejoins at the step the rest of the fleet agreed on.
 `latest_committed_step` is the strict marker-only view. The
 ``checkpoint.save`` / ``checkpoint.restore`` fault sites make the
 mid-commit crash injectable (`MXNET_TPU_FAULT_PLAN`).
+
+Integrity (ISSUE 20): `save_sharded` stamps a leaf-wise sha256 sidecar
+(``<step>.sha256.json`` next to the step dir) over every leaf's host
+bytes; `restore_sharded` re-digests the restored tree and, on any
+mismatch — or an orbax-level read failure — counts ``checkpoint.corrupt``
+and falls back to the next-oldest step, raising `CheckpointCorruptError`
+only when no candidate verifies. Sidecar-less steps (pre-checksum
+checkpoints) restore unverified, so old run dirs stay loadable.
 """
 from __future__ import annotations
 
@@ -46,6 +54,64 @@ def _mgr(path, keep=None):
     # item_metadata returns None and restore raises KeyError on orbax 0.7
     return ocp.CheckpointManager(os.path.abspath(path), options=options,
                                  item_handlers=ocp.StandardCheckpointHandler())
+
+
+def _digest_sidecar(path, step):
+    return os.path.join(os.path.abspath(path), "%d.sha256.json" % int(step))
+
+
+_CANON_DTYPE = {"i": "int64", "u": "uint64", "f": "float64",
+                "c": "complex128"}
+
+
+def _tree_digests(tree):
+    """Leaf-wise sha256 over (kind, shape, canonical bytes) of each leaf's
+    host view, keyed by keypath. Digesting the host view (not the file
+    bytes) keeps the check codec-independent: whatever OCDBT does on disk,
+    the restored array must hash back to what was saved. Dtypes are
+    canonicalized to their widest same-kind form before hashing (an exact,
+    injective cast for every checkpointable dtype) because a restore under
+    a different x64 mode legitimately narrows scalar leaves — int64 '7'
+    and the int32 '7' it restores as must digest identically, while any
+    flipped VALUE bit still changes the hash."""
+    import hashlib
+    import numpy as _np
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    digests = {}
+    for keypath, leaf in flat:
+        arr = _np.asarray(jax.device_get(leaf))
+        canon = _CANON_DTYPE.get(arr.dtype.kind)
+        if canon is not None:
+            arr = arr.astype(canon)
+        h = hashlib.sha256()
+        h.update(arr.dtype.kind.encode("utf-8"))
+        h.update(str(arr.shape).encode("utf-8"))
+        h.update(_np.ascontiguousarray(arr).tobytes())
+        digests[jax.tree_util.keystr(keypath)] = h.hexdigest()
+    return digests
+
+
+def _write_digest_sidecar(path, tree, step):
+    import json
+    from ..util import atomic_write
+    atomic_write(_digest_sidecar(path, step),
+                 json.dumps(_tree_digests(tree), sort_keys=True,
+                            indent=0).encode("utf-8"))
+
+
+def _verify_restored(path, step, tree):
+    """True when the restored tree matches its sidecar (or no sidecar
+    exists — a pre-checksum checkpoint restores unverified)."""
+    import json
+    sidecar = _digest_sidecar(path, step)
+    if not os.path.isfile(sidecar):
+        return True
+    try:
+        with open(sidecar, "r", encoding="utf-8") as f:
+            want = json.load(f)
+    except (OSError, ValueError):
+        return False  # a torn sidecar is as suspect as a torn payload
+    return _tree_digests(tree) == want
 
 
 def _commit_latest_marker(path, step):
@@ -80,6 +146,12 @@ def save_sharded(path, tree, step=0, wait=True, keep=None,
         mgr.save(int(step), args=ocp.args.StandardSave(tree))
         if wait:
             mgr.wait_until_finished()
+            # integrity stamp: digest the host view we just saved. Guarded
+            # to single-process runs — on a pod a host only holds its own
+            # shards, so a host-local digest of the global tree is
+            # undefined (orbax's own OCDBT checksums cover that case).
+            if jax.process_count() == 1:
+                _write_digest_sidecar(path, tree, step)
             _faults.check("checkpoint.save",
                           context="step=%d mid-commit" % step)
             marked = int(step)
@@ -135,8 +207,17 @@ def restore_sharded(path, step=None, mesh=None, rules=None, template=None,
     coordinated=True (step=None): every rank reports its local newest
     committed step and all restore the elected minimum — ranks always
     agree, even after a mid-commit crash left one rank's disk a step
-    ahead."""
+    ahead.
+
+    Integrity: each candidate restore is re-digested against its
+    ``<step>.sha256.json`` sidecar; a mismatch — or an orbax read
+    failure — counts ``checkpoint.corrupt`` and the restore falls back
+    to the next-oldest step. `CheckpointCorruptError` only when every
+    candidate is bad."""
+    from .. import telemetry as _telem
+    from ..telemetry import flight as _flight
     from ..resilience import faults as _faults
+    from ..resilience.errors import CheckpointCorruptError, ResilienceError
     import orbax.checkpoint as ocp
     mgr = _mgr(path)
     try:
@@ -151,25 +232,61 @@ def restore_sharded(path, step=None, mesh=None, rules=None, template=None,
             if step is None:
                 raise FileNotFoundError("no checkpoint under %s" % path)
         _faults.check("checkpoint.restore", context="step=%d" % int(step))
-        if template is None and mesh is not None:
-            meta = mgr.item_metadata(int(step))
-            tree_meta = getattr(meta, "item_metadata", meta)
-            rules = rules or ShardingRules([])
-            flat, treedef = jax.tree_util.tree_flatten_with_path(tree_meta)
-            outs = []
-            for keypath, leaf in flat:
-                name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                                for k in keypath)
-                spec = rules.spec_for(name, tuple(leaf.shape), mesh)
-                outs.append(jax.ShapeDtypeStruct(
-                    tuple(leaf.shape), leaf.dtype,
-                    sharding=NamedSharding(mesh, spec)))
-            template = jax.tree_util.tree_unflatten(treedef, outs)
-        # StandardRestore(None) restores host-resident arrays with the
-        # saved topology — still explicit args, which a fresh manager
-        # requires
-        return mgr.restore(
-            int(step), args=ocp.args.StandardRestore(template))
+        candidates = [int(step)]
+        try:
+            known = sorted((int(s) for s in mgr.all_steps()), reverse=True)
+        except Exception:  # noqa: BLE001 — a scan failure only kills fallback
+            known = []
+        candidates += [s for s in known if s < int(step)]
+        tried = []
+        last_exc = None
+        for cand in candidates:
+            tmpl = template
+            try:
+                if tmpl is None and mesh is not None:
+                    meta = mgr.item_metadata(cand)
+                    tree_meta = getattr(meta, "item_metadata", meta)
+                    c_rules = rules or ShardingRules([])
+                    flat, treedef = jax.tree_util.tree_flatten_with_path(
+                        tree_meta)
+                    outs = []
+                    for keypath, leaf in flat:
+                        name = "/".join(
+                            str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in keypath)
+                        spec = c_rules.spec_for(name, tuple(leaf.shape), mesh)
+                        outs.append(jax.ShapeDtypeStruct(
+                            tuple(leaf.shape), leaf.dtype,
+                            sharding=NamedSharding(mesh, spec)))
+                    tmpl = jax.tree_util.tree_unflatten(treedef, outs)
+                # StandardRestore(None) restores host-resident arrays with
+                # the saved topology — still explicit args, which a fresh
+                # manager requires
+                restored = mgr.restore(
+                    cand, args=ocp.args.StandardRestore(tmpl))
+            except ResilienceError:
+                raise  # injected faults keep their own semantics
+            except Exception as exc:  # noqa: BLE001 — torn step dir
+                last_exc = exc
+                detail = "%s: %s" % (type(exc).__name__, exc)
+                _telem.inc("checkpoint.corrupt")
+                _flight.note_event("checkpoint_corrupt",
+                                   "step=%d: %s" % (cand, detail))
+                tried.append(cand)
+                continue
+            if not _verify_restored(path, cand, restored):
+                _telem.inc("checkpoint.corrupt")
+                _flight.note_event("checkpoint_corrupt",
+                                   "step=%d: sha256 mismatch" % cand)
+                tried.append(cand)
+                continue
+            if tried:
+                _telem.inc("checkpoint.corrupt_fallbacks")
+            return restored
+        raise CheckpointCorruptError(
+            "every sharded snapshot under %s failed verification "
+            "(steps tried: %s)" % (path, tried or "none durable"),
+            steps_tried=tried) from last_exc
     finally:
         mgr.close()
 

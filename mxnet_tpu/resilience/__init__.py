@@ -28,7 +28,16 @@ fault kills the whole run. The subsystem has four parts, each usable alone:
 ``preempt``   `PreemptionListener` — SIGTERM + maintenance-event poller
               (``MXNET_TPU_PREEMPT_POLL_S``) turned into proactive
               checkpoints: resume replays zero steps instead of a
-              ckpt_every window.
+              ckpt_every window;
+``integrity`` the divergence sentinel (``MXNET_TPU_INTEGRITY=1``): an
+              all-finite check fused into the comm-bucket / fused-step
+              programs plus a rolling-median loss-spike detector
+              (``MXNET_TPU_LOSS_SPIKE_FACTOR``) — both raise a structured
+              `DivergenceError` that `ResilientRunner` answers with
+              rollback-to-last-good + skip-the-poisoned-batch (budget:
+              ``MXNET_TPU_ROLLBACK_BUDGET``). Checkpoints carry sha256
+              payload checksums; a corrupt snapshot falls back to the
+              next-oldest instead of crashing.
 
 Everything reports through `mx.telemetry`: ``resilience.faults_injected`` /
 ``retries`` / ``stalls`` / ``restores`` / ``checkpoints`` /
@@ -46,12 +55,13 @@ Quick start::
         max_restarts=3, step_deadline_s=120)
     report = runner.run(num_steps)
 """
-from . import errors, faults, retry, watchdog, run, commit, preempt  # noqa: F401
+from . import (errors, faults, retry, watchdog, run, commit,  # noqa: F401
+               preempt, integrity)
 
 from .errors import (ResilienceError, RetriableError, TransportError,  # noqa: F401
                      InjectedFault, PreemptionError, StallError,
-                     RetryExhausted, FatalTrainingError, classify,
-                     is_retriable)
+                     DivergenceError, RetryExhausted, FatalTrainingError,
+                     CheckpointCorruptError, classify, is_retriable)
 from .faults import FaultPlan, FaultSpec, inject  # noqa: F401
 from .retry import RetryPolicy, call_with_retry, retriable  # noqa: F401
 from .run import ResilientRunner, RunReport, SnapshotCheckpointer  # noqa: F401
@@ -60,10 +70,11 @@ from .commit import CommitCoordinator, elect_step  # noqa: F401
 from .preempt import PreemptionListener, PreemptionNotice  # noqa: F401
 
 __all__ = ["errors", "faults", "retry", "watchdog", "run", "commit",
-           "preempt",
+           "preempt", "integrity",
            "ResilienceError", "RetriableError", "TransportError",
            "InjectedFault", "PreemptionError", "StallError",
-           "RetryExhausted", "FatalTrainingError", "classify",
+           "DivergenceError", "RetryExhausted", "FatalTrainingError",
+           "CheckpointCorruptError", "classify",
            "is_retriable", "FaultPlan", "FaultSpec", "inject",
            "RetryPolicy", "call_with_retry", "retriable",
            "ResilientRunner", "RunReport", "SnapshotCheckpointer",

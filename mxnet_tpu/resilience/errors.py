@@ -17,8 +17,15 @@ Hierarchy::
             InjectedFault              raised by resilience.faults (testing)
           PreemptionError              host/device preemption notice
           StallError                   watchdog deadline passed (span dump)
+          DivergenceError              numeric divergence (non-finite grads
+                                       / loss spike) — recovery is
+                                       ROLLBACK-to-last-good + skip the
+                                       poisoned batch, never an in-place
+                                       retry (the same batch diverges again)
           RetryExhausted               retries spent; carries the last cause
         FatalTrainingError             deterministic — do NOT retry
+          CheckpointCorruptError       every on-disk snapshot failed its
+                                       checksum — nothing left to restore
 
 `classify(exc)` maps arbitrary exceptions (including jaxlib's
 XlaRuntimeError grpc-flavored messages) onto "retriable" / "fatal".
@@ -29,8 +36,8 @@ from ..base import MXNetError
 
 __all__ = ["ResilienceError", "RetriableError", "TransportError",
            "InjectedFault", "PreemptionError", "StallError",
-           "RetryExhausted", "FatalTrainingError", "classify",
-           "is_retriable"]
+           "DivergenceError", "RetryExhausted", "FatalTrainingError",
+           "CheckpointCorruptError", "classify", "is_retriable"]
 
 
 class ResilienceError(MXNetError):
@@ -150,6 +157,43 @@ class StallError(RetriableError):
         return "\n".join(lines)
 
 
+class DivergenceError(RetriableError):
+    """The integrity sentinel tripped: a non-finite value rode a gradient
+    bucket, a fused step produced NaN/Inf, or the loss spiked past the
+    rolling-median divergence factor.
+
+    Classified transient-WITH-ROLLBACK: retrying the same step in place
+    replays the identical divergence (the poisoned batch is deterministic),
+    so `ResilientRunner` restores the last *committed* snapshot and advances
+    the data stream past the poisoned batch window instead. Carries the
+    offending step (when the runner set one), the sentinel site, the
+    bucket/param keys that tripped, and the flight-recorder ring tail —
+    the post-mortem a silent-corruption incident needs.
+    """
+
+    def __init__(self, message, step=None, site=None, keys=None,
+                 flight_dump=None):
+        super().__init__(message)
+        self.step = step
+        self.site = site
+        self.keys = list(keys or [])
+        # list of per-step dicts — telemetry.flight_records() tail
+        self.flight_dump = list(flight_dump or [])
+
+    def format_flight(self, limit=10):
+        from ..telemetry.flight import format_records
+        return format_records(self.flight_dump, limit=limit)
+
+    def format_report(self):
+        lines = [str(self)]
+        if self.keys:
+            lines.append("offending keys: %s" % ",".join(
+                str(k) for k in self.keys))
+        lines.append("")
+        lines.append(self.format_flight())
+        return "\n".join(lines)
+
+
 class RetryExhausted(RetriableError):
     """Every attempt a RetryPolicy allowed failed with a retriable error.
     Carries the last underlying cause; still retriable at a coarser
@@ -166,6 +210,18 @@ class FatalTrainingError(ResilienceError):
     """Deterministic failure (shape/dtype mismatch, uninitialized key,
     programming error). Retrying replays the identical crash — surface it
     immediately instead."""
+
+
+class CheckpointCorruptError(FatalTrainingError):
+    """Every candidate snapshot failed its sha256 verification (or could
+    not be unpickled). A single corrupt payload is RECOVERABLE — the
+    checkpointer falls back to the next-oldest keep=N snapshot and counts
+    ``checkpoint.corrupt`` — so reaching this error means the whole
+    retention window is bad: surface it, do not spin."""
+
+    def __init__(self, message, steps_tried=None):
+        super().__init__(message)
+        self.steps_tried = list(steps_tried or [])
 
 
 # ---------------------------------------------------------------- classifier

@@ -34,6 +34,16 @@ Instrumented sites:
                                       "replica killed mid-stream" drill:
                                       in-flight streams drain back to the
                                       queue and resume by re-prefill
+``train.batch``                       the runner adapters' batch fetch
+                                      (a `transform` site: a ``corrupt``
+                                      here poisons one batch with NaN —
+                                      the divergence-sentinel drill)
+``checkpoint.corrupt``                the SnapshotCheckpointer's payload
+                                      bytes, between pickling and the
+                                      atomic write (a `transform` site: a
+                                      ``corrupt`` here flips bytes ON DISK
+                                      while the sha256 sidecar keeps the
+                                      true digest — the torn-disk drill)
 
 Fault kinds:
 
@@ -45,6 +55,11 @@ Fault kinds:
              land on, exactly like a Python-level wait on a dead collective.
 ``preempt``  raise `PreemptionError` (models host preemption — the runner
              restores a checkpoint instead of retrying in place)
+``corrupt``  silently mutate the payload passing through a `transform`
+             site: NaN into the first float array, XOR-flipped bytes into a
+             bytes blob. Models the failures that DON'T raise — a bad batch,
+             a flaky DMA, a torn disk write. At plain `check` sites (no
+             payload to mutate) a ``corrupt`` spec is a no-op.
 
 Plans come from the ``MXNET_TPU_FAULT_PLAN`` env var or a context manager::
 
@@ -71,10 +86,10 @@ import time
 from .errors import InjectedFault, PreemptionError
 
 __all__ = ["FaultSpec", "FaultPlan", "inject", "activate", "deactivate",
-           "active_plan", "check", "reset_counts", "call_count",
-           "HANG_TICK_S"]
+           "active_plan", "check", "transform", "reset_counts",
+           "call_count", "HANG_TICK_S"]
 
-KINDS = ("error", "latency", "hang", "preempt")
+KINDS = ("error", "latency", "hang", "preempt", "corrupt")
 
 # cooperative hang granularity: small enough that an async StallError lands
 # promptly, large enough to stay off the scheduler's back
@@ -229,6 +244,10 @@ def call_count(site):
 
 def _fire(spec, site, count, context):
     from .. import telemetry as _telem
+    if spec.kind == "corrupt":
+        # corruption mutates a payload; a plain check() site has none —
+        # the spec only bites at transform() sites
+        return
     _telem.inc("resilience.faults_injected")
     _telem.inc("resilience.faults_injected.%s" % spec.kind)
     where = "%s (call #%d%s)" % (
@@ -264,6 +283,88 @@ def check(site, context=None):
     spec = plan.match(site, count)
     if spec is not None:
         _fire(spec, site, count, context)
+
+
+# ---------------------------------------------------------------------------
+# payload-transforming sites (the ``corrupt`` kind)
+# ---------------------------------------------------------------------------
+def _corrupt_one(val):
+    """Corrupt ONE value; returns (new_val, did_corrupt). NaN into float
+    arrays (numpy or NDArray), XOR-flipped bytes into a bytes blob —
+    deterministic, so a chaos schedule replays bit-identically."""
+    import numpy as _np
+    if isinstance(val, (bytes, bytearray)):
+        raw = bytes(val)
+        if not raw:
+            return val, False
+        mid = len(raw) // 2
+        span = max(1, min(8, len(raw) - mid))
+        return (raw[:mid] + bytes(b ^ 0xFF for b in raw[mid:mid + span])
+                + raw[mid + span:]), True
+    if isinstance(val, _np.ndarray):
+        if val.dtype.kind == "f" and val.size:
+            out = val.copy()
+            out.flat[0] = _np.nan
+            return out, True
+        return val, False
+    if hasattr(val, "asnumpy") and hasattr(val, "context"):  # NDArray
+        arr = _np.asarray(val.asnumpy())
+        if arr.dtype.kind == "f" and arr.size:
+            arr = arr.copy()
+            arr.flat[0] = _np.nan
+            from ..ndarray import array as _nd_array
+            return _nd_array(arr, ctx=val.context, dtype=val.dtype), True
+        return val, False
+    # jax arrays (the raw device payloads) take the same NaN poke
+    dt = getattr(val, "dtype", None)
+    if dt is not None and hasattr(val, "at") and getattr(val, "size", 0):
+        try:
+            if _np.dtype(dt).kind == "f":
+                import jax.numpy as jnp
+                return val.at[(0,) * val.ndim].set(jnp.nan), True
+        except TypeError:
+            pass
+    return val, False
+
+
+def _corrupt_payload(payload):
+    """Corrupt the FIRST corruptible element of `payload` (recursing into
+    tuples/lists), preserving the container shape."""
+    if isinstance(payload, (tuple, list)):
+        out = list(payload)
+        for i, item in enumerate(out):
+            new, did = _corrupt_payload(item)
+            if did:
+                out[i] = new
+                return type(payload)(out), True
+        return payload, False
+    return _corrupt_one(payload)
+
+
+def transform(site, payload, context=None):
+    """Fault hook for sites where data passes THROUGH: returns `payload`,
+    possibly corrupted. Counts the site like `check` and fires non-corrupt
+    kinds (error/preempt raise, latency/hang delay) exactly the same; a
+    matching ``corrupt`` spec mutates the payload instead — NaN into the
+    first float array, flipped bytes into a bytes blob. No-op (one global
+    read) when no plan is active."""
+    plan = _ACTIVE
+    if plan is None:
+        return payload
+    count = plan.bump(site)
+    spec = plan.match(site, count)
+    if spec is None:
+        return payload
+    if spec.kind != "corrupt":
+        _fire(spec, site, count, context)
+        return payload
+    new, did = _corrupt_payload(payload)
+    if did:
+        from .. import telemetry as _telem
+        _telem.inc("resilience.faults_injected")
+        _telem.inc("resilience.faults_injected.corrupt")
+        _telem.inc("resilience.faults_injected.corrupt.%s" % site)
+    return new
 
 
 # load any env-provided plan at import so `MXNET_TPU_FAULT_PLAN=... python

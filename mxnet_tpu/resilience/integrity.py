@@ -1,0 +1,208 @@
+"""Training integrity sentinel: catch silent divergence before it spreads.
+
+The resilience layers recover from faults that *raise*. The nastiest fleet
+failures are silent: one non-finite gradient poisons the weights steps
+before any metric moves, a bad batch spikes the loss into divergence, and
+by the time a human looks, every checkpoint in the retention window is
+garbage. This module is the detection half of the integrity plane
+(`ResilientRunner`'s rollback-to-last-good is the recovery half):
+
+* **bucket sentinel** (``MXNET_TPU_INTEGRITY=1``) — an all-finite check
+  FUSED into the existing flat comm-bucket programs (`engine.
+  fused_bucket_fn(with_finite=True)`): one extra scalar reduction riding
+  the flat vector the collective already touches, so XLA folds it into the
+  bucket launch — near-free on device. The kvstore bucketed push, the ZeRO
+  reduce-scatter legs, and the FusedTrainStep's whole-step program all
+  carry it; a false scalar raises `DivergenceError` BEFORE the poisoned
+  values reach any store/updater write, naming the bucket keys.
+* **loss sentinel** — a non-finite loss always trips; with
+  ``MXNET_TPU_LOSS_SPIKE_FACTOR=k`` set, a loss exceeding ``k ×`` the
+  rolling median of the last ``MXNET_TPU_ANOMALY_WINDOW`` steps (the same
+  machinery as `telemetry.anomaly`'s step-time spike detector, same
+  warm-up) trips too — the "diverged without NaN" case.
+
+Both raise a structured `DivergenceError` carrying the offending step
+(`set_step` — the runner stamps it each step), the sentinel site, the
+bucket/param keys, and the flight-recorder ring tail.
+
+Counters: ``integrity.checks`` (buckets checked), ``integrity.divergences``
+(+ per-site), ``integrity.loss_spikes``, and the AMP bridge
+``integrity.amp_overflow`` / ``integrity.amp_skipped_steps`` — so an AMP
+overflow skip and an integrity rollback are distinguishable in telemetry.
+
+When NOT to use: the in-program check is near-free, but the *host* pays
+one scalar sync per bucket when enabled — leave it off for
+max-throughput runs that already trust their data pipeline, on for any
+run long enough that a silent poisoning costs more than the sync.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .errors import DivergenceError
+
+__all__ = ["enabled", "comm_checksum_enabled", "loss_spike_factor",
+           "set_step", "current_step",
+           "check_finite", "check_scalar", "observe_loss",
+           "note_amp_overflow", "note_amp_skip", "reset"]
+
+# loss-spike detection reuses the anomaly tracker's warm-up discipline: no
+# verdicts until the window has seen enough losses to trust a median
+_WARMUP = 8
+
+_STATE = threading.local()
+_LOCK = threading.Lock()
+_LOSS_WINDOW = []  # rolling |loss| window for the spike detector
+
+
+def enabled():
+    """The sentinel master switch (env ``MXNET_TPU_INTEGRITY``)."""
+    return os.environ.get("MXNET_TPU_INTEGRITY", "0").lower() \
+        in ("1", "true", "yes", "on")
+
+
+def comm_checksum_enabled():
+    """``MXNET_TPU_COMM_CHECKSUM`` — the heavier dist-push lever: digest
+    the packed bucket before the wire and all-finite the summed result
+    after. NOT free (one host digest + one scalar sync per bucket), so it
+    is a separate switch from the fused sentinel."""
+    return os.environ.get("MXNET_TPU_COMM_CHECKSUM", "0").lower() \
+        in ("1", "true", "yes", "on")
+
+
+def loss_spike_factor():
+    """``MXNET_TPU_LOSS_SPIKE_FACTOR`` as float, or None (spike detection
+    off; non-finite losses still always trip)."""
+    raw = os.environ.get("MXNET_TPU_LOSS_SPIKE_FACTOR")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+def _window_size():
+    from ..telemetry import anomaly as _anomaly
+    return _anomaly.default_window()
+
+
+def set_step(step):
+    """Stamp the current global step (the runner calls this each step) so
+    a divergence raised deep in the comm stack can name it."""
+    _STATE.step = int(step) if step is not None else None
+
+
+def current_step():
+    return getattr(_STATE, "step", None)
+
+
+def _raise(site, keys, detail):
+    from .. import telemetry as _telem
+    from ..telemetry import flight as _flight
+    _telem.inc("integrity.divergences")
+    _telem.inc("integrity.divergences.%s" % site)
+    step = current_step()
+    _flight.note_event(
+        "divergence", "site=%s step=%s%s"
+        % (site, "?" if step is None else step,
+           (" keys=[%s]" % ",".join(str(k) for k in keys)) if keys else ""))
+    raise DivergenceError(
+        "integrity sentinel tripped at %s%s: %s"
+        % (site, "" if step is None else " (step %d)" % step, detail),
+        step=step, site=site, keys=keys,
+        flight_dump=_telem.flight_records())
+
+
+def finite_scalar(raws):
+    """ONE device scalar: all values across `raws` finite. Pure jnp — safe
+    inside jit (the fused-step program composes it into its own outputs)."""
+    import jax.numpy as jnp
+    fin = jnp.asarray(True)
+    for r in raws:
+        fin = fin & jnp.isfinite(r).all()
+    return fin
+
+
+def check_finite(raws, site, keys=None):
+    """Host-side guard over already-materialized device arrays: one fused
+    finite reduction, ONE sync. Raises `DivergenceError` on a non-finite
+    value. The ZeRO packed-bucket path uses this (its flat_g is already
+    one array per bucket)."""
+    from .. import telemetry as _telem
+    _telem.inc("integrity.checks")
+    if bool(finite_scalar(raws)):
+        return
+    _raise(site, keys, "non-finite value in gradient bucket")
+
+
+def check_scalar(fin, site, keys=None):
+    """Guard for a finite-scalar an in-program check already computed (the
+    `fused_bucket_fn(with_finite=True)` output): one bool() sync, raise on
+    False."""
+    from .. import telemetry as _telem
+    _telem.inc("integrity.checks")
+    if bool(fin):
+        return
+    _raise(site, keys, "non-finite value in fused bucket program")
+
+
+def observe_loss(loss, step=None):
+    """Feed one step's scalar loss to the loss sentinel. Non-finite always
+    trips; with MXNET_TPU_LOSS_SPIKE_FACTOR set, a loss past k× the rolling
+    median (|loss|, post-warm-up) trips too. The spike joins the window
+    only when it did NOT fire — a genuine regime change after a rollback
+    must re-learn its baseline from clean steps."""
+    import math
+    if step is not None:
+        set_step(step)
+    try:
+        val = float(loss)
+    except (TypeError, ValueError):
+        return
+    if not math.isfinite(val):
+        _raise("train.loss", None, "non-finite loss %r" % val)
+    factor = loss_spike_factor()
+    if factor is None:
+        _append_loss(abs(val))
+        return
+    with _LOCK:
+        win = list(_LOSS_WINDOW)
+    if len(win) >= _WARMUP:
+        med = sorted(win)[len(win) // 2]
+        if med > 0 and abs(val) > factor * med:
+            from .. import telemetry as _telem
+            _telem.inc("integrity.loss_spikes")
+            _raise("train.loss", None,
+                   "loss %.6g exceeds %.3g x rolling median %.6g"
+                   % (val, factor, med))
+    _append_loss(abs(val))
+
+
+def _append_loss(val):
+    with _LOCK:
+        _LOSS_WINDOW.append(val)
+        limit = _window_size()
+        if len(_LOSS_WINDOW) > limit:
+            del _LOSS_WINDOW[:len(_LOSS_WINDOW) - limit]
+
+
+def note_amp_overflow():
+    """AMP's dynamic loss scaler found a non-finite grad: counted HERE so
+    telemetry can tell an AMP overflow skip from an integrity rollback."""
+    from .. import telemetry as _telem
+    _telem.inc("integrity.amp_overflow")
+
+
+def note_amp_skip():
+    """AMP skipped the weight update for an overflowed step."""
+    from .. import telemetry as _telem
+    _telem.inc("integrity.amp_skipped_steps")
+
+
+def reset():
+    """Drop the loss window (tests; measurement-window boundaries)."""
+    with _LOCK:
+        del _LOSS_WINDOW[:]
+    _STATE.step = None

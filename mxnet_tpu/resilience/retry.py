@@ -24,7 +24,7 @@ import os
 import random as _pyrandom
 import time
 
-from .errors import RetryExhausted, classify
+from .errors import DivergenceError, RetryExhausted, classify
 
 __all__ = ["RetryPolicy", "call_with_retry", "retriable", "default_policy"]
 
@@ -104,6 +104,11 @@ def call_with_retry(fn, *args, site="op", policy=None, context=None,
         try:
             return fn(*args, **kwargs)
         except Exception as exc:  # noqa: BLE001 — classifier decides
+            if isinstance(exc, DivergenceError):
+                # deterministic at retry granularity: the same inputs
+                # diverge again — only the runner's rollback-and-skip can
+                # absorb it, so it must surface unmasked
+                raise
             if classify(exc) != "retriable":
                 raise
             if retry_on is not None and not retry_on(exc):

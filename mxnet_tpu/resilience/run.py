@@ -45,6 +45,7 @@ are counted by their own modules).
 """
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import pickle
@@ -53,7 +54,9 @@ import shutil
 import time
 
 from . import faults, watchdog
-from .errors import ResilienceError, RetriableError, TransportError
+from .errors import (CheckpointCorruptError, DivergenceError,
+                     FatalTrainingError, ResilienceError, RetriableError,
+                     TransportError)
 from .retry import RetryPolicy, call_with_retry
 
 __all__ = ["SnapshotCheckpointer", "ResilientRunner", "RunReport",
@@ -87,6 +90,17 @@ class SnapshotCheckpointer:
     sites: ``checkpoint.save`` fires after the payload is durable and
     before the marker moves (the crashed-mid-commit shape), and
     ``checkpoint.restore`` fires on the way into a restore.
+
+    Integrity (ISSUE 20): every payload is stamped with a sha256 sidecar
+    (``step_N.ckpt.sha256``) at prepare time and verified on restore. A
+    mismatched / truncated / unpicklable payload is counted
+    (``checkpoint.corrupt``) and the restore FALLS BACK to the next-oldest
+    durable snapshot instead of crashing; only a retention window with no
+    good snapshot at all raises `CheckpointCorruptError`. The
+    ``checkpoint.corrupt`` fault site (a `faults.transform` site) sits
+    between pickling and the atomic write, so a ``corrupt`` plan entry
+    flips bytes ON DISK while the sidecar keeps the true digest — the
+    injectable torn-disk drill.
     """
 
     _STEP_RE = re.compile(r"^step_(\d+)\.ckpt$")
@@ -99,6 +113,9 @@ class SnapshotCheckpointer:
     def _file(self, step):
         return os.path.join(self.path, "step_%d.ckpt" % int(step))
 
+    def _digest_file(self, step):
+        return self._file(step) + ".sha256"
+
     def prepare(self, step, tree):
         """Phase 1: make the step's payload durable. The LATEST marker does
         not move — an uncommitted payload is invisible to `latest_step`
@@ -106,8 +123,15 @@ class SnapshotCheckpointer:
         steps. Ends at the ``checkpoint.save`` fault site: an injected
         crash here IS the mid-commit crash."""
         from ..util import atomic_write
-        atomic_write(self._file(step),
-                     pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL))
+        blob = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+        # digest BEFORE the corrupt transform: the sidecar must hold the
+        # truth so injected on-disk corruption is detectable, exactly like
+        # a real torn write under a checksum stamped at save time
+        digest = hashlib.sha256(blob).hexdigest()
+        blob = faults.transform("checkpoint.corrupt", blob,
+                                context="step=%d payload" % step)
+        atomic_write(self._file(step), blob)
+        atomic_write(self._digest_file(step), digest.encode())
         faults.check("checkpoint.save", context="step=%d mid-commit" % step)
         return self._file(step)
 
@@ -153,15 +177,67 @@ class SnapshotCheckpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def _load_verified(self, step):
+        """Read + verify + unpickle ONE step's payload. Raises ValueError
+        on a checksum mismatch / truncation / unpickle failure — the
+        caller's fallback walk treats all three identically (the disk
+        lied; the sidecar is the truth)."""
+        with open(self._file(step), "rb") as f:
+            blob = f.read()
+        digest_path = self._digest_file(step)
+        if os.path.exists(digest_path):
+            with open(digest_path, "rb") as f:
+                want = f.read().decode().strip()
+            got = hashlib.sha256(blob).hexdigest()
+            if got != want:
+                raise ValueError(
+                    "checksum mismatch for step %d: sha256 %s != stamped %s"
+                    % (step, got[:12], want[:12]))
+        # pre-checksum checkpoints (no sidecar) still get the unpickle
+        # sanity net below — never a crash on a truncated payload
+        try:
+            return pickle.loads(blob)
+        except Exception as exc:
+            raise ValueError(
+                "unpicklable payload for step %d: %s: %s"
+                % (step, type(exc).__name__, exc)) from exc
+
     def restore(self, step=None):
+        """Restore `step` (default: newest committed). A corrupt payload —
+        checksum mismatch, truncation, unpickle failure — is counted
+        (``checkpoint.corrupt``) and the restore falls back to the
+        next-oldest durable snapshot; `CheckpointCorruptError` only when
+        every candidate is bad."""
+        from .. import telemetry as _telem
+        from ..telemetry import flight as _flight
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(
                     "no checkpoint under %s" % self.path)
         faults.check("checkpoint.restore", context="step=%d" % step)
-        with open(self._file(step), "rb") as f:
-            return step, pickle.load(f)
+        candidates = [step] + [s for s in reversed(self.steps()) if s < step]
+        tried = []
+        for cand in candidates:
+            if not os.path.exists(self._file(cand)):
+                continue
+            try:
+                tree = self._load_verified(cand)
+            except ValueError as exc:
+                tried.append(cand)
+                _telem.inc("checkpoint.corrupt")
+                _flight.note_event("checkpoint_corrupt",
+                                   "step=%d: %s" % (cand, exc))
+                _LOG.warning(
+                    "checkpoint: step %d payload is corrupt (%s) — falling "
+                    "back to the next-oldest snapshot", cand, exc)
+                continue
+            if tried:
+                _telem.inc("checkpoint.corrupt_fallbacks")
+            return cand, tree
+        raise CheckpointCorruptError(
+            "every snapshot under %s failed verification (steps tried: %s)"
+            % (self.path, tried or "none durable"), steps_tried=tried)
 
     def _retain(self):
         if self.keep is None:
@@ -170,6 +246,8 @@ class SnapshotCheckpointer:
         for step in steps[:-self.keep]:
             try:
                 os.remove(self._file(step))
+                if os.path.exists(self._digest_file(step)):
+                    os.remove(self._digest_file(step))
             except OSError:  # pragma: no cover — races with manual cleanup
                 pass
 
@@ -194,16 +272,20 @@ class RunReport:
         self.proactive_ckpts = 0    # checkpoints forced by a preempt notice
         self.mesh_shrinks = 0
         self.mesh_grows = 0
+        self.rollbacks = 0          # divergence rollbacks-to-last-good
+        self.skipped_batches = 0    # batches skipped past poisoned windows
         self.recovery_time_s = 0.0  # wall time spent inside restores
 
     def __repr__(self):
         return ("RunReport(steps=%d, executed=%d, replayed=%d, restarts=%d, "
                 "retries=%d, checkpoints=%d, proactive=%d, mesh_shrinks=%d, "
-                "mesh_grows=%d, recovery_time_s=%.3f)"
+                "mesh_grows=%d, rollbacks=%d, skipped_batches=%d, "
+                "recovery_time_s=%.3f)"
                 % (len(self.losses), self.steps_executed,
                    self.replayed_steps, self.restarts, self.retries,
                    self.checkpoints, self.proactive_ckpts, self.mesh_shrinks,
-                   self.mesh_grows, self.recovery_time_s))
+                   self.mesh_grows, self.rollbacks, self.skipped_batches,
+                   self.recovery_time_s))
 
 
 class ResilientRunner:
@@ -227,13 +309,31 @@ class ResilientRunner:
     preempt_listener            (True or a `preempt.PreemptionListener`:
                                  proactive checkpoint on SIGTERM /
                                  maintenance notices)
+    skip_policy(step, exc)->int (rollback mode: how many batches to skip
+                                 past a `DivergenceError` at `step`;
+                                 default skip-one)
+    rollback_budget             (max CONSECUTIVE rollbacks — no completed
+                                 step between them — before escalating to
+                                 `FatalTrainingError`; default env
+                                 ``MXNET_TPU_ROLLBACK_BUDGET`` or 3)
+
+    Rollback-to-last-good (ISSUE 20): a `DivergenceError` (the integrity
+    sentinel — non-finite bucket/fused-step values, loss spike) is handled
+    as its OWN recovery mode, distinct from restore-and-replay: the runner
+    restores the last *committed* snapshot, then advances the data stream
+    past the poisoned batch window, so the replayed trajectory never
+    re-feeds the batch that diverged. Skip windows are bit-deterministic
+    (pure step-index arithmetic, RNG/step state rides the snapshot) and
+    travel inside dict checkpoints, so a process-level resume preserves
+    them. ``step_fn`` receives the skip-adjusted DATA index.
     """
 
     def __init__(self, step_fn, state_get, state_set, ckpt_dir=None,
                  checkpointer=None, ckpt_every=1, keep=2, max_restarts=3,
                  step_deadline_s=None, retry_policy=None, mesh_factory=None,
                  on_shrink=None, on_grow=None, relayout=None, on_stall=None,
-                 commit=None, preempt_listener=None):
+                 commit=None, preempt_listener=None, skip_policy=None,
+                 rollback_budget=None):
         if checkpointer is None and ckpt_dir is not None:
             checkpointer = SnapshotCheckpointer(ckpt_dir, keep=keep)
         self.step_fn = step_fn
@@ -260,6 +360,18 @@ class ResilientRunner:
             from .preempt import PreemptionListener
             preempt_listener = PreemptionListener()
         self.preempt_listener = preempt_listener or None
+        self.skip_policy = skip_policy or (lambda step, exc: 1)
+        if rollback_budget is None:
+            try:
+                rollback_budget = int(os.environ.get(
+                    "MXNET_TPU_ROLLBACK_BUDGET", "3"))
+            except (TypeError, ValueError):
+                rollback_budget = 3
+        self.rollback_budget = max(1, int(rollback_budget))
+        # {from_step: batches_to_skip} — the poisoned-window ledger; the
+        # effective data index for step s is s + sum(counts at steps <= s)
+        self._skip_windows = {}
+        self._consecutive_rollbacks = 0
         # last few save durations (rolling, this runner's own saves) —
         # the SIGTERM budgeter's evidence
         from collections import deque
@@ -287,6 +399,12 @@ class ResilientRunner:
                 if sched is not None:
                     tree = dict(tree)
                     tree["comm_schedule"] = sched
+            if isinstance(tree, dict) and self._skip_windows \
+                    and "integrity_skip" not in tree:
+                # poisoned-batch skip windows ride the checkpoint so a
+                # process-level resume keeps skipping the same batches
+                tree = dict(tree)
+                tree["integrity_skip"] = dict(self._skip_windows)
             if self.commit is not None:
                 # two-phase: payload durable everywhere BEFORE any marker
                 # moves; the marker then names the fleet-elected step
@@ -348,6 +466,14 @@ class ResilientRunner:
                     is not None:
                 from .. import engine as _engine
                 _engine.restore_schedule(tree.pop("comm_schedule"))
+            if isinstance(tree, dict) and "integrity_skip" in tree:
+                # merge by max: in-process windows added AFTER this
+                # snapshot was taken must survive the restore (else a
+                # second rollback would replay the same poisoned batch)
+                for f, c in (tree.pop("integrity_skip") or {}).items():
+                    f = int(f)
+                    self._skip_windows[f] = max(
+                        self._skip_windows.get(f, 0), int(c))
             self.state_set(tree)
         _telem.inc("resilience.restores")
         from ..telemetry import flight as _flight
@@ -473,6 +599,15 @@ class ResilientRunner:
         Counted faults deeper in the step go down the restore path."""
         faults.check("run.step", context="step=%d" % step)
 
+    def data_index(self, step):
+        """The data-stream index `step` consumes: the step index advanced
+        past every skip window at or before it. Pure arithmetic over the
+        window ledger — bit-deterministic across replay and resume."""
+        if not self._skip_windows:
+            return step
+        return step + sum(c for f, c in self._skip_windows.items()
+                          if f <= step)
+
     def _run_one(self, step, report):
         def on_retry(attempt, exc):
             report.retries += 1
@@ -480,11 +615,52 @@ class ResilientRunner:
                         policy=self.retry_policy,
                         retry_on=lambda e: isinstance(e, TransportError),
                         on_retry=on_retry)
+        from . import integrity as _integrity
+        _integrity.set_step(step)
         with watchdog.guard("run.step", deadline_s=self.step_deadline_s,
                             on_stall=self.on_stall):
-            loss = self.step_fn(step)
+            loss = self.step_fn(self.data_index(step))
         report.steps_executed += 1
+        if _integrity.enabled():
+            # loss sentinel: non-finite always trips; a rolling-median
+            # spike trips when MXNET_TPU_LOSS_SPIKE_FACTOR is set
+            _integrity.observe_loss(self._to_float(loss), step)
         return loss
+
+    def _rollback(self, step, exc, report):
+        """Divergence recovery: restore the last COMMITTED snapshot, open
+        a skip window over the poisoned batch(es), and continue — never an
+        in-place retry (the same batch diverges again). A consecutive-
+        rollback budget (reset by any completed step) escalates to fatal:
+        if skipping batches does not stop the divergence, the problem is
+        the run, not the data. Returns the restored step."""
+        from .. import telemetry as _telem
+        from ..telemetry import flight as _flight
+        if self.ckpt is None:
+            raise exc
+        self._consecutive_rollbacks += 1
+        if self._consecutive_rollbacks > self.rollback_budget:
+            raise FatalTrainingError(
+                "integrity: %d consecutive rollbacks exhausted the budget "
+                "(%d) — divergence persists across skipped batches; last "
+                "cause: %s" % (self._consecutive_rollbacks,
+                               self.rollback_budget, exc)) from exc
+        skip_n = max(1, int(self.skip_policy(step, exc)))
+        restored = self._restore(report, exc)
+        # a rollback is not a restart: it has its own ledger and budget
+        report.restarts -= 1
+        self._skip_windows[step] = self._skip_windows.get(step, 0) + skip_n
+        report.rollbacks += 1
+        report.skipped_batches += skip_n
+        _telem.inc("resilience.rollbacks")
+        _telem.inc("resilience.skipped_batches", skip_n)
+        _flight.note_event(
+            "rollback", "diverged_step=%d restored=%d skip=%d site=%s"
+            % (step, restored, skip_n, getattr(exc, "site", "?")))
+        _LOG.warning(
+            "integrity: rolled back to step %d after divergence at step %d "
+            "(%s); skipping %d batch(es)", restored, step, exc, skip_n)
+        return restored
 
     def run(self, num_steps, start_step=0, resume=False):
         """Run steps ``[start_step, num_steps)``; returns a `RunReport`.
@@ -517,6 +693,13 @@ class ResilientRunner:
                 try:
                     self._check_preempt(step, report)
                     loss = self._run_one(step, report)
+                except DivergenceError as exc:
+                    # rollback-to-last-good, NOT restore-and-replay: the
+                    # poisoned batch is skipped so the replayed trajectory
+                    # never re-feeds it
+                    step = self._rollback(step, exc, report)
+                    last_saved = step  # that snapshot is already on disk
+                    continue
                 except RetriableError as exc:
                     if report.restarts >= self.max_restarts:
                         _LOG.error(
@@ -526,6 +709,7 @@ class ResilientRunner:
                     step = self._restore(report, exc)
                     last_saved = step  # that snapshot is already on disk
                     continue
+                self._consecutive_rollbacks = 0  # a completed step resets
                 if step < frontier:
                     report.replayed_steps += 1
                 else:
@@ -586,7 +770,11 @@ class ResilientRunner:
         active = {"fused": build(fused)}
 
         def step_fn(i):
-            d, l = batch_fn(i)
+            # `train.batch` transform site: a `corrupt` plan entry poisons
+            # this batch with NaN — the injectable divergence drill the
+            # integrity sentinel + rollback path recovers from
+            d, l = faults.transform("train.batch", batch_fn(i),
+                                    context="index=%d" % i)
             return active["fused"](d, l)
 
         def relayout(mesh):
@@ -626,8 +814,10 @@ class ResilientRunner:
         active = {"step": step}
 
         def step_fn(i):
+            batch = faults.transform("train.batch", batch_fn(i),
+                                     context="index=%d" % i)
             p, o, loss = active["step"](holder["params"],
-                                        holder["opt_state"], batch_fn(i), i)
+                                        holder["opt_state"], batch, i)
             holder["params"], holder["opt_state"] = p, o
             return loss
 

@@ -99,6 +99,7 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "serve: serving-engine tests (continuous batching, paged KV cache, replica supervision)")
     config.addinivalue_line("markers", "pallas: Pallas kernel parity tests (CPU backend runs the real kernels through the interpreter — parity evidence only, never perf evidence)")
     config.addinivalue_line("markers", "compiler: whole-graph symbolic compiler + AOT executable cache tests (run alone with -m compiler)")
+    config.addinivalue_line("markers", "chaos: seeded multi-fault soak over the resilience fault sites (tools/chaos.py; run with -m chaos)")
 
 
 @pytest.fixture(autouse=True)

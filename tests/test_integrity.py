@@ -1,0 +1,627 @@
+"""Training integrity plane (ISSUE 20): divergence sentinel, rollback-to-
+last-good, checksummed checkpoints, AMP overflow bridge, chaos soak.
+
+The parity bar everywhere is BIT-identical, not allclose: rollback must
+restore the exact snapshot and the skip-adjusted replay must follow the
+exact clean trajectory, or silent drift hides behind tolerances.
+"""
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, nd, resilience as rz, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import faults, integrity
+from mxnet_tpu.resilience.errors import (CheckpointCorruptError,
+                                         DivergenceError, FatalTrainingError)
+from mxnet_tpu.resilience.run import SnapshotCheckpointer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    return telemetry.snapshot()["counters"].get(name, 0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_integrity():
+    telemetry.enable()
+    integrity.reset()
+    faults.deactivate()
+    yield
+    integrity.reset()
+    faults.deactivate()
+
+
+def _build_mlp():
+    mx.random.seed(42)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    return net, tr
+
+
+def _batches(n=8, poison=None):
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 32, 8).astype(np.float32)
+    Y = rng.randint(0, 3, (n, 32)).astype(np.float32)
+    if poison is not None:
+        X[poison, 0, 0] = np.nan
+    return X, Y
+
+
+def _params_of(net):
+    return [(k, p.data().asnumpy())
+            for k, p in sorted(net.collect_params().items())]
+
+
+def _assert_bit_identical(net_a, net_b):
+    for (ka, a), (_, b) in zip(_params_of(net_a), _params_of(net_b)):
+        assert a.tobytes() == b.tobytes(), "param %s drifted" % ka
+
+
+# ---------------------------------------------------------------------------
+# sentinel unit behavior
+# ---------------------------------------------------------------------------
+def test_divergence_error_carries_context():
+    integrity.set_step(17)
+    with pytest.raises(DivergenceError) as ei:
+        integrity.check_finite([np.array([1.0, np.nan])],
+                               site="kvstore.bucket", keys=["3", "4"])
+    err = ei.value
+    assert err.step == 17 and err.site == "kvstore.bucket"
+    assert err.keys == ["3", "4"]
+    assert "kvstore.bucket" in err.format_report()
+    assert _counter("integrity.divergences.kvstore.bucket") == 1
+
+
+def test_loss_sentinel_nonfinite_always_trips():
+    with pytest.raises(DivergenceError):
+        integrity.observe_loss(float("nan"), step=3)
+    with pytest.raises(DivergenceError):
+        integrity.observe_loss(float("inf"), step=4)
+
+
+def test_loss_spike_factor_trips_after_warmup(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_LOSS_SPIKE_FACTOR", "10")
+    for i in range(9):
+        integrity.observe_loss(1.0 + 0.01 * i, step=i)
+    before = _counter("integrity.loss_spikes")
+    with pytest.raises(DivergenceError, match="rolling median"):
+        integrity.observe_loss(500.0, step=9)
+    assert _counter("integrity.loss_spikes") == before + 1
+    # the spike did not join the window: the baseline survives
+    with pytest.raises(DivergenceError):
+        integrity.observe_loss(400.0, step=10)
+
+
+def test_loss_spike_within_factor_passes(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_LOSS_SPIKE_FACTOR", "10")
+    for i in range(12):
+        integrity.observe_loss(1.0, step=i)
+    integrity.observe_loss(5.0, step=12)  # 5x median: under the bar
+
+
+def test_sentinel_off_by_default_lets_nan_through(tmp_path):
+    """Gating: without MXNET_TPU_INTEGRITY the fused step must not pay for
+    (or raise) the check — the NaN lands in the params."""
+    X, Y = _batches(poison=2)
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    for i in range(4):
+        fused(nd.array(X[i]), nd.array(Y[i]))
+    finite = all(np.isfinite(a).all() for _, a in _params_of(net))
+    assert not finite
+
+
+# ---------------------------------------------------------------------------
+# rollback parity: FusedTrainStep / Trainer / Trainer(zero=True)
+# ---------------------------------------------------------------------------
+def test_fused_step_nan_rollback_bit_identical(tmp_path, monkeypatch):
+    """Corrupt-kind fault poisons batch 3; the in-program sentinel raises,
+    the runner rolls back to the last committed snapshot and skips the
+    poisoned index — final params bit-identical to the clean run that
+    never saw that batch."""
+    monkeypatch.setenv("MXNET_TPU_INTEGRITY", "1")
+    X, Y = _batches()
+    batch_fn = lambda i: (nd.array(X[i]), nd.array(Y[i]))  # noqa: E731
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    with faults.inject("train.batch:corrupt:4"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+            max_restarts=3)
+        report = runner.run(6)
+    assert report.rollbacks == 1 and report.skipped_batches == 1
+    assert report.restarts == 0  # rollback has its own budget
+    assert _counter("resilience.rollbacks") >= 1
+    assert _counter("resilience.skipped_batches") >= 1
+    final_idx = [runner.data_index(s) for s in range(6)]
+    assert final_idx == [0, 1, 2, 4, 5, 6]
+
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    for i in final_idx:
+        fused_a(*batch_fn(i))
+    _assert_bit_identical(net_a, net_b)
+
+
+def _trainer_state_io(net, tr, tmp_path):
+    sfile = str(tmp_path / "trainer.states")
+
+    def state_get():
+        tr.save_states(sfile)
+        with open(sfile, "rb") as f:
+            blob = f.read()
+        return {"params": {k: p.data().asnumpy()
+                           for k, p in net.collect_params().items()},
+                "opt": blob}
+
+    def state_set(tree):
+        for k, p in net.collect_params().items():
+            p.set_data(nd.array(tree["params"][k]))
+        # weights live ON the store under update_on_kvstore: re-init the
+        # kvstore from the restored params, then reload optimizer state
+        tr._reset_kvstore()
+        with open(sfile, "wb") as f:
+            f.write(tree["opt"])
+        tr.load_states(sfile)
+
+    return state_get, state_set
+
+
+def _trainer_rollback_parity(tmp_path, monkeypatch, zero):
+    """Shared body: poisoned batch 3 trips the bucket sentinel inside
+    tr.step (kvstore.bucket for the local bucketed path, zero.bucket for
+    the ZeRO reduce-scatter guard); rollback + skip must reproduce the
+    clean trajectory bit-exactly."""
+    monkeypatch.setenv("MXNET_TPU_INTEGRITY", "1")
+    steps, poison = 6, 3
+    X, Y = _batches(poison=poison)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def build():
+        mx.random.seed(42)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            # explicit in_units: the runner snapshots state BEFORE the first
+            # forward, so shapes cannot stay deferred
+            net.add(nn.Dense(16, in_units=8, activation="relu"),
+                    nn.Dense(3, in_units=16))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9},
+                           kvstore="device", update_on_kvstore=True,
+                           zero=zero)
+        return net, tr
+
+    def one_step(net, tr, i):
+        x, y = nd.array(X[i]), nd.array(Y[i])
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(x.shape[0])
+        return float(loss.mean().asnumpy())
+
+    with engine.bucket_mb_scope(0.001):  # several buckets, not one
+        net_b, tr_b = build()
+        state_get, state_set = _trainer_state_io(net_b, tr_b, tmp_path)
+        runner = rz.ResilientRunner(
+            lambda i: one_step(net_b, tr_b, i),
+            state_get=state_get, state_set=state_set,
+            ckpt_dir=str(tmp_path / "ck"), ckpt_every=2, max_restarts=3)
+        report = runner.run(steps)
+        assert report.rollbacks == 1 and report.skipped_batches == 1
+        final_idx = [runner.data_index(s) for s in range(steps)]
+        assert poison not in final_idx
+
+        net_a, tr_a = build()
+        for i in final_idx:
+            one_step(net_a, tr_a, i)
+    _assert_bit_identical(net_a, net_b)
+    assert all(np.isfinite(a).all() for _, a in _params_of(net_b))
+
+
+def test_trainer_bucketed_nan_rollback_bit_identical(tmp_path, monkeypatch):
+    _trainer_rollback_parity(tmp_path, monkeypatch, zero=None)
+
+
+def test_trainer_zero_nan_rollback_bit_identical(tmp_path, monkeypatch):
+    _trainer_rollback_parity(tmp_path, monkeypatch, zero=True)
+
+
+def test_resume_after_rollback_roundtrip(tmp_path, monkeypatch):
+    """Skip windows ride the checkpoint: a process kill after a rollback
+    resumes with the poisoned index still skipped, and the 10-step result
+    is bit-identical to the clean run over the final trajectory."""
+    monkeypatch.setenv("MXNET_TPU_INTEGRITY", "1")
+    X, Y = _batches(n=12)
+    batch_fn = lambda i: (nd.array(X[i]), nd.array(Y[i]))  # noqa: E731
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    with faults.inject("train.batch:corrupt:4"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+            max_restarts=3)
+        runner.run(6)
+    assert runner.data_index(5) == 6
+
+    # "process kill": perturb live state, fresh runner, resume from disk
+    for _, p in net_b.collect_params().items():
+        p.set_data(p.data() * 0.0)
+    fused_b2 = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    runner2 = rz.ResilientRunner.for_fused_step(
+        fused_b2, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+        max_restarts=3)
+    runner2.run(10, resume=True)
+    final_idx = [runner2.data_index(s) for s in range(10)]
+    assert final_idx == [0, 1, 2, 4, 5, 6, 7, 8, 9, 10]
+
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    for i in final_idx:
+        fused_a(*batch_fn(i))
+    _assert_bit_identical(net_a, net_b)
+
+
+def test_rollback_budget_escalates_fatal(tmp_path, monkeypatch):
+    """Every batch poisoned from call 4 on: rollback+skip can never make
+    progress, so the consecutive-rollback budget must escalate to
+    FatalTrainingError instead of looping forever."""
+    monkeypatch.setenv("MXNET_TPU_INTEGRITY", "1")
+    monkeypatch.setenv("MXNET_TPU_ROLLBACK_BUDGET", "2")
+    X, Y = _batches(n=16)
+    batch_fn = lambda i: (nd.array(X[i]), nd.array(Y[i]))  # noqa: E731
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    with faults.inject("train.batch:corrupt:4+"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+            max_restarts=10)
+        with pytest.raises(FatalTrainingError, match="rollback"):
+            runner.run(8)
+
+
+def test_divergence_without_checkpointer_surfaces(tmp_path, monkeypatch):
+    """No checkpointer configured: nothing to roll back to — the
+    DivergenceError itself must surface, not a secondary failure."""
+    monkeypatch.setenv("MXNET_TPU_INTEGRITY", "1")
+    X, Y = _batches()
+    batch_fn = lambda i: (nd.array(X[i]), nd.array(Y[i]))  # noqa: E731
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    with faults.inject("train.batch:corrupt:2"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused, batch_fn, ckpt_dir=None, max_restarts=3)
+        with pytest.raises(DivergenceError):
+            runner.run(6)
+
+
+def test_skip_policy_pluggable(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_INTEGRITY", "1")
+    X, Y = _batches(n=10)
+    batch_fn = lambda i: (nd.array(X[i]), nd.array(Y[i]))  # noqa: E731
+    net, tr = _build_mlp()
+    fused = gluon.FusedTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), tr)
+    with faults.inject("train.batch:corrupt:4"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+            max_restarts=3, skip_policy=lambda step, exc: 3)
+        report = runner.run(6)
+    assert report.skipped_batches == 3
+    assert [runner.data_index(s) for s in range(6)] == [0, 1, 2, 6, 7, 8]
+
+
+# ---------------------------------------------------------------------------
+# checksummed snapshots: the corruption matrix
+# ---------------------------------------------------------------------------
+def _ck_with_two_steps(tmp_path):
+    ck = SnapshotCheckpointer(str(tmp_path / "ck"), keep=4)
+    ck.save(1, {"w": np.arange(4.0), "step": 1})
+    ck.save(2, {"w": np.arange(4.0) * 2, "step": 2})
+    return ck
+
+
+def test_snapshot_truncated_payload_falls_back(tmp_path):
+    ck = _ck_with_two_steps(tmp_path)
+    with open(ck._file(2), "r+b") as f:
+        f.truncate(10)
+    before = _counter("checkpoint.corrupt")
+    step, tree = ck.restore()
+    assert step == 1 and tree["step"] == 1
+    assert _counter("checkpoint.corrupt") == before + 1
+    assert _counter("checkpoint.corrupt_fallbacks") >= 1
+
+
+def test_snapshot_flipped_bytes_falls_back(tmp_path):
+    ck = _ck_with_two_steps(tmp_path)
+    with open(ck._file(2), "r+b") as f:
+        blob = bytearray(f.read())
+        blob[len(blob) // 2] ^= 0xFF
+        f.seek(0)
+        f.write(bytes(blob))
+    step, tree = ck.restore()
+    assert step == 1 and tree["step"] == 1
+
+
+def test_snapshot_stale_latest_marker_never_crashes(tmp_path):
+    """LATEST flipped to garbage bytes: the scan fallback restores the
+    newest durable step — counted nowhere, crashed never."""
+    ck = _ck_with_two_steps(tmp_path)
+    with open(os.path.join(ck.path, "LATEST"), "wb") as f:
+        f.write(b"\xff\x13garbage")
+    assert ck.latest_step() == 2
+    step, tree = ck.restore()
+    assert step == 2 and tree["step"] == 2
+
+
+def test_snapshot_marker_names_missing_step_falls_back(tmp_path):
+    from mxnet_tpu.util import write_latest_marker
+    ck = _ck_with_two_steps(tmp_path)
+    write_latest_marker(ck.path, 9)  # stale: step 9 was retained away
+    assert ck.latest_step() == 2
+    step, _ = ck.restore()
+    assert step == 2
+
+
+def test_snapshot_injected_corruption_between_prepare_and_commit(tmp_path):
+    """The checkpoint.corrupt transform flips bytes ON DISK between pickle
+    and atomic write, while the sidecar keeps the true digest — restore
+    must detect it and fall back even though commit() succeeded."""
+    ck = SnapshotCheckpointer(str(tmp_path / "ck"), keep=4)
+    ck.save(1, {"w": np.arange(4.0)})
+    with faults.inject("checkpoint.corrupt:corrupt:1"):
+        ck.prepare(2, {"w": np.arange(4.0) * 2})
+        ck.commit(2)
+    assert ck.latest_step() == 2  # committed: the marker moved
+    before = _counter("checkpoint.corrupt")
+    step, tree = ck.restore()
+    assert step == 1
+    assert _counter("checkpoint.corrupt") == before + 1
+
+
+def test_snapshot_all_corrupt_raises(tmp_path):
+    ck = _ck_with_two_steps(tmp_path)
+    for s in (1, 2):
+        with open(ck._file(s), "r+b") as f:
+            f.truncate(8)
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ck.restore()
+    assert ei.value.steps_tried == [2, 1]
+
+
+def test_snapshot_missing_sidecar_still_loads(tmp_path):
+    """Pre-checksum snapshots (no .sha256 sidecar) must stay restorable."""
+    ck = _ck_with_two_steps(tmp_path)
+    os.remove(ck._digest_file(2))
+    step, tree = ck.restore()
+    assert step == 2
+
+
+def test_runner_restores_past_corrupt_snapshot(tmp_path, monkeypatch):
+    """End-to-end: newest snapshot corrupted on disk, then a preemption —
+    the runner falls back to the older snapshot, replays, and still
+    matches the clean trajectory bit-exactly."""
+    X, Y = _batches()
+    batch_fn = lambda i: (nd.array(X[i]), nd.array(Y[i]))  # noqa: E731
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net_b, tr_b = _build_mlp()
+    fused_b = gluon.FusedTrainStep(net_b, loss_fn, tr_b)
+    with faults.inject(
+            "checkpoint.corrupt:corrupt:2;run.step:preempt:5"):
+        runner = rz.ResilientRunner.for_fused_step(
+            fused_b, batch_fn, ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+            max_restarts=3)
+        report = runner.run(6)
+    assert report.restarts == 1
+    assert _counter("checkpoint.corrupt_fallbacks") >= 1
+
+    net_a, tr_a = _build_mlp()
+    fused_a = gluon.FusedTrainStep(net_a, loss_fn, tr_a)
+    for i in range(6):
+        fused_a(*batch_fn(i))
+    _assert_bit_identical(net_a, net_b)
+
+
+# ---------------------------------------------------------------------------
+# orbax (sharded) checksums
+# ---------------------------------------------------------------------------
+def _orbax_corrupt(root, step):
+    """Flip a byte in every ocdbt data chunk of the step — the array
+    payload lives in the d/ files (tensorstore may surface the damage as
+    a read error or as silently different values; both must be caught)."""
+    import glob
+    victims = [p for p in glob.glob("%s/%d/**/*" % (root, step),
+                                    recursive=True)
+               if os.path.isfile(p) and os.sep + "d" + os.sep in p]
+    assert victims, "no ocdbt data chunks under step %d" % step
+    for victim in victims:
+        with open(victim, "r+b") as f:
+            blob = bytearray(f.read())
+            blob[len(blob) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(bytes(blob))
+
+
+def test_sharded_checkpoint_flipped_bytes_falls_back(tmp_path):
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    root = str(tmp_path / "sharded")
+    ckpt.save_sharded(root, {"w": np.arange(8.0, dtype=np.float32)}, step=1)
+    ckpt.save_sharded(root, {"w": np.arange(8.0, dtype=np.float32) * 3},
+                      step=2)
+    _orbax_corrupt(root, 2)
+    before = _counter("checkpoint.corrupt")
+    tree = ckpt.restore_sharded(root)
+    np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                  np.arange(8.0, dtype=np.float32))
+    assert _counter("checkpoint.corrupt") == before + 1
+    assert _counter("checkpoint.corrupt_fallbacks") >= 1
+
+
+def test_sharded_checkpoint_all_corrupt_raises(tmp_path):
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    root = str(tmp_path / "sharded")
+    ckpt.save_sharded(root, {"w": np.ones(4, np.float32)}, step=1)
+    _orbax_corrupt(root, 1)
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.restore_sharded(root)
+
+
+def test_sharded_checkpoint_coordinated_commit_verified(tmp_path):
+    """commit=True path (single-process election degenerates): the elected
+    step's sidecar is stamped and a clean restore verifies against it."""
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    root = str(tmp_path / "sharded")
+    ckpt.save_sharded(root, {"w": np.full(4, 7.0, np.float32)}, step=3,
+                      coordinated=True)
+    assert os.path.isfile(os.path.join(root, "3.sha256.json"))
+    assert ckpt.latest_committed_step(root) == 3
+    tree = ckpt.restore_sharded(root, coordinated=True)
+    np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                  np.full(4, 7.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# AMP bridge
+# ---------------------------------------------------------------------------
+def _net_with_grads(poison=False):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2))
+    net.initialize()
+    x = nd.array(np.random.RandomState(3).rand(5, 3).astype(np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    params = [p for p in net.collect_params().values()
+              if p.grad_req != "null"]
+    if poison:
+        g = params[1].list_grad()[0]
+        g[:] = nd.array(np.full(g.shape, np.nan, np.float32))
+    return params
+
+
+def _reference_has_overflow(params):
+    # the pre-fusion per-grad host-sync loop, kept as the decision oracle
+    for p in params:
+        if p.grad_req == "null":
+            continue
+        for g in p.list_grad():
+            if not np.isfinite(np.asarray(g.asnumpy(),
+                                          dtype=np.float64)).all():
+                return True
+    return False
+
+
+def test_amp_has_overflow_single_sync_bit_identical_decision():
+    from mxnet_tpu.contrib.amp import amp
+    scaler = amp.LossScaler()
+    for poison in (False, True):
+        params = _net_with_grads(poison=poison)
+        n_grads = sum(len(p.list_grad()) for p in params)
+        saved0 = _counter("amp.syncs_saved")
+        got = scaler.has_overflow(params)
+        assert got == _reference_has_overflow(params) == poison
+        assert _counter("amp.syncs_saved") - saved0 == n_grads - 1
+    assert _counter("integrity.amp_overflow") == 1
+
+
+def test_amp_overflow_skip_routes_through_sentinel_counters():
+    from mxnet_tpu.contrib.amp import amp
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    tr._amp_loss_scaler.loss_scale = 1.0
+    x = nd.array(np.ones((4, 3), np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    for p in net.collect_params().values():
+        g = p.list_grad()[0]
+        g[:] = nd.array(np.full(g.shape, np.nan, np.float32))
+    before_w = _params_of(net)
+    skipped0 = _counter("integrity.amp_skipped_steps")
+    # force the fp16-style decision path regardless of global amp state
+    import mxnet_tpu.contrib.amp.amp as amp_mod
+    old = amp_mod._target_dtype
+    amp_mod._target_dtype = "float16"
+    try:
+        tr._update()
+    finally:
+        amp_mod._target_dtype = old
+    assert _counter("integrity.amp_skipped_steps") == skipped0 + 1
+    for (k, a), (_, b) in zip(before_w, _params_of(net)):
+        assert a.tobytes() == b.tobytes(), "skip-step mutated %s" % k
+
+
+# ---------------------------------------------------------------------------
+# comm checksum lever (dist push buckets)
+# ---------------------------------------------------------------------------
+def test_comm_checksum_counts_and_trips_on_nonfinite(monkeypatch):
+    from mxnet_tpu.kvstore.kvstore_dist import KVStoreDist
+    monkeypatch.setenv("MXNET_TPU_COMM_CHECKSUM", "1")
+    with engine.bucket_mb_scope(25):
+        kv = KVStoreDist("dist_sync")
+        for k in range(4):
+            kv.init(k, nd.zeros((3,)))
+        before = _counter("comm.checksum.buckets")
+        kv.push(list(range(4)),
+                [nd.array(np.full(3, float(k + 1), np.float32))
+                 for k in range(4)])
+        assert _counter("comm.checksum.buckets") > before
+        bad = [nd.array(np.full(3, float(k), np.float32)) for k in range(4)]
+        bad[2] = nd.array(np.array([1.0, np.nan, 2.0], np.float32))
+        with pytest.raises(DivergenceError):
+            kv.push(list(range(4)), bad)
+
+
+# ---------------------------------------------------------------------------
+# chaos soak (pytest -m chaos; rides slow CI)
+# ---------------------------------------------------------------------------
+def _chaos_mod():
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import chaos
+    return chaos
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_train_soak_invariants():
+    chaos = _chaos_mod()
+    report = chaos.train_soak(seed=0, steps=30, n_faults=14)
+    assert report["ok"], report
+    assert report["faults_fired"] >= 12
+    assert len(report["sites_hit"]) >= 5
+    for kind in ("corrupt", "preempt", "hang"):
+        assert kind in report["kinds_hit"], report["kinds_hit"]
+    assert report["params_bit_identical"] and report["params_finite"]
+    assert report["final_indices_unique"]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_serve_soak_invariants():
+    chaos = _chaos_mod()
+    report = chaos.serve_soak(seed=0, requests=6, n_faults=6)
+    assert report["ok"], report
+    assert report["faults_fired"] >= 5
+    assert report["tokens_byte_identical"]
+    assert report["reconcile_exact"] and report["leaked_kv_blocks"] == 0

@@ -1,0 +1,353 @@
+"""Chaos soak harness: randomized multi-fault schedules, exact invariants.
+
+Single-shot fault tests prove each recovery path works alone; fleets die
+to *combinations* — a preemption landing mid-replay of a rollback, a
+corrupt checkpoint discovered only because a later hang forced a restore.
+This harness closes that gap: a seeded `random.Random` draws a multi-fault
+schedule over the registered fault sites (`resilience.faults`), installs
+it as a fault plan, soaks a real training loop (and, separately, the
+serving engine) through it, and then checks invariants that are EXACT,
+not statistical:
+
+training soak (`train_soak`)
+    * the run completes (no fault combination may wedge or kill it);
+    * final params are bit-identical to an equivalent clean run over the
+      post-skip batch trajectory (`ResilientRunner.data_index`) — replay
+      and rollback must be deterministic to the last mantissa bit;
+    * every committed data index is unique (no batch trained twice, none
+      silently dropped);
+    * all params finite (a poisoned batch that escaped the sentinel would
+      leave NaN footprints).
+
+serving soak (`serve_soak`)
+    * every stream's tokens byte-identical to the unfaulted run (no token
+      lost or duplicated across kills/requeues/hangs);
+    * `pool.reconcile() == 0` and zero leaked KV blocks after drain.
+
+Deterministic by construction: same seed → same schedule → same report.
+Run standalone (``python tools/chaos.py --mode both --seed 0``) or from
+CI via the ``chaos``-marked pytest wrappers (``pytest -m chaos``).
+Unlike the log-side tools/ scripts this one imports the framework — it
+IS the workload.
+
+Exit codes: 0 = all invariants green, 1 = an invariant failed,
+2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as tools/chaos.py from anywhere
+    sys.path.insert(0, _REPO)
+
+# ---------------------------------------------------------------------------
+# schedule generation
+# ---------------------------------------------------------------------------
+# site -> kinds a soak may draw there. Deliberately narrower than what the
+# site accepts: the soak must always be *survivable* (an `error` inside the
+# restore path would fail the run by design, so only latency goes there).
+TRAIN_SITE_KINDS = {
+    "run.step": ("error", "preempt", "latency", "hang"),
+    "train.step": ("error", "latency"),
+    "train.batch": ("corrupt",),
+    "checkpoint.save": ("error", "latency"),
+    "checkpoint.restore": ("latency",),
+    "checkpoint.corrupt": ("corrupt",),
+}
+SERVE_SITE_KINDS = {
+    "serve.step": ("error", "latency", "hang"),
+    "serve.admit": ("latency",),
+}
+# kinds the acceptance bar demands at least once per schedule
+_MANDATORY = (("train.batch", "corrupt"), ("checkpoint.corrupt", "corrupt"),
+              ("run.step", "preempt"), ("run.step", "hang"))
+_SERVE_MANDATORY = (("serve.step", "error"), ("serve.step", "hang"))
+
+_HANG_ARG = 3.0  # seconds; the watchdog deadline converts it to a StallError
+
+
+def _nth_range(site, steps, ckpt_every):
+    """1-based call-count window in which a fault at `site` is guaranteed
+    to fire during a `steps`-step soak (replays only add calls)."""
+    if site in ("run.step", "train.step", "train.batch"):
+        return 2, max(2, steps)
+    if site in ("checkpoint.save", "checkpoint.corrupt"):
+        return 1, max(1, steps // ckpt_every - 1)
+    if site == "checkpoint.restore":
+        return 1, 2  # only recoveries restore; keep it early
+    if site == "serve.admit":
+        return 1, 4
+    return 2, 8  # serve.step: scheduler ticks, many per request
+
+
+def _draw_schedule(rng, site_kinds, n_faults, steps=32, ckpt_every=2,
+                   mandatory=()):
+    """Seeded schedule: `n_faults` deduped (site, nth) entries in fault-plan
+    grammar, mandatory (site, kind) pairs first so the acceptance kinds
+    (corrupt / preempt / hang) always appear."""
+    entries = {}
+
+    def add(site, kind):
+        lo, hi = _nth_range(site, steps, ckpt_every)
+        for _ in range(8):  # dedup (site, nth) by redraw
+            nth = rng.randint(lo, hi)
+            if (site, nth) not in entries:
+                break
+        else:
+            return
+        arg = None
+        if kind == "latency":
+            arg = round(rng.uniform(0.01, 0.04), 3)
+        elif kind == "hang":
+            arg = _HANG_ARG
+        entries[(site, nth)] = (site, kind, nth, arg)
+
+    for site, kind in mandatory:
+        if site in site_kinds:
+            add(site, kind)
+    sites = sorted(site_kinds)
+    while len(entries) < n_faults:
+        site = rng.choice(sites)
+        add(site, rng.choice(site_kinds[site]))
+    plan = []
+    for site, kind, nth, arg in sorted(entries.values(),
+                                       key=lambda e: (e[0], e[2])):
+        plan.append("%s:%s:%d" % (site, kind, nth)
+                    + ("" if arg is None else ":%g" % arg))
+    return ";".join(plan)
+
+
+def _fired_specs(plan):
+    """Which plan entries actually fired: a one-shot spec fired iff its
+    site's call counter reached its nth."""
+    return [s for s in plan.specs
+            if (not s.every) and plan.count(s.site) >= s.nth]
+
+
+# ---------------------------------------------------------------------------
+# training soak
+# ---------------------------------------------------------------------------
+def _build_mlp():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(42)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    return net, trainer
+
+
+def _batches(n, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 32, 8).astype(np.float32)
+    Y = rng.randint(0, 3, (n, 32)).astype(np.float32)
+    return X, Y
+
+
+def train_soak(seed=0, steps=30, n_faults=12, verbose=False):
+    """Seeded multi-fault training soak; returns the invariant report."""
+    import numpy as np
+    from mxnet_tpu import gluon, nd, telemetry
+    from mxnet_tpu import resilience as rz
+    from mxnet_tpu.resilience import faults
+
+    rng = random.Random(seed)
+    ckpt_every = 2
+    plan_text = _draw_schedule(rng, TRAIN_SITE_KINDS, n_faults, steps=steps,
+                               ckpt_every=ckpt_every, mandatory=_MANDATORY)
+    if verbose:
+        print("train plan:", plan_text)
+    # enough spare batches to absorb every possible skipped window
+    X, Y = _batches(steps + n_faults + 4)
+
+    def batch_fn(i):
+        return nd.array(X[i]), nd.array(Y[i])
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    overrides = {"MXNET_TPU_INTEGRITY": "1",
+                 "MXNET_TPU_ROLLBACK_BUDGET": "10"}
+    saved_env = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        net, trainer = _build_mlp()
+        fused = gluon.FusedTrainStep(net, loss_fn, trainer)
+        with tempfile.TemporaryDirectory(prefix="chaos_ckpt_") as ckpt_dir:
+            runner = rz.ResilientRunner.for_fused_step(
+                fused, batch_fn, ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+                keep=4, max_restarts=n_faults + 8, step_deadline_s=0.75)
+            with faults.inject(plan_text) as plan:
+                report = runner.run(steps)
+                fired = _fired_specs(plan)
+            final_idx = [runner.data_index(s) for s in range(steps)]
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    counters = telemetry.snapshot()["counters"]
+
+    # the equivalent clean run: same init, the post-skip batch trajectory
+    net_clean, trainer_clean = _build_mlp()
+    fused_clean = gluon.FusedTrainStep(net_clean, loss_fn, trainer_clean)
+    for i in final_idx:
+        fused_clean(*batch_fn(i))
+
+    mismatched, nonfinite = [], []
+    chaos_params = sorted(net.collect_params().items())
+    clean_params = sorted(net_clean.collect_params().items())
+    for (name, p_chaos), (_, p_clean) in zip(chaos_params, clean_params):
+        a = np.asarray(p_chaos.data().asnumpy())
+        b = np.asarray(p_clean.data().asnumpy())
+        if not np.isfinite(a).all():
+            nonfinite.append(name)
+        if a.tobytes() != b.tobytes():
+            mismatched.append(name)
+
+    result = {
+        "mode": "train",
+        "seed": seed,
+        "steps": steps,
+        "plan": plan_text,
+        "faults_scheduled": len(plan.specs),
+        "faults_fired": len(fired),
+        "sites_hit": sorted({s.site for s in fired}),
+        "kinds_hit": sorted({s.kind for s in fired}),
+        "rollbacks": report.rollbacks,
+        "skipped_batches": report.skipped_batches,
+        "restarts": report.restarts,
+        "replayed": report.replayed_steps,
+        "corrupt_snapshots": int(counters.get("checkpoint.corrupt", 0)),
+        "corrupt_fallbacks": int(
+            counters.get("checkpoint.corrupt_fallbacks", 0)),
+        "divergences": int(counters.get("integrity.divergences", 0)),
+        "final_indices_unique": len(set(final_idx)) == steps,
+        "params_bit_identical": not mismatched,
+        "params_finite": not nonfinite,
+        "mismatched_params": mismatched,
+        "nonfinite_params": nonfinite,
+    }
+    result["ok"] = (result["params_bit_identical"]
+                    and result["params_finite"]
+                    and result["final_indices_unique"])
+    return result
+
+
+# ---------------------------------------------------------------------------
+# serving soak
+# ---------------------------------------------------------------------------
+def serve_soak(seed=0, requests=6, n_faults=6, verbose=False):
+    """Seeded multi-fault serving soak; returns the invariant report."""
+    import jax
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models.llama import LlamaConfig, llama_init
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serve import InferenceServer, Request
+
+    rng = random.Random(seed)
+    plan_text = _draw_schedule(rng, SERVE_SITE_KINDS, n_faults,
+                               mandatory=_SERVE_MANDATORY)
+    if verbose:
+        print("serve plan:", plan_text)
+
+    import jax.numpy as jnp
+    cfg = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, hidden_dim=128, rope_theta=10000.0,
+                      max_seq_len=64, dtype=jnp.float32)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    prng = np.random.RandomState(seed)
+    prompts = [prng.randint(1, cfg.vocab_size - 1,
+                            size=prng.randint(3, 10)).tolist()
+               for _ in range(requests)]
+    budgets = [3 + i % 4 for i in range(requests)]
+
+    def run_all(server):
+        handles = [server.submit(Request(p, max_new_tokens=b))
+                   for p, b in zip(prompts, budgets)]
+        server.run()
+        return [h.result(timeout=60) for h in handles]
+
+    def make_server():
+        return InferenceServer(params, cfg, kv_blocks=48, block_size=8,
+                               max_batch=4, max_context=32,
+                               step_deadline_s=0.5).warmup()
+
+    baseline = run_all(make_server())
+    telemetry.enable()
+    telemetry.reset()
+    server = make_server()
+    with faults.inject(plan_text) as plan:
+        chaos = run_all(server)
+        fired = _fired_specs(plan)
+    counters = telemetry.snapshot()["counters"]
+
+    leaked = server.pool.blocks_in_use - server.pool.prefix_blocks
+    result = {
+        "mode": "serve",
+        "seed": seed,
+        "requests": requests,
+        "plan": plan_text,
+        "faults_scheduled": len(plan.specs),
+        "faults_fired": len(fired),
+        "sites_hit": sorted({s.site for s in fired}),
+        "kinds_hit": sorted({s.kind for s in fired}),
+        "recoveries": int(counters.get("serve.recoveries", 0)),
+        "requeued_streams": int(counters.get("serve.requeued_streams", 0)),
+        "tokens_byte_identical": chaos == baseline,
+        "reconcile_exact": server.pool.reconcile() == 0,
+        "leaked_kv_blocks": int(leaked),
+    }
+    result["ok"] = (result["tokens_byte_identical"]
+                    and result["reconcile_exact"]
+                    and result["leaked_kv_blocks"] == 0)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Seeded chaos soak over the resilience fault sites.")
+    ap.add_argument("--mode", choices=("train", "serve", "both"),
+                    default="both")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=30,
+                    help="training soak steps")
+    ap.add_argument("--requests", type=int, default=6,
+                    help="serving soak request count")
+    ap.add_argument("--faults", type=int, default=12,
+                    help="faults per training schedule (serve draws half)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    reports = []
+    if args.mode in ("train", "both"):
+        reports.append(train_soak(args.seed, steps=args.steps,
+                                  n_faults=args.faults,
+                                  verbose=args.verbose))
+    if args.mode in ("serve", "both"):
+        reports.append(serve_soak(args.seed, requests=args.requests,
+                                  n_faults=max(2, args.faults // 2),
+                                  verbose=args.verbose))
+    print(json.dumps(reports, indent=2))
+    return 0 if all(r["ok"] for r in reports) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,7 +86,14 @@ _RESILIENCE_EVENTS = ("faults_injected", "retries", "retry_exhausted",
                       "stalls", "restores", "checkpoints",
                       "proactive_checkpoints", "mesh_shrinks", "mesh_grows",
                       "commit.elections", "commit.rank_ahead",
-                      "preempt.notices")
+                      "preempt.notices", "rollbacks", "skipped_batches")
+
+# integrity-plane counters living OUTSIDE the resilience.* namespace — the
+# divergence sentinel (integrity.*) and checksum-verified restores
+# (checkpoint.corrupt*) are part of the same recovery narrative, so the
+# --resilience table lists them explicitly rather than losing them to the
+# unknown-prefix scan.
+_INTEGRITY_PREFIXES = ("integrity.", "checkpoint.corrupt", "comm.checksum.")
 
 
 def parse_resilience(obj):
@@ -116,6 +123,11 @@ def parse_resilience(obj):
                 not any(name.startswith("resilience.%s." % e)
                         for e in _RESILIENCE_EVENTS):
             rows.append((name[len("resilience."):], "total", counters[name]))
+    # the integrity plane: sentinel trips (integrity.divergences.<site>),
+    # AMP overflow skips, corrupt-checkpoint fallbacks, wire checksums
+    for name in sorted(counters):
+        if any(name.startswith(p) for p in _INTEGRITY_PREFIXES):
+            rows.append((name, "total", counters[name]))
     return rows
 
 
@@ -1009,9 +1021,11 @@ def main():
                              "dump (auto-detected for JSON files)")
     parser.add_argument("--resilience", action="store_true",
                         help="resilience-events mode: table of retries/"
-                             "stalls/restores/faults from a telemetry JSON "
-                             "dump — distinguishes a noisy-but-recovered "
-                             "run from a clean one")
+                             "stalls/restores/faults plus the integrity "
+                             "plane (rollbacks, skipped batches, sentinel "
+                             "divergences, corrupt-checkpoint fallbacks) "
+                             "from a telemetry JSON dump — distinguishes a "
+                             "noisy-but-recovered run from a clean one")
     parser.add_argument("--lint", action="store_true",
                         help="tracelint mode: table of findings from "
                              "`python -m mxnet_tpu.analysis --format json` "
